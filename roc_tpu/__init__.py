@@ -1,0 +1,18 @@
+"""roc-tpu: TPU-native distributed full-graph GNN training.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the
+Legion+CUDA reference system ROC (makemebitter/ROC) — see SURVEY.md.
+"""
+
+__version__ = "0.1.0"
+
+from .core.graph import (Dataset, Graph, add_self_edges, from_edge_list,
+                         load_dataset, load_lux, save_lux,
+                         synthetic_dataset, synthetic_graph,
+                         MASK_NONE, MASK_TRAIN, MASK_VAL, MASK_TEST)
+from .core.partition import (PartitionedGraph, edge_balanced_bounds,
+                             padded_edge_list, partition_graph)
+from .models.builder import (AGGR_AVG, AGGR_SUM, GraphContext, Model)
+from .models.gcn import build_gcn
+from .train.optimizer import (AdamConfig, AdamState, adam_init,
+                              adam_update, decayed_lr)

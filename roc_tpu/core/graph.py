@@ -1,0 +1,362 @@
+"""Graph data layer: CSR graph container, .lux binary reader, feature /
+label / mask loaders, and synthetic fixtures.
+
+TPU-native re-design of the reference data layer:
+
+- Reference ``Graph`` (``gnn.h:120-130``) holds Legion regions for row
+  pointers (inclusive-end offsets, one per vertex) and column indices.  We
+  hold plain numpy arrays host-side with the standard exclusive-start
+  ``row_ptr`` of length ``V+1`` (``row_ptr[0] == 0``), converting on load.
+- Reference `.lux` format (``gnn.cc:756-801``, ``load_task.cu:229-243``):
+  ``u32 numNodes``, ``u64 numEdges``, then ``numNodes`` u64 *inclusive end*
+  row offsets, then ``numEdges`` u32 source-vertex ids, rows sorted by
+  destination.  Self-edges are pre-added in the file (the driver appends
+  ``.add_self_edge.lux`` to the path, ``gnn.cc:756``); we expose
+  :func:`add_self_edges` to perform the same conversion in-framework.
+- Feature CSV loader with ``.feats.bin`` binary caching mirrors
+  ``load_task.cu:41-73``; labels are class indices (one integer per line,
+  ``load_task.cu:118-123`` one-hots them — we keep int labels and one-hot
+  lazily on device); masks are the strings Train/Val/Test/None
+  (``load_task.cu:169-183``).
+
+Row-major node-feature layout ``[num_nodes, dim]`` (the reference uses
+``[dim, num_nodes]`` column-major Legion rects — row-major is the
+TPU-friendly choice: feature dim lands on the 128-wide lane axis).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field, replace
+from typing import Optional
+
+import numpy as np
+
+# Mask values mirror the reference enum MaskType (gnn.h:98-103).
+MASK_NONE = 0
+MASK_TRAIN = 1
+MASK_VAL = 2
+MASK_TEST = 3
+
+_MASK_NAMES = {"Train": MASK_TRAIN, "Val": MASK_VAL, "Test": MASK_TEST,
+               "None": MASK_NONE}
+
+
+@dataclass
+class Graph:
+    """An in-memory CSR graph, destination-major.
+
+    ``row_ptr`` has length ``num_nodes + 1`` with ``row_ptr[0] == 0``;
+    edges for destination vertex ``v`` occupy ``col_idx[row_ptr[v]:row_ptr[v+1]]``
+    and store *source* vertex ids.  Aggregation computes
+    ``out[v] = sum(in[col_idx[row_ptr[v]:row_ptr[v+1]]])`` exactly like the
+    reference hot loop (``scattergather_kernel.cu:20-76``).
+    """
+
+    row_ptr: np.ndarray  # int64 [V+1]
+    col_idx: np.ndarray  # int32 [E]
+
+    def __post_init__(self):
+        self.row_ptr = np.asarray(self.row_ptr, dtype=np.int64)
+        self.col_idx = np.asarray(self.col_idx, dtype=np.int32)
+        assert self.row_ptr.ndim == 1 and self.col_idx.ndim == 1
+        assert self.row_ptr[0] == 0
+        assert self.row_ptr[-1] == self.col_idx.shape[0]
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.row_ptr.shape[0] - 1)
+
+    @property
+    def num_edges(self) -> int:
+        return int(self.col_idx.shape[0])
+
+    @property
+    def in_degree(self) -> np.ndarray:
+        """Per-destination edge counts (int32), the reference's indegree
+        (``graphnorm_kernel.cu:45-55`` computes it from CSR row pointers)."""
+        return np.diff(self.row_ptr).astype(np.int32)
+
+    def edge_dst(self) -> np.ndarray:
+        """Expand row_ptr to a per-edge destination id array (int32 [E])."""
+        return np.repeat(
+            np.arange(self.num_nodes, dtype=np.int32), self.in_degree
+        )
+
+    def has_all_self_edges(self) -> bool:
+        deg = self.in_degree
+        if (deg == 0).any():
+            return False
+        dst = self.edge_dst()
+        # binary check: does each row contain its own id?
+        out = np.zeros(self.num_nodes, dtype=bool)
+        out[dst[self.col_idx == dst]] = True
+        return bool(out.all())
+
+    def is_symmetric(self) -> bool:
+        """True iff the adjacency matrix equals its transpose.  The
+        reference backward pass reuses the forward CSR
+        (``scattergather_kernel.cu:160-170``) which is only correct for
+        symmetric graphs; callers can verify with this."""
+        return check_symmetric(self)
+
+    def transpose(self) -> "Graph":
+        """CSC <-> CSR flip: returns the graph with edge directions
+        reversed (sorted by the old source)."""
+        dst = self.edge_dst()
+        src = self.col_idx
+        order = np.argsort(src, kind="stable")
+        new_dst = src[order]
+        new_col = dst[order]
+        counts = np.bincount(new_dst, minlength=self.num_nodes)
+        row_ptr = np.zeros(self.num_nodes + 1, dtype=np.int64)
+        np.cumsum(counts, out=row_ptr[1:])
+        return Graph(row_ptr=row_ptr, col_idx=new_col.astype(np.int32))
+
+
+def check_symmetric(graph: Graph) -> bool:
+    """Exact symmetry check via sorted edge-list comparison."""
+    dst = graph.edge_dst().astype(np.int64)
+    src = graph.col_idx.astype(np.int64)
+    fwd = dst * graph.num_nodes + src
+    bwd = src * graph.num_nodes + dst
+    return bool(np.array_equal(np.sort(fwd), np.sort(bwd)))
+
+
+# ---------------------------------------------------------------------------
+# .lux binary format
+# ---------------------------------------------------------------------------
+
+def load_lux(path: str) -> Graph:
+    """Read a `.lux` binary graph (reference format, ``gnn.cc:756-801``):
+    u32 num_nodes, u64 num_edges, num_nodes x u64 inclusive-end row
+    offsets, num_edges x u32 source ids."""
+    with open(path, "rb") as f:
+        header = f.read(12)
+        num_nodes, num_edges = struct.unpack("<IQ", header)
+        raw_rows = np.fromfile(f, dtype="<u8", count=num_nodes)
+        col_idx = np.fromfile(f, dtype="<u4", count=num_edges)
+    assert raw_rows.shape[0] == num_nodes, "truncated .lux row offsets"
+    assert col_idx.shape[0] == num_edges, "truncated .lux col indices"
+    # Monotonicity asserts mirror gnn.cc:798-800.
+    assert (np.diff(raw_rows.astype(np.int64)) >= 0).all()
+    assert raw_rows[-1] == num_edges
+    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    row_ptr[1:] = raw_rows.astype(np.int64)
+    return Graph(row_ptr=row_ptr, col_idx=col_idx.astype(np.int32))
+
+
+def save_lux(graph: Graph, path: str) -> None:
+    """Write the reference `.lux` binary format (inverse of load_lux)."""
+    with open(path, "wb") as f:
+        f.write(struct.pack("<IQ", graph.num_nodes, graph.num_edges))
+        graph.row_ptr[1:].astype("<u8").tofile(f)
+        graph.col_idx.astype("<u4").tofile(f)
+
+
+def add_self_edges(graph: Graph) -> Graph:
+    """Ensure every vertex has a self edge (the `.add_self_edge.lux`
+    preprocessing the reference assumes was done offline, ``gnn.cc:756``).
+    Existing self edges are kept; missing ones are inserted."""
+    V = graph.num_nodes
+    dst = graph.edge_dst()
+    has_self = np.zeros(V, dtype=bool)
+    self_rows = dst[graph.col_idx == dst]
+    has_self[self_rows] = True
+    missing = np.flatnonzero(~has_self).astype(np.int32)
+    if missing.size == 0:
+        return graph
+    dst_all = np.concatenate([dst, missing])
+    col_all = np.concatenate([graph.col_idx, missing])
+    order = np.argsort(dst_all, kind="stable")
+    dst_all = dst_all[order]
+    col_all = col_all[order]
+    counts = np.bincount(dst_all, minlength=V)
+    row_ptr = np.zeros(V + 1, dtype=np.int64)
+    np.cumsum(counts, out=row_ptr[1:])
+    return Graph(row_ptr=row_ptr, col_idx=col_all.astype(np.int32))
+
+
+def from_edge_list(src: np.ndarray, dst: np.ndarray, num_nodes: int,
+                   symmetrize: bool = False) -> Graph:
+    """Build a dst-major CSR graph from a COO edge list."""
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    if symmetrize:
+        src, dst = np.concatenate([src, dst]), np.concatenate([dst, src])
+        # dedupe
+        key = dst * num_nodes + src
+        key = np.unique(key)
+        dst, src = key // num_nodes, key % num_nodes
+    order = np.argsort(dst, kind="stable")
+    src, dst = src[order], dst[order]
+    counts = np.bincount(dst, minlength=num_nodes)
+    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(counts, out=row_ptr[1:])
+    return Graph(row_ptr=row_ptr, col_idx=src.astype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# Feature / label / mask loaders (reference load_task.cu:25-199)
+# ---------------------------------------------------------------------------
+
+def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
+    """Load ``<prefix>.feats.csv`` (one comma-separated row per vertex),
+    caching a ``.feats.bin`` float32 binary alongside exactly like
+    ``load_task.cu:41-73``.  Returns float32 ``[num_nodes, in_dim]``."""
+    bin_path = prefix + ".feats.bin"
+    csv_path = prefix + ".feats.csv"
+    if os.path.exists(bin_path):
+        data = np.fromfile(bin_path, dtype=np.float32,
+                           count=num_nodes * in_dim)
+        assert data.size == num_nodes * in_dim, "truncated .feats.bin"
+        return data.reshape(num_nodes, in_dim)
+    data = np.loadtxt(csv_path, delimiter=",", dtype=np.float32)
+    data = data.reshape(num_nodes, in_dim)
+    data.tofile(bin_path)
+    return data
+
+
+def load_labels(prefix: str, num_nodes: int, num_classes: int) -> np.ndarray:
+    """Load ``<prefix>.label`` (one class index per line,
+    ``load_task.cu:118-123``).  Returns int32 ``[num_nodes]``; one-hot is
+    formed on device by the loss."""
+    labels = np.loadtxt(prefix + ".label", dtype=np.int64)[:num_nodes]
+    assert labels.shape[0] == num_nodes
+    assert ((labels >= 0) & (labels < num_classes)).all()
+    return labels.astype(np.int32)
+
+
+def load_mask(prefix: str, num_nodes: int) -> np.ndarray:
+    """Load ``<prefix>.mask`` ("Train"/"Val"/"Test"/"None" per line,
+    ``load_task.cu:169-183``).  Returns int32 ``[num_nodes]`` with
+    MASK_* values."""
+    out = np.empty(num_nodes, dtype=np.int32)
+    with open(prefix + ".mask") as f:
+        for v in range(num_nodes):
+            line = f.readline().strip()
+            if line not in _MASK_NAMES:
+                raise ValueError(f"Unrecognized mask: {line!r}")
+            out[v] = _MASK_NAMES[line]
+    return out
+
+
+@dataclass
+class Dataset:
+    """A fully-loaded full-graph node-classification problem."""
+
+    graph: Graph
+    features: np.ndarray  # float32 [V, in_dim]
+    labels: np.ndarray    # int32 [V]
+    mask: np.ndarray      # int32 [V] of MASK_* values
+    num_classes: int
+    name: str = "dataset"
+
+    @property
+    def in_dim(self) -> int:
+        return int(self.features.shape[1])
+
+
+def load_dataset(prefix: str, in_dim: int, num_classes: int,
+                 name: Optional[str] = None) -> Dataset:
+    """Load a reference-layout dataset directory: ``<prefix>.add_self_edge.lux``
+    (falling back to ``<prefix>.lux`` + in-framework self-edge insertion),
+    ``.feats.csv``/``.feats.bin``, ``.label``, ``.mask``."""
+    lux = prefix + ".add_self_edge.lux"
+    if os.path.exists(lux):
+        graph = load_lux(lux)
+    else:
+        graph = add_self_edges(load_lux(prefix + ".lux"))
+    feats = load_features(prefix, graph.num_nodes, in_dim)
+    labels = load_labels(prefix, graph.num_nodes, num_classes)
+    mask = load_mask(prefix, graph.num_nodes)
+    return Dataset(graph=graph, features=feats, labels=labels, mask=mask,
+                   num_classes=num_classes,
+                   name=name or os.path.basename(prefix))
+
+
+# ---------------------------------------------------------------------------
+# Synthetic fixtures (the reference ships none; needed for tests + bench)
+# ---------------------------------------------------------------------------
+
+def random_csr(num_nodes: int, num_edges: int, seed: int = 0,
+               power_law: bool = True) -> Graph:
+    """Fast benchmark-scale CSR generator: draws a degree sequence
+    (lognormal when ``power_law``, else near-uniform) summing to
+    ``num_edges`` with every degree >= 1 (self-edge convention), and
+    uniform random sources.  Not symmetric — use for timing, not for
+    gradient-parity tests."""
+    assert num_edges >= num_nodes, "need >= 1 edge per node (self edges)"
+    rng = np.random.RandomState(seed)
+    if power_law:
+        raw = rng.lognormal(mean=0.0, sigma=1.25, size=num_nodes)
+    else:
+        raw = np.ones(num_nodes) + rng.rand(num_nodes) * 0.1
+    extra = num_edges - num_nodes
+    deg = 1 + np.floor(raw / raw.sum() * extra).astype(np.int64)
+    # distribute the rounding remainder over random vertices
+    short = num_edges - int(deg.sum())
+    if short > 0:
+        idx = rng.randint(0, num_nodes, size=short)
+        np.add.at(deg, idx, 1)
+    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_ptr[1:])
+    col_idx = rng.randint(0, num_nodes, size=num_edges, dtype=np.int64)
+    return Graph(row_ptr=row_ptr, col_idx=col_idx.astype(np.int32))
+
+
+def synthetic_graph(num_nodes: int, avg_degree: int, seed: int = 0,
+                    power_law: bool = False) -> Graph:
+    """Random symmetric graph with self edges.  ``power_law=True`` skews
+    degrees like real social graphs (Reddit-ish) to stress edge-balanced
+    partitioning."""
+    rng = np.random.RandomState(seed)
+    n_rand = num_nodes * max(avg_degree - 1, 0) // 2
+    if power_law and n_rand > 0:
+        # preferential-attachment-flavored endpoints
+        p = 1.0 / (np.arange(num_nodes) + 10.0)
+        p /= p.sum()
+        src = rng.choice(num_nodes, size=n_rand, p=p).astype(np.int64)
+        dst = rng.randint(0, num_nodes, size=n_rand).astype(np.int64)
+    else:
+        src = rng.randint(0, num_nodes, size=n_rand).astype(np.int64)
+        dst = rng.randint(0, num_nodes, size=n_rand).astype(np.int64)
+    g = from_edge_list(src, dst, num_nodes, symmetrize=True)
+    return add_self_edges(g)
+
+
+def synthetic_dataset(num_nodes: int = 128, avg_degree: int = 8,
+                      in_dim: int = 16, num_classes: int = 4,
+                      seed: int = 0, homophily: float = 0.8,
+                      name: str = "synthetic") -> Dataset:
+    """Deterministic learnable fixture: a homophilous graph (edges mostly
+    intra-class, like Cora/Reddit) with class-informative features
+    (cluster means + noise), so a GCN converges quickly — the stand-in
+    for the reference's convergence-as-test strategy (SURVEY §4)."""
+    rng = np.random.RandomState(seed + 1)
+    labels = rng.randint(0, num_classes, size=num_nodes).astype(np.int32)
+    # homophilous edges: src random; dst same-class with prob `homophily`
+    n_rand = num_nodes * max(avg_degree - 1, 0) // 2
+    src = rng.randint(0, num_nodes, size=n_rand).astype(np.int64)
+    by_class = [np.flatnonzero(labels == c) for c in range(num_classes)]
+    dst = np.empty(n_rand, dtype=np.int64)
+    same = rng.rand(n_rand) < homophily
+    for i in range(n_rand):
+        if same[i]:
+            pool = by_class[labels[src[i]]]
+            dst[i] = pool[rng.randint(len(pool))]
+        else:
+            dst[i] = rng.randint(num_nodes)
+    graph = add_self_edges(from_edge_list(src, dst, num_nodes,
+                                          symmetrize=True))
+    means = rng.randn(num_classes, in_dim).astype(np.float32) * 2.0
+    feats = means[labels] + rng.randn(num_nodes, in_dim).astype(np.float32)
+    mask = np.full(num_nodes, MASK_NONE, dtype=np.int32)
+    split = rng.rand(num_nodes)
+    mask[split < 0.5] = MASK_TRAIN
+    mask[(split >= 0.5) & (split < 0.75)] = MASK_VAL
+    mask[split >= 0.75] = MASK_TEST
+    return Dataset(graph=graph, features=feats.astype(np.float32),
+                   labels=labels, mask=mask, num_classes=num_classes,
+                   name=name)

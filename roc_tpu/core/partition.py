@@ -1,0 +1,189 @@
+"""Edge-balanced contiguous vertex-range graph partitioner.
+
+Re-implements the reference's greedy sweep (``gnn.cc:806-829``): walk
+vertices in order accumulating in-edge counts; whenever the running count
+exceeds ``cap = ceil(E / num_parts)`` close the current range at this
+vertex (inclusive) and reset the counter.  The reference then *asserts*
+that exactly ``num_parts`` ranges were produced (``gnn.cc:829``) — which
+can fail on skewed graphs.  We keep the same greedy semantics but make the
+result total: if the sweep closes fewer than ``num_parts`` ranges, the
+tail ranges are empty; it can never produce more because the cap
+guarantees at least one vertex per closed range.
+
+On top of the ranges we add what the TPU SPMD layer needs and Legion
+provided implicitly (``gnn_mapper.cc`` + region partitions): *padded,
+equal-sized* shards so every device holds identical static shapes.
+Node counts pad to ``max_part_nodes`` rounded up to ``node_multiple``
+(sublane-friendly), edge counts to ``max_part_edges`` rounded to
+``edge_multiple``.  Padding edges point at a dummy source (node index
+``V``, whose feature row is zero) and a dummy destination (the last padded
+row), so they aggregate zeros and touch no real output row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import numpy as np
+
+from .graph import Graph
+
+
+def edge_balanced_bounds(row_ptr: np.ndarray, num_parts: int
+                         ) -> List[Tuple[int, int]]:
+    """Greedy edge-balanced split into ``num_parts`` contiguous inclusive
+    vertex ranges ``[left, right]`` (reference ``gnn.cc:806-829``).
+    Ranges may be empty (``left > right``) only in the padded tail."""
+    row_ptr = np.asarray(row_ptr, dtype=np.int64)
+    num_nodes = row_ptr.shape[0] - 1
+    num_edges = int(row_ptr[-1])
+    cap = (num_edges + num_parts - 1) // num_parts
+    bounds: List[Tuple[int, int]] = []
+    left = 0
+    cnt = 0
+    deg = np.diff(row_ptr)
+    for v in range(num_nodes):
+        cnt += int(deg[v])
+        if cnt > cap and len(bounds) < num_parts - 1:
+            bounds.append((left, v))
+            cnt = 0
+            left = v + 1
+    bounds.append((left, num_nodes - 1))
+    # pad with empty tail ranges so len(bounds) == num_parts always
+    while len(bounds) < num_parts:
+        bounds.append((num_nodes, num_nodes - 1))
+    return bounds
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@dataclass
+class PartitionedGraph:
+    """A graph split into ``num_parts`` equal-shaped shards for a 1-D
+    device mesh.  All per-part arrays are stacked on a leading parts axis
+    so they shard cleanly with ``NamedSharding(P('parts'))``.
+
+    Conventions:
+      - ``part_row_ptr[p]`` is a *local* CSR over the part's padded rows:
+        length ``part_nodes + 1``, offsets into the part's padded edge
+        slice.  Padding edges attach to the *first padded row* (or the
+        last real row when the part has no padded rows) so that edge
+        destinations stay contiguous — the blocked/pallas aggregators
+        rely on "a chunk of C sorted edges spans <= C rows".  Padding
+        edges point at the dummy zero-feature source, so a real last row
+        absorbing them just adds zeros.
+      - ``part_col_idx[p]`` holds *global* source ids; padding edges point
+        at the dummy source id ``num_nodes`` (a zero feature row appended
+        by the training layer).
+      - ``node_offset[p]`` is the global id of the part's first row;
+        global row ``g`` lives at part ``p``, local row ``g - node_offset[p]``.
+    """
+
+    num_nodes: int
+    num_edges: int
+    num_parts: int
+    part_nodes: int              # padded rows per part
+    part_edges: int              # padded edges per part
+    bounds: List[Tuple[int, int]]
+    node_offset: np.ndarray      # int32 [P]
+    real_nodes: np.ndarray       # int32 [P] un-padded row counts
+    real_edges: np.ndarray       # int64 [P]
+    part_row_ptr: np.ndarray     # int32 [P, part_nodes+1] local offsets
+    part_col_idx: np.ndarray     # int32 [P, part_edges] global src ids
+    part_in_degree: np.ndarray   # int32 [P, part_nodes] real in-degrees
+
+    @property
+    def padded_num_nodes(self) -> int:
+        """Total rows across all parts (== part_nodes * num_parts)."""
+        return self.part_nodes * self.num_parts
+
+    @property
+    def dummy_src(self) -> int:
+        """Global source id used by padding edges; its feature row must be
+        zero."""
+        return self.num_nodes
+
+    def local_to_global(self) -> np.ndarray:
+        """int32 [P, part_nodes] map of padded local rows to global node
+        ids; padded rows map to ``num_nodes`` (the dummy row)."""
+        out = np.full((self.num_parts, self.part_nodes), self.num_nodes,
+                      dtype=np.int32)
+        for p in range(self.num_parts):
+            n = int(self.real_nodes[p])
+            out[p, :n] = np.arange(self.node_offset[p],
+                                   self.node_offset[p] + n, dtype=np.int32)
+        return out
+
+    def global_pad_map(self) -> np.ndarray:
+        """int32 [padded_num_nodes] map from concatenated padded rows back
+        to global node ids (num_nodes for padding rows).  Used to scatter
+        padded-part outputs back to the compact global order."""
+        return self.local_to_global().reshape(-1)
+
+
+def padded_edge_list(graph: Graph, multiple: int = 1024
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """Single-device analog of the partition padding: return
+    ``(edge_src, edge_dst)`` int32 arrays padded to a multiple of
+    ``multiple``.  Padding edges use the dummy source ``num_nodes`` (zero
+    feature row) and the last real destination row, preserving both the
+    aggregation result and the blocked aggregator's sorted-contiguity
+    invariant."""
+    E = graph.num_edges
+    Ep = _round_up(max(E, 1), multiple)
+    src = np.full(Ep, graph.num_nodes, dtype=np.int32)
+    dst = np.full(Ep, graph.num_nodes - 1, dtype=np.int32)
+    src[:E] = graph.col_idx
+    dst[:E] = graph.edge_dst()
+    return src, dst
+
+
+def partition_graph(graph: Graph, num_parts: int,
+                    node_multiple: int = 8,
+                    edge_multiple: int = 128) -> PartitionedGraph:
+    """Partition ``graph`` into ``num_parts`` equal-shaped padded shards
+    using the reference's edge-balanced greedy bounds."""
+    bounds = edge_balanced_bounds(graph.row_ptr, num_parts)
+    V, E = graph.num_nodes, graph.num_edges
+    real_nodes = np.array([max(r - l + 1, 0) for l, r in bounds],
+                          dtype=np.int32)
+    real_edges = np.array(
+        [int(graph.row_ptr[r + 1] - graph.row_ptr[l]) if r >= l else 0
+         for l, r in bounds], dtype=np.int64)
+    part_nodes = _round_up(max(int(real_nodes.max()), 1), node_multiple)
+    part_edges = _round_up(max(int(real_edges.max()), 1), edge_multiple)
+
+    node_offset = np.array([l for l, _ in bounds], dtype=np.int32)
+    node_offset = np.minimum(node_offset, V)  # empty tail parts
+    part_row_ptr = np.zeros((num_parts, part_nodes + 1), dtype=np.int32)
+    part_col_idx = np.full((num_parts, part_edges), V, dtype=np.int32)
+    part_in_degree = np.zeros((num_parts, part_nodes), dtype=np.int32)
+
+    for p, (l, r) in enumerate(bounds):
+        if r < l:
+            # empty part: every edge is padding; row 0 absorbs them all.
+            part_row_ptr[p, 1:] = part_edges
+            continue
+        n = r - l + 1
+        e0 = int(graph.row_ptr[l])
+        e1 = int(graph.row_ptr[r + 1])
+        local_ptr = (graph.row_ptr[l:r + 2] - e0).astype(np.int32)
+        part_row_ptr[p, :n + 1] = local_ptr
+        # Padding edges attach immediately after the real edges, on the
+        # first padded row (local row n) — or, when n == part_nodes, on
+        # the last real row, where they harmlessly add the dummy source's
+        # zero feature row.  Every row after that has zero edges, so
+        # part_row_ptr[-1] == part_edges always holds.
+        part_row_ptr[p, min(n, part_nodes - 1) + 1:] = part_edges
+        part_col_idx[p, :e1 - e0] = graph.col_idx[e0:e1]
+        part_in_degree[p, :n] = np.diff(graph.row_ptr[l:r + 2])
+
+    return PartitionedGraph(
+        num_nodes=V, num_edges=E, num_parts=num_parts,
+        part_nodes=part_nodes, part_edges=part_edges, bounds=bounds,
+        node_offset=node_offset, real_nodes=real_nodes,
+        real_edges=real_edges, part_row_ptr=part_row_ptr,
+        part_col_idx=part_col_idx, part_in_degree=part_in_degree)

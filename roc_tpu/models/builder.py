@@ -1,0 +1,262 @@
+"""Model builder: the reference's ``Model`` API rebuilt functionally.
+
+The reference ``Model`` class (``gnn.h:162-203``) exposes
+``dropout / linear / scatter_gather / indegree_norm / relu / sigmoid /
+add / softmax_cross_entropy`` which append ``GnnOp*`` to a layer list
+(e.g. ``linear.cc:20-29``); ``forward()`` walks the list and
+``backward()`` walks it in reverse with hand-written gradients
+(``gnn.cc:696-716``).
+
+Here the same builder API records a static op list; :meth:`Model.apply`
+interprets it inside a traced JAX function, so XLA sees one fused program
+and ``jax.grad`` replaces the reference's manual autodiff driver
+(including the shared-input gradient-accumulation bookkeeping of
+``gnn.cc:705-713`` — JAX accumulates fanout cotangents automatically).
+
+Graph access is abstracted behind :class:`GraphContext` so the same model
+runs single-device (identity feature gather) and under ``shard_map``
+(ICI ``all_gather`` feature halo — the reference's whole-region input
+requirement, ``scattergather.cc:70-72``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import dense
+from ..ops.aggregate import aggregate, aggregate_mean
+from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU, AC_MODE_SIGMOID
+from ..ops.loss import masked_softmax_cross_entropy, perf_metrics
+from ..ops.norm import indegree_norm
+
+# AggrType mirror (gnn.h:75-80); the reference declares SUM/AVG/MAX/MIN
+# but implements only SUM.
+AGGR_SUM = "sum"
+AGGR_AVG = "avg"
+
+
+@dataclass
+class GraphContext:
+    """Per-device view of the (partitioned) graph inside a step function.
+
+    edge_src: int32 [E_local] source ids in *row-coordinate space* — i.e.
+      indices into the feature matrix produced by ``gather_features``,
+      with the dummy zero row at index ``gathered_rows``.
+    edge_dst: int32 [E_local] local destination rows (sorted ascending).
+    in_degree: int32 [num_rows] real in-degrees of local rows.
+    num_rows: static local row count (padded).
+    gathered_rows: static row count of the gathered feature matrix
+      (== num_rows single-device; == parts * num_rows under shard_map).
+    gather_features: the halo exchange — identity single-device,
+      ``lax.all_gather`` over the mesh axis in the distributed step.
+    psum: metric/loss reduction across shards (identity single-device).
+    """
+
+    edge_src: jax.Array
+    edge_dst: jax.Array
+    in_degree: jax.Array
+    num_rows: int
+    gathered_rows: int
+    gather_features: Callable[[jax.Array], jax.Array] = lambda x: x
+    psum: Callable[[Any], Any] = lambda x: x
+    aggr_impl: str = "segment"
+    chunk: int = 512
+    symmetric: bool = True
+
+    def _sum_fwd(self, x: jax.Array) -> jax.Array:
+        """Halo exchange + local CSR sum: ``out = A_p @ gather(x)``."""
+        full = self.gather_features(x)
+        # append the dummy zero source row that padding edges point at
+        zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
+        full = jnp.concatenate([full, zero], axis=0)
+        return aggregate(full, self.edge_src, self.edge_dst,
+                         self.num_rows, impl=self.aggr_impl,
+                         chunk=self.chunk)
+
+    def aggregate_sum(self, x: jax.Array) -> jax.Array:
+        """Sum aggregation with the reference's backward: for a symmetric
+        global adjacency, grad_x(local) = A_p @ all_gather(cotangent) —
+        the same kernel + halo exchange run on the cotangent
+        (``scattergather_kernel.cu:160-170``; shard-level identity:
+        row-slice_p(A^T g) = A_p g for A == A^T).  Besides parity, this
+        keeps the blocked scan's backward O(chunk) memory instead of
+        saving per-chunk residuals.  Set ``symmetric=False`` for exact
+        autodiff through the forward (directed graphs)."""
+        if not self.symmetric:
+            return self._sum_fwd(x)
+
+        @jax.custom_vjp
+        def agg(x):
+            return self._sum_fwd(x)
+
+        def fwd(x):
+            return agg(x), None
+
+        def bwd(_, g):
+            return (self._sum_fwd(g),)
+
+        agg.defvjp(fwd, bwd)
+        return agg(x)
+
+    def aggregate(self, x: jax.Array, aggr: str = AGGR_SUM) -> jax.Array:
+        if aggr == AGGR_SUM:
+            return self.aggregate_sum(x)
+        if aggr == AGGR_AVG:
+            s = self.aggregate_sum(x)
+            deg = jnp.maximum(self.in_degree.astype(s.dtype), 1.0)
+            return s / deg[:, None]
+        raise ValueError(f"unknown aggregator: {aggr}")
+
+
+@dataclass(frozen=True)
+class TensorHandle:
+    """Symbolic tensor produced by builder calls (the analog of the
+    reference's ``Tensor`` value, ``gnn.h:132-158``)."""
+    idx: int
+    dim: int
+
+
+@dataclass
+class _Op:
+    kind: str
+    inputs: Tuple[int, ...]
+    dim: int
+    param: Optional[str] = None        # param-dict key for linear ops
+    attrs: Dict[str, Any] = field(default_factory=dict)
+
+
+class Model:
+    """Builder + interpreter.  Mirrors the reference Model API
+    (``gnn.h:162-203``); see module docstring."""
+
+    def __init__(self, in_dim: int):
+        self._ops: List[_Op] = [_Op("input", (), in_dim)]
+        self._n_linear = 0
+        self._loss_op: Optional[int] = None
+
+    # ---- builder API (names match the reference) ----
+
+    def input(self) -> TensorHandle:
+        return TensorHandle(0, self._ops[0].dim)
+
+    def dropout(self, t: TensorHandle, rate: float = 0.5) -> TensorHandle:
+        return self._append("dropout", (t.idx,), t.dim, attrs={"rate": rate})
+
+    def linear(self, t: TensorHandle, out_dim: int,
+               activation: str = AC_MODE_NONE) -> TensorHandle:
+        name = f"linear_{self._n_linear}"
+        self._n_linear += 1
+        return self._append("linear", (t.idx,), out_dim, param=name,
+                            attrs={"activation": activation,
+                                   "in_dim": t.dim})
+
+    def indegree_norm(self, t: TensorHandle) -> TensorHandle:
+        return self._append("indegree_norm", (t.idx,), t.dim)
+
+    def scatter_gather(self, t: TensorHandle,
+                       aggr: str = AGGR_SUM) -> TensorHandle:
+        return self._append("scatter_gather", (t.idx,), t.dim,
+                            attrs={"aggr": aggr})
+
+    def relu(self, t: TensorHandle) -> TensorHandle:
+        return self._append("activation", (t.idx,), t.dim,
+                            attrs={"mode": AC_MODE_RELU})
+
+    def sigmoid(self, t: TensorHandle) -> TensorHandle:
+        return self._append("activation", (t.idx,), t.dim,
+                            attrs={"mode": AC_MODE_SIGMOID})
+
+    def add(self, a: TensorHandle, b: TensorHandle) -> TensorHandle:
+        assert a.dim == b.dim
+        return self._append("add", (a.idx, b.idx), a.dim)
+
+    def mul(self, a: TensorHandle, b: TensorHandle) -> TensorHandle:
+        assert a.dim == b.dim
+        return self._append("mul", (a.idx, b.idx), a.dim)
+
+    def softmax_cross_entropy(self, t: TensorHandle) -> TensorHandle:
+        """Marks ``t`` as the logits fed to the masked CE loss (labels and
+        mask arrive as apply() arguments, unlike the reference which binds
+        label/mask tensors here, ``gnn.cc:92``)."""
+        self._loss_op = t.idx
+        return t
+
+    def _append(self, kind: str, inputs: Tuple[int, ...], dim: int,
+                param: Optional[str] = None,
+                attrs: Optional[Dict[str, Any]] = None) -> TensorHandle:
+        self._ops.append(_Op(kind, inputs, dim, param, attrs or {}))
+        return TensorHandle(len(self._ops) - 1, dim)
+
+    # ---- params ----
+
+    def init_params(self, key: jax.Array,
+                    dtype=jnp.float32) -> Dict[str, jax.Array]:
+        """Glorot-uniform for every linear weight: U(-s, s) with
+        ``s = sqrt(6/(in+out))`` (``initializer_kernel.cu:38-48``)."""
+        params: Dict[str, jax.Array] = {}
+        for op in self._ops:
+            if op.kind == "linear":
+                key, sub = jax.random.split(key)
+                in_dim = op.attrs["in_dim"]
+                s = float(np.sqrt(6.0 / (in_dim + op.dim)))
+                params[op.param] = jax.random.uniform(
+                    sub, (in_dim, op.dim), dtype=dtype, minval=-s, maxval=s)
+        return params
+
+    # ---- interpreter ----
+
+    def apply(self, params: Dict[str, jax.Array], feats: jax.Array,
+              gctx: GraphContext, key: Optional[jax.Array] = None,
+              train: bool = True) -> jax.Array:
+        """Run the recorded op list; returns the logits tensor."""
+        if (train and key is None and
+                any(op.kind == "dropout" and op.attrs["rate"] > 0
+                    for op in self._ops)):
+            raise ValueError(
+                "a PRNG key is required in train mode for models with "
+                "dropout; pass key= or use train=False")
+        vals: List[Optional[jax.Array]] = [None] * len(self._ops)
+        vals[0] = feats
+        n_dropout = 0
+        for i, op in enumerate(self._ops[1:], start=1):
+            x = vals[op.inputs[0]] if op.inputs else None
+            if op.kind == "dropout":
+                if train and key is not None:
+                    sub = jax.random.fold_in(key, n_dropout)
+                else:
+                    sub = None
+                n_dropout += 1
+                vals[i] = dense.dropout(x, op.attrs["rate"], sub, train)
+            elif op.kind == "linear":
+                vals[i] = dense.linear(x, params[op.param],
+                                       op.attrs["activation"])
+            elif op.kind == "indegree_norm":
+                vals[i] = indegree_norm(x, gctx.in_degree)
+            elif op.kind == "scatter_gather":
+                vals[i] = gctx.aggregate(x, op.attrs["aggr"])
+            elif op.kind == "activation":
+                vals[i] = dense.activation(x, op.attrs["mode"])
+            elif op.kind == "add":
+                vals[i] = vals[op.inputs[0]] + vals[op.inputs[1]]
+            elif op.kind == "mul":
+                vals[i] = vals[op.inputs[0]] * vals[op.inputs[1]]
+            else:
+                raise ValueError(f"unknown op kind {op.kind}")
+        out_idx = self._loss_op if self._loss_op is not None else -1
+        return vals[out_idx]
+
+    def loss_fn(self, params: Dict[str, jax.Array], feats: jax.Array,
+                labels: jax.Array, mask: jax.Array, gctx: GraphContext,
+                key: Optional[jax.Array] = None,
+                train: bool = True) -> Tuple[jax.Array, jax.Array]:
+        """(summed masked CE, logits) — the differentiable objective whose
+        gradient equals the reference's ``softmax - onehot`` on train rows
+        (``softmax_kernel.cu:19-33``)."""
+        logits = self.apply(params, feats, gctx, key=key, train=train)
+        loss = masked_softmax_cross_entropy(logits, labels, mask)
+        return gctx.psum(loss), logits

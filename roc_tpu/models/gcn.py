@@ -1,0 +1,47 @@
+"""GCN model family: the reference driver's layer stack.
+
+Reproduces ``top_level_task``'s model construction (``gnn.cc:75-92``): for
+each layer spec entry after the first::
+
+    t = dropout(t, rate)
+    input = t
+    t = linear(t, layers[i], AC_MODE_NONE)
+    t = indegree_norm(t)
+    t = scatter_gather(t)          # D^-1/2 A D^-1/2 with self edges
+    t = indegree_norm(t)
+    if not last: t = relu(t)
+    if len(layers) > 3:            # residual for deep stacks
+        input = linear(input, t.dim, AC_MODE_NONE)
+        t = add(t, input)
+    softmax_cross_entropy(t, label, mask)
+
+``layers`` follows the reference CLI convention ``-layers 602-256-41``:
+layers[0] is the input feature dim, layers[-1] the class count.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .builder import AGGR_AVG, AGGR_SUM, Model
+from ..ops.dense import AC_MODE_NONE
+
+
+def build_gcn(layers: Sequence[int], dropout_rate: float = 0.5) -> Model:
+    model = Model(in_dim=layers[0])
+    t = model.input()
+    n = len(layers)
+    for i in range(1, n):
+        t = model.dropout(t, dropout_rate)
+        res = t
+        t = model.linear(t, layers[i], AC_MODE_NONE)
+        t = model.indegree_norm(t)
+        t = model.scatter_gather(t)
+        t = model.indegree_norm(t)
+        if i != n - 1:
+            t = model.relu(t)
+        if n > 3:
+            res = model.linear(res, t.dim, AC_MODE_NONE)
+            t = model.add(t, res)
+    model.softmax_cross_entropy(t)
+    return model

@@ -1,0 +1,77 @@
+"""Dense ops: linear, activations, elementwise, dropout.
+
+The reference implements these as cuBLAS/cuDNN leaf tasks (``linear.cc`` /
+``linear_kernel.cu``, ``activation_kernel.cu``, ``element_kernel.cu``,
+``dropout_kernel.cu``).  On TPU they are single XLA ops that the compiler
+fuses and lowers onto the MXU/VPU — the fused linear+ReLU of
+``linear_kernel.cu:81-104`` falls out of XLA fusion for free.
+
+Semantics parity notes:
+- Linear: ``y = x @ W`` with no bias, exactly the reference
+  (``linear_kernel.cu:76-80`` computes W^T·X in its column-major layout,
+  which is X·W in our row-major layout).  Optional fused activation
+  mirrors ``ActiMode`` (``gnn.h:82-86``).
+- Dropout: inverted dropout with scale 1/(1-rate) in train mode (cuDNN's
+  convention, ``dropout_kernel.cu:98-99``), identity in infer mode
+  (``dropout_kernel.cu:160-180``).  We thread an explicit PRNG key —
+  the functional replacement for the cuDNN dropout states cached in the
+  reference's ResourceManager.
+- Element add: used for residual connections when the model is deeper
+  than 3 layers (``gnn.cc:86-90``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# ActiMode mirror (gnn.h:82-86)
+AC_MODE_NONE = "none"
+AC_MODE_RELU = "relu"
+AC_MODE_SIGMOID = "sigmoid"
+
+_ACTIVATIONS = {
+    AC_MODE_NONE: lambda x: x,
+    AC_MODE_RELU: jax.nn.relu,
+    AC_MODE_SIGMOID: jax.nn.sigmoid,
+}
+
+
+def linear(x: jax.Array, w: jax.Array,
+           activation: str = AC_MODE_NONE,
+           precision=None) -> jax.Array:
+    """x: [V, in_dim] @ w: [in_dim, out_dim] with optional fused
+    activation.  Always accumulates in fp32 on the MXU; for fp32 inputs
+    the multiply also runs at full precision (parity with the reference's
+    fp32 cuBLAS GEMM, ``linear_kernel.cu:76-80``), while bf16 inputs use
+    the MXU's native bf16 multiply path."""
+    if precision is None and x.dtype == jnp.float32:
+        precision = jax.lax.Precision.HIGHEST
+    y = jax.lax.dot_general(
+        x, w, (((1,), (0,)), ((), ())), precision=precision,
+        preferred_element_type=jnp.float32).astype(x.dtype)
+    return _ACTIVATIONS[activation](y)
+
+
+def activation(x: jax.Array, mode: str) -> jax.Array:
+    return _ACTIVATIONS[mode](x)
+
+
+def element_add(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a + b
+
+
+def element_mul(a: jax.Array, b: jax.Array) -> jax.Array:
+    return a * b
+
+
+def dropout(x: jax.Array, rate: float, key: Optional[jax.Array],
+            train: bool) -> jax.Array:
+    """Inverted dropout; identity when not training or rate == 0."""
+    if not train or rate <= 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, p=keep, shape=x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)

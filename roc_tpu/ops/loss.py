@@ -1,0 +1,82 @@
+"""Masked softmax cross-entropy and training metrics.
+
+Reference (``softmax_kernel.cu``): the train-mode forward is a no-op and
+the loss is fused into backward (``softmax.cc:45-55``) — the gradient is
+``softmax(logits) - onehot(label)`` zeroed outside the train mask
+(``softmax_kernel.cu:19-33``), i.e. the gradient of the *sum* (not mean)
+of per-vertex cross-entropies over train vertices.  We expose that
+objective directly and let ``jax.grad`` produce the identical gradient.
+
+The printed "train loss" is NOT the cross-entropy: the reference's
+``calc_loss`` kernel accumulates ``sum over train vertices of
+(1 - p_true)`` (``softmax_kernel.cu:65``) plus masked argmax accuracies
+for train/val/test (``softmax_kernel.cu:41-79``), reduced with on-GPU
+atomics.  :func:`perf_metrics` reproduces those definitions exactly; in
+the sharded step the returned struct is ``psum``-reduced over the mesh —
+the ICI equivalent of the reference's atomics + single-GPU reduction.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..core.graph import MASK_NONE, MASK_TRAIN, MASK_VAL, MASK_TEST
+
+
+def masked_softmax_cross_entropy(logits: jax.Array, labels: jax.Array,
+                                 mask: jax.Array) -> jax.Array:
+    """Sum of CE over MASK_TRAIN vertices.  ``grad == softmax - onehot``
+    on train rows and 0 elsewhere, matching ``softmax_kernel.cu:19-33``.
+
+    logits: [V, C] float; labels: [V] int32; mask: [V] int32 MASK_*.
+    Padding rows must carry MASK_NONE.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None].astype(jnp.int32),
+                             axis=-1)[:, 0]
+    train = (mask == MASK_TRAIN).astype(jnp.float32)
+    return -jnp.sum(ll * train)
+
+
+def perf_metrics(logits: jax.Array, labels: jax.Array,
+                 mask: jax.Array) -> Dict[str, jax.Array]:
+    """Reference ``PerfMetrics`` (``softmax_kernel.cu:35-39``): unreduced
+    sums, safe to ``psum`` across shards before dividing."""
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    p_true = jnp.take_along_axis(p, labels[:, None].astype(jnp.int32),
+                                 axis=-1)[:, 0]
+    pred = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    correct = (pred == labels).astype(jnp.float32)
+    out: Dict[str, jax.Array] = {}
+    for name, mval in (("train", MASK_TRAIN), ("val", MASK_VAL),
+                       ("test", MASK_TEST)):
+        sel = (mask == mval).astype(jnp.float32)
+        out[f"{name}_cnt"] = jnp.sum(sel)
+        out[f"{name}_correct"] = jnp.sum(correct * sel)
+    train_sel = (mask == MASK_TRAIN).astype(jnp.float32)
+    # reference "loss": sum over train of (1 - p_true)  (softmax_kernel.cu:65)
+    out["train_loss_sum"] = jnp.sum((1.0 - p_true) * train_sel)
+    return out
+
+
+def summarize_metrics(m: Dict[str, jax.Array]) -> Dict[str, float]:
+    """Convert psum'd metric sums into the printed quantities
+    (``softmax_kernel.cu:141-152``)."""
+    def _div(a, b):
+        return float(a) / max(float(b), 1.0)
+    return {
+        # the reference prints the raw sum, not a mean
+        "train_loss": float(m["train_loss_sum"]),
+        "train_acc": _div(m["train_correct"], m["train_cnt"]),
+        "val_acc": _div(m["val_correct"], m["val_cnt"]),
+        "test_acc": _div(m["test_correct"], m["test_cnt"]),
+        "train_cnt": int(m["train_cnt"]),
+        "val_cnt": int(m["val_cnt"]),
+        "test_cnt": int(m["test_cnt"]),
+        "train_correct": int(m["train_correct"]),
+        "val_correct": int(m["val_correct"]),
+        "test_correct": int(m["test_correct"]),
+    }

@@ -1,0 +1,153 @@
+"""Single-device training loop (the reference's ``top_level_task`` epoch
+loop, ``gnn.cc:99-111``): per epoch — staircase lr decay, zero grads
+(implicit: JAX recomputes), forward, backward, Adam update; every 5th
+epoch an inference pass printing train loss + train/val/test accuracy in
+the reference's format (``softmax_kernel.cu:141-152``).
+
+The distributed loop lives in ``parallel/distributed.py``; this module is
+the minimum end-to-end slice (BASELINE.md config 1/2 path).
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.graph import Dataset
+from ..core.partition import padded_edge_list
+from ..models.builder import GraphContext, Model
+from ..ops.loss import perf_metrics, summarize_metrics
+from .optimizer import AdamConfig, AdamState, adam_init, adam_update, decayed_lr
+
+
+@dataclass
+class TrainConfig:
+    """Mirrors the reference ``Config`` struct + CLI defaults
+    (``gnn.h:105-113``, ``gnn.cc:30-41``)."""
+    learning_rate: float = 0.01
+    weight_decay: float = 0.05
+    dropout_rate: float = 0.5
+    decay_rate: float = 1.0
+    decay_steps: int = 100
+    epochs: int = 200
+    seed: int = 1
+    eval_every: int = 5
+    verbose: bool = True
+    aggr_impl: str = "segment"   # "segment" | "blocked" | "pallas"
+    chunk: int = 512
+    dtype: Any = jnp.float32
+    # Symmetric-adjacency assumption for the aggregation backward (the
+    # reference requires it, scattergather_kernel.cu:160-170).
+    # None = verify host-side at setup (O(E log E)); True = trust the
+    # caller (skip the check, e.g. huge graphs); False = force exact
+    # autodiff gradients (directed graphs; slow for the blocked impl).
+    symmetric: Optional[bool] = None
+
+
+def resolve_symmetric(dataset: Dataset,
+                      symmetric: Optional[bool]) -> bool:
+    if symmetric is None:
+        from ..core.graph import check_symmetric
+        return check_symmetric(dataset.graph)
+    return symmetric
+
+
+def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
+                       chunk: int = 512,
+                       symmetric: Optional[bool] = None) -> GraphContext:
+    """Single-device GraphContext: edges padded to the chunk multiple,
+    dummy source id == num_nodes (the appended zero row)."""
+    g = dataset.graph
+    edge_src, edge_dst = padded_edge_list(g, multiple=chunk)
+    return GraphContext(
+        edge_src=jnp.asarray(edge_src),
+        edge_dst=jnp.asarray(edge_dst),
+        in_degree=jnp.asarray(g.in_degree),
+        num_rows=g.num_nodes,
+        gathered_rows=g.num_nodes,
+        aggr_impl=aggr_impl,
+        chunk=chunk,
+        symmetric=resolve_symmetric(dataset, symmetric),
+    )
+
+
+class Trainer:
+    """Owns params + optimizer state and the jitted step functions."""
+
+    def __init__(self, model: Model, dataset: Dataset,
+                 config: TrainConfig = TrainConfig()):
+        self.model = model
+        self.config = config
+        self.epoch = 0
+        self.gctx = make_graph_context(dataset, config.aggr_impl,
+                                       config.chunk,
+                                       symmetric=config.symmetric)
+        self.feats = jnp.asarray(dataset.features, dtype=config.dtype)
+        self.labels = jnp.asarray(dataset.labels)
+        self.mask = jnp.asarray(dataset.mask)
+        key = jax.random.PRNGKey(config.seed)
+        self.key, init_key = jax.random.split(key)
+        self.params = model.init_params(init_key, dtype=config.dtype)
+        self.opt_state = adam_init(self.params)
+        self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
+        self._train_step = jax.jit(self._train_step_impl)
+        self._eval_step = jax.jit(self._eval_step_impl)
+
+    def _train_step_impl(self, params, opt_state, key, lr):
+        def objective(p):
+            loss, _ = self.model.loss_fn(p, self.feats, self.labels,
+                                         self.mask, self.gctx, key=key,
+                                         train=True)
+            return loss
+        loss, grads = jax.value_and_grad(objective)(params)
+        params, opt_state = adam_update(params, grads, opt_state, lr,
+                                        self.adam_cfg)
+        return params, opt_state, loss
+
+    def _eval_step_impl(self, params):
+        logits = self.model.apply(params, self.feats, self.gctx,
+                                  key=None, train=False)
+        return perf_metrics(logits, self.labels, self.mask)
+
+    def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
+        """Run ``epochs`` more epochs; the epoch counter persists across
+        calls so lr decay and the eval cadence continue correctly."""
+        cfg = self.config
+        epochs = epochs if epochs is not None else cfg.epochs
+        history: List[Dict[str, float]] = []
+        for _ in range(epochs):
+            epoch = self.epoch
+            lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
+                            cfg.decay_rate, cfg.decay_steps)
+            self.key, step_key = jax.random.split(self.key)
+            self.params, self.opt_state, _ = self._train_step(
+                self.params, self.opt_state, step_key, lr)
+            if epoch % cfg.eval_every == 0:
+                m = summarize_metrics(jax.device_get(
+                    self._eval_step(self.params)))
+                m["epoch"] = epoch
+                history.append(m)
+                if cfg.verbose:
+                    print(format_metrics(epoch, m))
+            self.epoch += 1
+        return history
+
+    def evaluate(self) -> Dict[str, float]:
+        return summarize_metrics(jax.device_get(
+            self._eval_step(self.params)))
+
+
+def format_metrics(epoch: int, m: Dict[str, float]) -> str:
+    """The reference's infer-mode print line (``softmax_kernel.cu:146``)."""
+    return ("[INFER][%d] train_loss: %.4f  train_accuracy: %.2f%%(%d/%d)  "
+            "val_accuracy: %.2f%%(%d/%d)  test_accuracy: %.2f%%(%d/%d)"
+            % (epoch, m["train_loss"],
+               m["train_acc"] * 100.0, m["train_correct"], m["train_cnt"],
+               m["val_acc"] * 100.0, m["val_correct"], m["val_cnt"],
+               m["test_acc"] * 100.0, m["test_correct"], m["test_cnt"]))

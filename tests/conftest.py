@@ -1,0 +1,17 @@
+"""Test configuration: force an 8-virtual-device CPU platform BEFORE jax
+initializes, so sharding tests run anywhere (SURVEY.md §4 test plan)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize can override JAX_PLATFORMS after env setup;
+# force the CPU platform explicitly so the 8 virtual devices exist.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)

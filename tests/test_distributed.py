@@ -1,0 +1,103 @@
+"""Sharded training on an 8-virtual-device CPU mesh: partition-count
+invariance (1 vs N shards must match single-device numerics), halo
+exchange correctness, psum'd metrics."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu.core.graph import synthetic_dataset
+from roc_tpu.core.partition import partition_graph
+from roc_tpu.models.gcn import build_gcn
+from roc_tpu.parallel.distributed import (DistributedTrainer, make_mesh,
+                                          pad_nodes, remap_to_padded,
+                                          unpad_nodes)
+from roc_tpu.train.trainer import TrainConfig, Trainer
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    return synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=11)
+
+
+def _no_dropout_cfg(**kw):
+    return TrainConfig(dropout_rate=0.0, verbose=False, epochs=8,
+                       weight_decay=1e-3, learning_rate=0.01, **kw)
+
+
+def test_remap_roundtrip(dataset):
+    pg = partition_graph(dataset.graph, 4, node_multiple=8,
+                         edge_multiple=32)
+    col_padded = remap_to_padded(pg)
+    l2g = pg.local_to_global().reshape(-1)  # padded coord -> global id
+    # every real edge must map back to its original global src
+    for p in range(4):
+        e = int(pg.real_edges[p])
+        back = l2g[col_padded[p, :e]]
+        np.testing.assert_array_equal(back, pg.part_col_idx[p, :e])
+        assert (col_padded[p, e:] == pg.num_parts * pg.part_nodes).all()
+
+
+def test_pad_unpad_roundtrip(dataset):
+    pg = partition_graph(dataset.graph, 4, node_multiple=8)
+    padded = pad_nodes(dataset.features, pg)
+    back = unpad_nodes(padded, pg)
+    np.testing.assert_array_equal(back, dataset.features)
+
+
+@pytest.mark.parametrize("num_parts", [2, 4, 8])
+def test_distributed_matches_single_device(dataset, num_parts):
+    """Same init, same data, no dropout: the sharded step must reproduce
+    single-device training (the reference's partition-count invariance)."""
+    model = build_gcn([dataset.in_dim, 16, dataset.num_classes],
+                      dropout_rate=0.0)
+    cfg = _no_dropout_cfg()
+    single = Trainer(model, dataset, cfg)
+    dist = DistributedTrainer(model, dataset, num_parts, cfg)
+    # identical initial params by construction (same seed)
+    for k in single.params:
+        np.testing.assert_array_equal(np.asarray(single.params[k]),
+                                      np.asarray(dist.params[k]))
+    single.train()
+    dist.train()
+    for k in single.params:
+        np.testing.assert_allclose(np.asarray(single.params[k]),
+                                   np.asarray(dist.params[k]),
+                                   rtol=2e-4, atol=2e-5)
+    m_s = single.evaluate()
+    m_d = dist.evaluate()
+    assert m_s["train_cnt"] == m_d["train_cnt"]
+    assert m_s["val_cnt"] == m_d["val_cnt"]
+    assert m_s["test_cnt"] == m_d["test_cnt"]
+    assert abs(m_s["test_acc"] - m_d["test_acc"]) < 0.02
+    np.testing.assert_allclose(m_s["train_loss"], m_d["train_loss"],
+                               rtol=1e-3)
+
+
+def test_distributed_blocked_impl(dataset):
+    """blocked aggregation under shard_map matches segment."""
+    model = build_gcn([dataset.in_dim, 16, dataset.num_classes],
+                      dropout_rate=0.0)
+    outs = {}
+    for impl in ("segment", "blocked"):
+        cfg = _no_dropout_cfg(aggr_impl=impl, chunk=64)
+        t = DistributedTrainer(model, dataset, 4, cfg)
+        t.train(epochs=3)
+        outs[impl] = t.evaluate()
+    np.testing.assert_allclose(outs["segment"]["train_loss"],
+                               outs["blocked"]["train_loss"], rtol=1e-3)
+
+
+def test_distributed_converges(dataset):
+    model = build_gcn([dataset.in_dim, 24, dataset.num_classes],
+                      dropout_rate=0.1)
+    cfg = TrainConfig(dropout_rate=0.1, verbose=False, epochs=50,
+                      weight_decay=1e-4, learning_rate=0.01)
+    t = DistributedTrainer(model, dataset, 8, cfg)
+    t.train()
+    m = t.evaluate()
+    assert m["train_acc"] > 0.9
+    assert m["test_acc"] > 0.6
