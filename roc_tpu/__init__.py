@@ -12,7 +12,13 @@ from .core.graph import (Dataset, Graph, add_self_edges, from_edge_list,
                          MASK_NONE, MASK_TRAIN, MASK_VAL, MASK_TEST)
 from .core.partition import (PartitionedGraph, edge_balanced_bounds,
                              padded_edge_list, partition_graph)
-from .models.builder import (AGGR_AVG, AGGR_SUM, GraphContext, Model)
+from .core.ell import EllTable, ell_from_graph, ell_from_padded_parts
+from .models.builder import (AGGR_AVG, AGGR_MAX, AGGR_SUM, GraphContext,
+                             Model)
 from .models.gcn import build_gcn
+from .models.sage import build_sage
+from .models.gin import build_gin
 from .train.optimizer import (AdamConfig, AdamState, adam_init,
                               adam_update, decayed_lr)
+from .utils.checkpoint import (checkpoint_trainer, load_checkpoint,
+                               restore_trainer, save_checkpoint)

@@ -29,15 +29,18 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import dense
-from ..ops.aggregate import aggregate, aggregate_mean
+from ..ops.aggregate import aggregate, aggregate_ell, aggregate_mean
 from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU, AC_MODE_SIGMOID
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics
 from ..ops.norm import indegree_norm
 
 # AggrType mirror (gnn.h:75-80); the reference declares SUM/AVG/MAX/MIN
-# but implements only SUM.
+# but implements only SUM.  Here SUM and AVG ride the symmetric-vjp CSR
+# path; MAX uses exact autodiff (it is nonlinear, so the reference's
+# kernel-reuse trick does not apply).
 AGGR_SUM = "sum"
 AGGR_AVG = "avg"
+AGGR_MAX = "max"
 
 
 @dataclass
@@ -67,6 +70,10 @@ class GraphContext:
     aggr_impl: str = "segment"
     chunk: int = 512
     symmetric: bool = True
+    # ELL layout (aggr_impl == "ell"): tuple of [rows_b, width_b] index
+    # arrays + [num_rows] output permutation (core/ell.py)
+    ell_idx: Tuple[jax.Array, ...] = ()
+    ell_row_pos: Optional[jax.Array] = None
 
     def _sum_fwd(self, x: jax.Array) -> jax.Array:
         """Halo exchange + local CSR sum: ``out = A_p @ gather(x)``."""
@@ -74,6 +81,9 @@ class GraphContext:
         # append the dummy zero source row that padding edges point at
         zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
         full = jnp.concatenate([full, zero], axis=0)
+        if self.aggr_impl == "ell":
+            return aggregate_ell(full, self.ell_idx, self.ell_row_pos,
+                                 self.num_rows)
         return aggregate(full, self.edge_src, self.edge_dst,
                          self.num_rows, impl=self.aggr_impl,
                          chunk=self.chunk)
@@ -110,7 +120,39 @@ class GraphContext:
             s = self.aggregate_sum(x)
             deg = jnp.maximum(self.in_degree.astype(s.dtype), 1.0)
             return s / deg[:, None]
+        if aggr == AGGR_MAX:
+            return self._max_fwd(x)
         raise ValueError(f"unknown aggregator: {aggr}")
+
+    def _max_fwd(self, x: jax.Array) -> jax.Array:
+        """Neighbor max; rows with no neighbors yield 0.  Dummy/padding
+        sources are masked out (their zero rows must not win the max)."""
+        full = self.gather_features(x)
+        zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
+        full = jnp.concatenate([full, zero], axis=0)
+        dummy = full.shape[0] - 1
+        neg = jnp.asarray(-jnp.inf, dtype=full.dtype)
+        if self.aggr_impl == "ell":
+            outs = []
+            for idx in self.ell_idx:
+                g = full[idx]                              # [R, W, F]
+                m = (idx != dummy)[:, :, None]
+                outs.append(jnp.max(jnp.where(m, g, neg), axis=1))
+            tail = jnp.full((1, full.shape[1]), neg, dtype=full.dtype)
+            cat = jnp.concatenate(outs + [tail], axis=0)
+            out = cat[self.ell_row_pos]
+        else:
+            if self.aggr_impl == "blocked":
+                raise NotImplementedError(
+                    "AGGR_MAX has no blocked implementation; use "
+                    "aggr_impl='ell' (big graphs) or 'segment' — the "
+                    "segment path materializes the full [E, F] per-edge "
+                    "matrix")
+            g = full[self.edge_src]
+            g = jnp.where((self.edge_src != dummy)[:, None], g, neg)
+            out = jax.ops.segment_max(g, self.edge_dst,
+                                      num_segments=self.num_rows)
+        return jnp.where(jnp.isfinite(out), out, 0.0).astype(full.dtype)
 
 
 @dataclass(frozen=True)

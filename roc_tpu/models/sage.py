@@ -1,0 +1,45 @@
+"""GraphSAGE (mean aggregation) model family.
+
+The reference declares AGGR_AVG in its AggrType enum (``gnn.h:75-80``)
+but never builds a SAGE model; this fills BASELINE.md config 3
+(GraphSAGE mean-aggregation + GraphNorm + dropout).  Standard SAGE-mean
+layer, expressed with the builder ops::
+
+    h = W_self · x  +  W_neigh · mean_{u in N(v)} x_u
+    (concat-then-linear == sum of two linears, so no concat op needed)
+
+and ReLU between layers.  ``use_norm=True`` swaps the mean aggregator
+for the reference's symmetric GraphNorm form — InDegreeNorm on both
+sides of a SUM aggregation, i.e. D^-1/2 A D^-1/2 (the norm pair around
+AVG would triple-normalize: D^-1/2 D^-1 A D^-1/2).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .builder import AGGR_AVG, AGGR_SUM, Model
+from ..ops.dense import AC_MODE_NONE
+
+
+def build_sage(layers: Sequence[int], dropout_rate: float = 0.5,
+               use_norm: bool = False) -> Model:
+    model = Model(in_dim=layers[0])
+    t = model.input()
+    n = len(layers)
+    for i in range(1, n):
+        t = model.dropout(t, dropout_rate)
+        self_proj = model.linear(t, layers[i], AC_MODE_NONE)
+        neigh = t
+        if use_norm:
+            neigh = model.indegree_norm(neigh)
+            neigh = model.scatter_gather(neigh, aggr=AGGR_SUM)
+            neigh = model.indegree_norm(neigh)
+        else:
+            neigh = model.scatter_gather(neigh, aggr=AGGR_AVG)
+        neigh_proj = model.linear(neigh, layers[i], AC_MODE_NONE)
+        t = model.add(self_proj, neigh_proj)
+        if i != n - 1:
+            t = model.relu(t)
+    model.softmax_cross_entropy(t)
+    return model

@@ -97,6 +97,47 @@ def aggregate_blocked(feats: jax.Array, edge_src: jax.Array,
     return out[:num_rows]
 
 
+def aggregate_ell(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,
+                  num_rows: int,
+                  budget_elems: int = 1 << 24) -> jax.Array:
+    """Degree-bucketed ELLPACK aggregation (see core/ell.py): per width
+    bucket, gather ``feats[idx]`` and sum the width axis; inverse-permute
+    the concatenated bucket outputs back to row order.  No scatter, no
+    per-edge scan — the TPU-native layout for the reference's CSR hot
+    loop (``scattergather_kernel.cu:20-76``).
+
+    feats: [R+1, F] gathered features with trailing zero row.
+    ell_idx: tuple of int32 [rows_b, width_b] arrays (dummy = R).
+    ell_row_pos: int32 [num_rows] output permutation (zero slot = total
+    bucket rows).  Buckets whose gathered block would exceed
+    ``budget_elems`` scalars (R * W * F, i.e. bytes/4 in fp32 — default
+    64 MiB) are processed in row segments with lax.scan to bound the
+    transient.
+    """
+    F = feats.shape[1]
+    outs = []
+    for idx in ell_idx:
+        R, W = idx.shape
+        if R * W * F <= budget_elems:
+            outs.append(feats[idx].sum(axis=1))
+            continue
+        segs = -(-R * W * F // budget_elems)
+        seg_rows = -(-R // segs)
+        Rp = seg_rows * segs
+        pad = jnp.full((Rp - R, W), feats.shape[0] - 1, dtype=idx.dtype)
+        idx_p = jnp.concatenate([idx, pad], axis=0)
+
+        def body(_, ch):
+            return None, feats[ch].sum(axis=1)
+
+        _, segs_out = lax.scan(body, None,
+                               idx_p.reshape(segs, seg_rows, W))
+        outs.append(segs_out.reshape(Rp, F)[:R])
+    zero = jnp.zeros((1, F), dtype=feats.dtype)
+    cat = jnp.concatenate(outs + [zero], axis=0)
+    return cat[ell_row_pos]
+
+
 def aggregate(feats: jax.Array, edge_src: jax.Array, edge_dst: jax.Array,
               num_rows: int, impl: str = "segment",
               chunk: int = 512) -> jax.Array:

@@ -40,6 +40,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..core.ell import ell_from_padded_parts
 from ..core.graph import Dataset, MASK_NONE
 from ..core.partition import PartitionedGraph, partition_graph
 from ..models.builder import GraphContext, Model
@@ -113,10 +114,13 @@ class ShardedData:
     edge_src: jax.Array    # [P, part_edges]      P('parts'), padded coords
     edge_dst: jax.Array    # [P, part_edges]      P('parts'), local rows
     in_degree: jax.Array   # [P, part_nodes]      P('parts')
+    ell_idx: Tuple[jax.Array, ...] = ()   # per bucket [P, rows_b, width_b]
+    ell_row_pos: jax.Array = None         # [P, part_nodes]
 
 
 def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
-                  mesh: Mesh, dtype=jnp.float32) -> ShardedData:
+                  mesh: Mesh, dtype=jnp.float32,
+                  aggr_impl: str = "segment") -> ShardedData:
     sh = NamedSharding(mesh, P("parts"))
     col_padded = remap_to_padded(pg)
     edge_dst = np.stack([
@@ -124,6 +128,14 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   np.diff(pg.part_row_ptr[p]))
         for p in range(pg.num_parts)])
     put = lambda x: jax.device_put(x, sh)
+    ell_idx = ()
+    ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
+    if aggr_impl == "ell":
+        table = ell_from_padded_parts(
+            pg.part_row_ptr, col_padded, pg.real_nodes,
+            pg.part_nodes, dummy=pg.num_parts * pg.part_nodes)
+        ell_idx = tuple(put(a) for a in table.idx)
+        ell_row_pos = put(table.row_pos)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
         labels=put(pad_nodes(dataset.labels, pg)),
@@ -131,6 +143,8 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         edge_src=put(col_padded),
         edge_dst=put(edge_dst),
         in_degree=put(pg.part_in_degree),
+        ell_idx=ell_idx,
+        ell_row_pos=ell_row_pos,
     )
 
 
@@ -150,7 +164,8 @@ class DistributedTrainer:
             dataset.graph, num_parts,
             node_multiple=8, edge_multiple=config.chunk)
         self.data = shard_dataset(dataset, self.pg, self.mesh,
-                                  dtype=config.dtype)
+                                  dtype=config.dtype,
+                                  aggr_impl=config.aggr_impl)
         key = jax.random.PRNGKey(config.seed)
         self.key, init_key = jax.random.split(key)
         repl = NamedSharding(self.mesh, P())
@@ -184,14 +199,16 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, opt_state, feats, labels, mask, edge_src,
-                 edge_dst, in_degree, key, lr):
+                 edge_dst, in_degree, ell_idx, ell_row_pos, key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
                                              in_degree[0])
             gctx = dc_replace(
                 self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
-                in_degree=in_degree)
+                in_degree=in_degree,
+                ell_idx=tuple(a[0] for a in ell_idx),
+                ell_row_pos=ell_row_pos[0])
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
@@ -211,7 +228,7 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_r, spec_r),
+                      spec_p, spec_p, spec_p, spec_p, spec_r, spec_r),
             out_specs=(spec_r, spec_r, spec_r),
             check_vma=False)
         return jax.jit(sm, donate_argnums=(0, 1))
@@ -222,13 +239,15 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, feats, labels, mask, edge_src, edge_dst,
-                 in_degree):
+                 in_degree, ell_idx, ell_row_pos):
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
                                              in_degree[0])
             gctx = dc_replace(
                 self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
-                in_degree=in_degree)
+                in_degree=in_degree,
+                ell_idx=tuple(a[0] for a in ell_idx),
+                ell_row_pos=ell_row_pos[0])
             logits = self.model.apply(params, feats, gctx, key=None,
                                       train=False)
             m = perf_metrics(logits, labels, mask)
@@ -238,7 +257,7 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p),
+                      spec_p, spec_p, spec_p),
             out_specs=spec_r, check_vma=False)
         return jax.jit(sm)
 
@@ -256,7 +275,8 @@ class DistributedTrainer:
             self.key, step_key = jax.random.split(self.key)
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, d.feats, d.labels, d.mask,
-                d.edge_src, d.edge_dst, d.in_degree, step_key, lr)
+                d.edge_src, d.edge_dst, d.in_degree, d.ell_idx,
+                d.ell_row_pos, step_key, lr)
             if epoch % cfg.eval_every == 0:
                 history.append(self._eval(epoch))
                 if cfg.verbose:
@@ -268,7 +288,7 @@ class DistributedTrainer:
         d = self.data
         m = summarize_metrics(jax.device_get(self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
-            d.edge_dst, d.in_degree)))
+            d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos)))
         m["epoch"] = epoch
         return m
 

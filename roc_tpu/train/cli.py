@@ -1,0 +1,156 @@
+"""Command-line driver: the reference's flag surface on the new stack.
+
+Mirrors ``parse_input_args`` (``gnn.cc:114-179``) flag for flag —
+``-lr``, ``-e/-epoch``, ``-dropout/-dr``, ``-decay/-wd``,
+``-decay-rate``, ``-decay-step/-ds``, ``-file``, ``-seed``,
+``-verbose/-v`` and the dash-separated ``-layers 602-256-41`` spec
+(layers[0] = input dim, layers[-1] = classes) — plus the TPU-side knobs
+the Legion low-level flags (``-ll:gpu`` etc.) used to carry: ``--parts``
+(graph partitions = mesh size), ``--model`` (gcn/sage/gin), ``--impl``
+(aggregation backend), ``--dtype``, ``--checkpoint``/``--resume``.
+
+Run: ``python -m roc_tpu.train.cli -file data/reddit -layers 602-256-41
+-lr 0.01 -decay 0.0001 -decay-rate 0.97 -dropout 0.5 -e 3000``
+(cf. ``test.sh:8`` / ``example_run.sh:1``).  Without ``-file`` a
+synthetic dataset is used (smoke-test mode).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="roc_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    # reference flags (gnn.cc:114-179); defaults from gnn.cc:30-41
+    ap.add_argument("-lr", type=float, default=0.01, dest="lr")
+    ap.add_argument("-e", "-epoch", type=int, default=200, dest="epochs")
+    ap.add_argument("-dropout", "-dr", type=float, default=0.5,
+                    dest="dropout")
+    ap.add_argument("-decay", "-wd", type=float, default=0.05,
+                    dest="weight_decay")
+    ap.add_argument("-decay-rate", type=float, default=1.0,
+                    dest="decay_rate")
+    ap.add_argument("-decay-step", "-ds", type=int, default=100,
+                    dest="decay_steps")
+    ap.add_argument("-file", type=str, default=None, dest="file",
+                    help="dataset prefix (<prefix>.lux / .feats.csv / "
+                         ".label / .mask)")
+    ap.add_argument("-layers", type=str, default="16-16-4",
+                    help="dash-separated dims, e.g. 602-256-41")
+    ap.add_argument("-seed", type=int, default=1)
+    ap.add_argument("-verbose", "-v", action="store_true")
+    # TPU-era flags
+    ap.add_argument("--model", choices=["gcn", "sage", "gin"],
+                    default="gcn")
+    ap.add_argument("--parts", type=int, default=1,
+                    help="graph partitions == mesh devices (the "
+                         "reference's numMachines*numGPUs)")
+    ap.add_argument("--impl", default="ell",
+                    choices=["segment", "blocked", "ell"],
+                    help="aggregation backend")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--eval-every", type=int, default=5)
+    ap.add_argument("--checkpoint", type=str, default=None,
+                    help="save params+opt state here after training")
+    ap.add_argument("--checkpoint-every", type=int, default=0,
+                    help="also save every N epochs")
+    ap.add_argument("--resume", type=str, default=None,
+                    help="restore a checkpoint before training")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    ap.add_argument("--profile-dir", type=str, default=None,
+                    help="write a jax.profiler trace of one epoch here")
+    return ap.parse_args(argv)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ..core.graph import load_dataset, synthetic_dataset
+    from ..models.gcn import build_gcn
+    from ..models.sage import build_sage
+    from ..models.gin import build_gin
+    from .trainer import TrainConfig, Trainer
+    from ..parallel.distributed import DistributedTrainer
+    from ..utils.checkpoint import checkpoint_trainer, restore_trainer
+
+    layers = [int(x) for x in args.layers.split("-")]
+    if len(layers) < 2:
+        print("error: -layers needs at least in-dim and classes",
+              file=sys.stderr)
+        return 2
+
+    if args.file:
+        ds = load_dataset(args.file, in_dim=layers[0],
+                          num_classes=layers[-1])
+    else:
+        ds = synthetic_dataset(512, 8, in_dim=layers[0],
+                               num_classes=layers[-1], seed=args.seed)
+    # config echo, like gnn.cc:48-60
+    print(f"# dataset={ds.name} V={ds.graph.num_nodes} "
+          f"E={ds.graph.num_edges} layers={layers} model={args.model} "
+          f"lr={args.lr} wd={args.weight_decay} dropout={args.dropout} "
+          f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
+          f"impl={args.impl}", file=sys.stderr)
+
+    build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin}
+    model = build[args.model](layers, dropout_rate=args.dropout)
+    cfg = TrainConfig(
+        learning_rate=args.lr, weight_decay=args.weight_decay,
+        dropout_rate=args.dropout, decay_rate=args.decay_rate,
+        decay_steps=args.decay_steps, epochs=args.epochs,
+        seed=args.seed, eval_every=args.eval_every, verbose=True,
+        aggr_impl=args.impl,
+        dtype=jnp.float32 if args.dtype == "float32" else jnp.bfloat16)
+
+    if args.parts > 1:
+        trainer = DistributedTrainer(model, ds, args.parts, cfg)
+    else:
+        trainer = Trainer(model, ds, cfg)
+
+    if args.resume:
+        restore_trainer(trainer, args.resume)
+        print(f"# resumed from {args.resume} at epoch {trainer.epoch}",
+              file=sys.stderr)
+
+    if args.profile_dir:
+        trainer.train(epochs=1)  # compile outside the trace
+        with jax.profiler.trace(args.profile_dir):
+            trainer.train(epochs=1)
+        print(f"# profile written to {args.profile_dir}", file=sys.stderr)
+
+    t0 = time.time()
+    remaining = args.epochs - trainer.epoch
+    if args.checkpoint and args.checkpoint_every > 0:
+        while trainer.epoch < args.epochs:
+            n = min(args.checkpoint_every, args.epochs - trainer.epoch)
+            trainer.train(epochs=n)
+            checkpoint_trainer(trainer, args.checkpoint)
+    else:
+        trainer.train(epochs=max(remaining, 0))
+    dt = time.time() - t0
+    if remaining > 0:
+        print(f"# {remaining} epochs in {dt:.1f}s "
+              f"({1000.0 * dt / max(remaining, 1):.1f} ms/epoch)",
+              file=sys.stderr)
+    if args.checkpoint:
+        checkpoint_trainer(trainer, args.checkpoint)
+        print(f"# checkpoint saved to {args.checkpoint}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,6 +65,13 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     dummy source id == num_nodes (the appended zero row)."""
     g = dataset.graph
     edge_src, edge_dst = padded_edge_list(g, multiple=chunk)
+    ell_idx: tuple = ()
+    ell_row_pos = None
+    if aggr_impl == "ell":
+        from ..core.ell import ell_from_graph
+        table = ell_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
+        ell_idx = tuple(jnp.asarray(a[0]) for a in table.idx)
+        ell_row_pos = jnp.asarray(table.row_pos[0])
     return GraphContext(
         edge_src=jnp.asarray(edge_src),
         edge_dst=jnp.asarray(edge_dst),
@@ -74,6 +81,8 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         aggr_impl=aggr_impl,
         chunk=chunk,
         symmetric=resolve_symmetric(dataset, symmetric),
+        ell_idx=ell_idx,
+        ell_row_pos=ell_row_pos,
     )
 
 

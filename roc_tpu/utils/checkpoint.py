@@ -1,0 +1,95 @@
+"""Checkpoint / resume.
+
+The reference has **no** persistence at all (SURVEY §5: weights are never
+saved; the only cache is the feature-CSV binary).  This fills that gap
+with a minimal, dependency-light checkpointer: the params pytree, Adam
+state, epoch counter and PRNG key are flattened to a single ``.npz``
+(atomic rename on save), restored against a template built from the
+model — robust across JAX versions and trivially inspectable.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..train.optimizer import AdamState
+
+
+def _flatten(tree: Any, prefix: str) -> Dict[str, np.ndarray]:
+    out = {}
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    for path, leaf in leaves:
+        key = prefix + jax.tree_util.keystr(path)
+        out[key] = np.asarray(leaf)
+    return out
+
+
+def _unflatten(tree_template: Any, data, prefix: str) -> Any:
+    leaves, treedef = jax.tree_util.tree_flatten(tree_template)
+    paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(
+        tree_template)]
+    new_leaves = []
+    for path, tmpl in zip(paths, leaves):
+        key = prefix + jax.tree_util.keystr(path)
+        arr = data[key]
+        assert arr.shape == tuple(tmpl.shape), (
+            f"checkpoint/model mismatch at {key}: "
+            f"{arr.shape} vs {tmpl.shape}")
+        new_leaves.append(jnp.asarray(arr, dtype=tmpl.dtype))
+    return jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+def save_checkpoint(path: str, params: Any, opt_state: AdamState,
+                    epoch: int, key: Optional[jax.Array] = None) -> None:
+    """Atomically write params + optimizer state + loop counters."""
+    data = _flatten(jax.device_get(params), "params")
+    data.update(_flatten(jax.device_get(opt_state), "opt"))
+    data["__epoch__"] = np.asarray(epoch, dtype=np.int64)
+    if key is not None:
+        data["__key__"] = np.asarray(jax.device_get(key))
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **data)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def load_checkpoint(path: str, params_template: Any,
+                    opt_template: AdamState
+                    ) -> Tuple[Any, AdamState, int, Optional[jax.Array]]:
+    """Restore against templates (e.g. a fresh ``model.init_params`` +
+    ``adam_init``); shapes are validated leaf by leaf."""
+    with np.load(path) as z:
+        data = {k: z[k] for k in z.files}
+    params = _unflatten(params_template, data, "params")
+    opt_state = _unflatten(opt_template, data, "opt")
+    epoch = int(data["__epoch__"])
+    key = jnp.asarray(data["__key__"]) if "__key__" in data else None
+    return params, opt_state, epoch, key
+
+
+def restore_trainer(trainer, path: str) -> None:
+    """Resume a Trainer/DistributedTrainer in place."""
+    params, opt_state, epoch, key = load_checkpoint(
+        path, trainer.params, trainer.opt_state)
+    trainer.params = params
+    trainer.opt_state = opt_state
+    trainer.epoch = epoch
+    if key is not None:
+        trainer.key = key
+
+
+def checkpoint_trainer(trainer, path: str) -> None:
+    save_checkpoint(path, trainer.params, trainer.opt_state,
+                    trainer.epoch, trainer.key)

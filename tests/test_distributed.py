@@ -82,13 +82,15 @@ def test_distributed_blocked_impl(dataset):
     model = build_gcn([dataset.in_dim, 16, dataset.num_classes],
                       dropout_rate=0.0)
     outs = {}
-    for impl in ("segment", "blocked"):
+    for impl in ("segment", "blocked", "ell"):
         cfg = _no_dropout_cfg(aggr_impl=impl, chunk=64)
         t = DistributedTrainer(model, dataset, 4, cfg)
         t.train(epochs=3)
         outs[impl] = t.evaluate()
     np.testing.assert_allclose(outs["segment"]["train_loss"],
                                outs["blocked"]["train_loss"], rtol=1e-3)
+    np.testing.assert_allclose(outs["segment"]["train_loss"],
+                               outs["ell"]["train_loss"], rtol=1e-3)
 
 
 def test_distributed_converges(dataset):

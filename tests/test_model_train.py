@@ -67,11 +67,13 @@ def test_aggr_impl_invariance(dataset):
     params = model.init_params(jax.random.PRNGKey(1))
     feats = jnp.asarray(dataset.features)
     logits = {}
-    for impl in ("segment", "blocked"):
+    for impl in ("segment", "blocked", "ell"):
         gctx = make_graph_context(dataset, aggr_impl=impl, chunk=256)
         logits[impl] = np.asarray(
             model.apply(params, feats, gctx, train=False))
     np.testing.assert_allclose(logits["segment"], logits["blocked"],
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(logits["segment"], logits["ell"],
                                rtol=1e-4, atol=1e-4)
 
 

@@ -162,3 +162,53 @@ def test_perf_metrics_definitions():
     p1 = np.exp(3.0) / (np.exp(0.0) + np.exp(3.0))
     np.testing.assert_allclose(m["train_loss"], (1 - p0) + (1 - p1),
                                rtol=1e-5)
+
+
+def test_aggregate_ell_matches_dense(graph, feats):
+    from roc_tpu.core.ell import ell_from_graph
+    from roc_tpu.ops.aggregate import aggregate_ell
+    A = dense_adjacency(graph)
+    want = A @ feats
+    table = ell_from_graph(graph.row_ptr, graph.col_idx, graph.num_nodes)
+    x = jnp.concatenate([jnp.asarray(feats),
+                         jnp.zeros((1, feats.shape[1]))], axis=0)
+    got = aggregate_ell(x, tuple(jnp.asarray(a[0]) for a in table.idx),
+                        jnp.asarray(table.row_pos[0]), graph.num_nodes)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_aggregate_ell_chunked_budget(graph, feats):
+    """Tiny budget forces the segmented-scan path; results identical."""
+    from roc_tpu.core.ell import ell_from_graph
+    from roc_tpu.ops.aggregate import aggregate_ell
+    table = ell_from_graph(graph.row_ptr, graph.col_idx, graph.num_nodes)
+    x = jnp.concatenate([jnp.asarray(feats),
+                         jnp.zeros((1, feats.shape[1]))], axis=0)
+    idx = tuple(jnp.asarray(a[0]) for a in table.idx)
+    pos = jnp.asarray(table.row_pos[0])
+    a = aggregate_ell(x, idx, pos, graph.num_nodes)
+    b = aggregate_ell(x, idx, pos, graph.num_nodes, budget_elems=256)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_aggregate_ell_hub_node():
+    """A hub row far above the old width clamp must aggregate exactly
+    (regression: widths are unbounded powers of two, never clamped)."""
+    from roc_tpu.core.graph import from_edge_list, add_self_edges
+    from roc_tpu.core.ell import ell_from_graph, _width_of
+    from roc_tpu.ops.aggregate import aggregate_ell
+    assert _width_of(70_000, 8) == 131072
+    V = 300
+    hub_src = np.arange(V, dtype=np.int64)
+    hub_dst = np.zeros(V, dtype=np.int64)
+    g = add_self_edges(from_edge_list(hub_src, hub_dst, V))
+    rng = np.random.RandomState(0)
+    feats = rng.randn(V, 5).astype(np.float32)
+    table = ell_from_graph(g.row_ptr, g.col_idx, V)
+    x = jnp.concatenate([jnp.asarray(feats), jnp.zeros((1, 5))], axis=0)
+    got = aggregate_ell(x, tuple(jnp.asarray(a[0]) for a in table.idx),
+                        jnp.asarray(table.row_pos[0]), V)
+    # row 0 sums every node's features (+ its self edge already counted)
+    np.testing.assert_allclose(np.asarray(got)[0], feats.sum(axis=0),
+                               rtol=1e-4, atol=1e-4)
