@@ -74,9 +74,21 @@ class GraphContext:
     # arrays + [num_rows] output permutation (core/ell.py)
     ell_idx: Tuple[jax.Array, ...] = ()
     ell_row_pos: Optional[jax.Array] = None
+    # halo exchange mode: "gather" = one-shot all_gather of the full
+    # feature matrix (the reference's whole-region requirement);
+    # "ring" = ppermute rotation overlapping per-shard aggregation
+    # (parallel/ring.py) — O(V/P * F) peak memory instead of O(V * F)
+    halo: str = "gather"
+    ring_idx: Tuple[jax.Array, ...] = ()     # [S, rows_b, width_b] each
+    ring_row_pos: Optional[jax.Array] = None  # [S, num_rows]
+    axis_name: str = "parts"
 
     def _sum_fwd(self, x: jax.Array) -> jax.Array:
         """Halo exchange + local CSR sum: ``out = A_p @ gather(x)``."""
+        if self.halo == "ring":
+            from ..parallel.ring import ring_aggregate
+            return ring_aggregate(x, self.ring_idx, self.ring_row_pos,
+                                  axis_name=self.axis_name)
         full = self.gather_features(x)
         # append the dummy zero source row that padding edges point at
         zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
@@ -127,6 +139,10 @@ class GraphContext:
     def _max_fwd(self, x: jax.Array) -> jax.Array:
         """Neighbor max; rows with no neighbors yield 0.  Dummy/padding
         sources are masked out (their zero rows must not win the max)."""
+        if self.halo == "ring":
+            raise NotImplementedError(
+                "AGGR_MAX is not supported with halo='ring' (the ring "
+                "accumulator is additive); use halo='gather'")
         full = self.gather_features(x)
         zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
         full = jnp.concatenate([full, zero], axis=0)

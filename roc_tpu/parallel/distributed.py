@@ -116,11 +116,14 @@ class ShardedData:
     in_degree: jax.Array   # [P, part_nodes]      P('parts')
     ell_idx: Tuple[jax.Array, ...] = ()   # per bucket [P, rows_b, width_b]
     ell_row_pos: jax.Array = None         # [P, part_nodes]
+    ring_idx: Tuple[jax.Array, ...] = ()  # per bucket [P, S, rows_b, width_b]
+    ring_row_pos: jax.Array = None        # [P, S, part_nodes]
 
 
 def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   mesh: Mesh, dtype=jnp.float32,
-                  aggr_impl: str = "segment") -> ShardedData:
+                  aggr_impl: str = "segment",
+                  halo: str = "gather") -> ShardedData:
     sh = NamedSharding(mesh, P("parts"))
     col_padded = remap_to_padded(pg)
     edge_dst = np.stack([
@@ -130,12 +133,21 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     put = lambda x: jax.device_put(x, sh)
     ell_idx = ()
     ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
-    if aggr_impl == "ell":
+    if aggr_impl == "ell" and halo != "ring":
+        # ring mode has its own per-shard tables; the gather-mode ELL
+        # arrays would be dead weight (a second O(E) copy on device)
         table = ell_from_padded_parts(
             pg.part_row_ptr, col_padded, pg.real_nodes,
             pg.part_nodes, dummy=pg.num_parts * pg.part_nodes)
         ell_idx = tuple(put(a) for a in table.idx)
         ell_row_pos = put(table.row_pos)
+    ring_idx = ()
+    ring_row_pos = put(np.zeros((pg.num_parts, 1, 1), dtype=np.int32))
+    if halo == "ring":
+        from .ring import build_ring_tables
+        rt = build_ring_tables(pg)
+        ring_idx = tuple(put(a) for a in rt.idx)
+        ring_row_pos = put(rt.row_pos)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
         labels=put(pad_nodes(dataset.labels, pg)),
@@ -145,6 +157,8 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         in_degree=put(pg.part_in_degree),
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
+        ring_idx=ring_idx,
+        ring_row_pos=ring_row_pos,
     )
 
 
@@ -165,7 +179,8 @@ class DistributedTrainer:
             node_multiple=8, edge_multiple=config.chunk)
         self.data = shard_dataset(dataset, self.pg, self.mesh,
                                   dtype=config.dtype,
-                                  aggr_impl=config.aggr_impl)
+                                  aggr_impl=config.aggr_impl,
+                                  halo=config.halo)
         key = jax.random.PRNGKey(config.seed)
         self.key, init_key = jax.random.split(key)
         repl = NamedSharding(self.mesh, P())
@@ -191,6 +206,7 @@ class DistributedTrainer:
             aggr_impl=self.config.aggr_impl,
             chunk=self.config.chunk,
             symmetric=self.symmetric,
+            halo=self.config.halo,
         )
 
     def _build_train_step(self):
@@ -199,7 +215,8 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, opt_state, feats, labels, mask, edge_src,
-                 edge_dst, in_degree, ell_idx, ell_row_pos, key, lr):
+                 edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
+                 ring_row_pos, key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
@@ -208,7 +225,9 @@ class DistributedTrainer:
                 self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
-                ell_row_pos=ell_row_pos[0])
+                ell_row_pos=ell_row_pos[0],
+                ring_idx=tuple(a[0] for a in ring_idx),
+                ring_row_pos=ring_row_pos[0])
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
@@ -228,7 +247,8 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_r, spec_r),
+                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
+                      spec_r, spec_r),
             out_specs=(spec_r, spec_r, spec_r),
             check_vma=False)
         return jax.jit(sm, donate_argnums=(0, 1))
@@ -239,7 +259,8 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, feats, labels, mask, edge_src, edge_dst,
-                 in_degree, ell_idx, ell_row_pos):
+                 in_degree, ell_idx, ell_row_pos, ring_idx,
+                 ring_row_pos):
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
                                              in_degree[0])
@@ -247,7 +268,9 @@ class DistributedTrainer:
                 self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
-                ell_row_pos=ell_row_pos[0])
+                ell_row_pos=ell_row_pos[0],
+                ring_idx=tuple(a[0] for a in ring_idx),
+                ring_row_pos=ring_row_pos[0])
             logits = self.model.apply(params, feats, gctx, key=None,
                                       train=False)
             m = perf_metrics(logits, labels, mask)
@@ -257,7 +280,7 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p),
+                      spec_p, spec_p, spec_p, spec_p, spec_p),
             out_specs=spec_r, check_vma=False)
         return jax.jit(sm)
 
@@ -276,7 +299,7 @@ class DistributedTrainer:
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, d.feats, d.labels, d.mask,
                 d.edge_src, d.edge_dst, d.in_degree, d.ell_idx,
-                d.ell_row_pos, step_key, lr)
+                d.ell_row_pos, d.ring_idx, d.ring_row_pos, step_key, lr)
             if epoch % cfg.eval_every == 0:
                 history.append(self._eval(epoch))
                 if cfg.verbose:
@@ -288,7 +311,8 @@ class DistributedTrainer:
         d = self.data
         m = summarize_metrics(jax.device_get(self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
-            d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos)))
+            d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
+            d.ring_idx, d.ring_row_pos)))
         m["epoch"] = epoch
         return m
 

@@ -1,0 +1,121 @@
+"""Ring halo exchange: blocked all-gather overlapped with aggregation.
+
+The reference materializes the WHOLE node-feature region on every GPU
+for each aggregation (``scattergather.cc:70-72``; explicitly
+``ncclAllGather`` in the vestigial ``gnn_kernel.cu:65-78``), which caps
+graph size at one device's memory.  SURVEY §7 flags the TPU fix: a ring
+schedule that never holds more than one shard's features at a time.
+
+Mechanism (the ring-attention communication shape, with CSR aggregation
+as the local op): each device keeps a rotating buffer of one shard's
+features.  At ring step k, device p holds shard ``(p - k) mod P``; it
+aggregates the local edges whose *sources* live in that shard (a
+per-source-shard ELL table built at partition time) into its running
+output, while ``lax.ppermute`` rotates the buffer one hop around the ICI
+ring.  After P steps every edge has been applied exactly once and peak
+memory is O(V/P · F) instead of O(V · F).
+
+The per-(partition, source-shard) edge groups are stored as stacked ELL
+tables with uniform shapes across all pairs (SPMD requires identical
+per-device shapes); padding cost is bounded by the densest pair, which
+is modest for edge-balanced partitions of real graphs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.ell import EllTable, build_ell, stack_ell
+from ..core.partition import PartitionedGraph
+from ..ops.aggregate import aggregate_ell
+
+
+@dataclass
+class RingTables:
+    """Stacked per-(partition, source-shard) ELL tables.
+
+    idx: per width bucket, int32 [P, S, rows_b, width_b]; source ids are
+      *local to the source shard* (dummy = part_nodes, the zero row
+      appended to the rotating buffer).
+    row_pos: int32 [P, S, part_nodes].
+    """
+
+    widths: Tuple[int, ...]
+    idx: Tuple[np.ndarray, ...]
+    row_pos: np.ndarray
+
+
+def build_ring_tables(pg: PartitionedGraph,
+                      min_width: int = 4) -> RingTables:
+    """Split each partition's local CSR by source shard and build the
+    uniform stacked ELL tables the ring step indexes by shard."""
+    P = pg.num_parts
+    offsets = np.asarray([l for l, _ in pg.bounds] + [pg.num_nodes],
+                         dtype=np.int64)
+    starts = np.minimum(offsets[:P], pg.num_nodes)
+    per_pair: List[dict] = []
+    for p in range(P):
+        n = int(pg.real_nodes[p])
+        ptr = pg.part_row_ptr[p, :n + 1].astype(np.int64)
+        col = pg.part_col_idx[p]  # global src ids; padding == num_nodes
+        dst = np.repeat(np.arange(n, dtype=np.int64), np.diff(ptr))
+        col_real = col[:int(ptr[n])].astype(np.int64)
+        # source shard of each edge
+        src_shard = np.searchsorted(offsets[1:P + 1], col_real,
+                                    side="right")
+        for s in range(P):
+            sel = src_shard == s
+            d, c = dst[sel], col_real[sel] - starts[s]
+            # rebuild a local CSR over (d, c); d is already sorted
+            counts = np.bincount(d, minlength=n)
+            ptr_s = np.zeros(n + 1, dtype=np.int64)
+            np.cumsum(counts, out=ptr_s[1:])
+            per_pair.append(build_ell(ptr_s, c.astype(np.int32),
+                                      min_width=min_width))
+    table = stack_ell(per_pair, pg.part_nodes, dummy=pg.part_nodes)
+    idx = tuple(a.reshape(P, P, *a.shape[1:]) for a in table.idx)
+    row_pos = table.row_pos.reshape(P, P, pg.part_nodes)
+    return RingTables(widths=table.widths, idx=idx, row_pos=row_pos)
+
+
+def ring_aggregate(x: jax.Array, ring_idx, ring_row_pos: jax.Array,
+                   axis_name: str = "parts") -> jax.Array:
+    """SPMD ring aggregation (call inside shard_map).
+
+    x: [part_nodes, F] this device's shard.
+    ring_idx: tuple of int32 [S, rows_b, width_b] (this device's slice).
+    ring_row_pos: int32 [S, part_nodes].
+    Returns [part_nodes, F] = sum aggregation over ALL global edges whose
+    destination is local.
+    """
+    P = ring_row_pos.shape[0]
+    n, F = x.shape
+    me = lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % P) for i in range(P)]
+
+    def step(k, carry):
+        buf, out = carry
+        src_shard = jax.numpy.mod(me - k, P)
+        idx_k = tuple(
+            lax.dynamic_index_in_dim(a, src_shard, axis=0, keepdims=False)
+            for a in ring_idx)
+        pos_k = lax.dynamic_index_in_dim(ring_row_pos, src_shard, axis=0,
+                                         keepdims=False)
+        buf_ext = jnp.concatenate(
+            [buf, jnp.zeros((1, F), dtype=buf.dtype)], axis=0)
+        out = out + aggregate_ell(buf_ext, idx_k, pos_k, n)
+        # rotate for the next step (skipped work on the last step is
+        # harmless; keeping it unconditional lets XLA overlap the
+        # permute with this step's aggregation)
+        buf = lax.ppermute(buf, axis_name, perm)
+        return buf, out
+
+    out0 = jnp.zeros((n, F), dtype=x.dtype)
+    _, out = lax.fori_loop(0, P, step, (x, out0))
+    return out

@@ -56,6 +56,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("--impl", default="ell",
                     choices=["segment", "blocked", "ell"],
                     help="aggregation backend")
+    ap.add_argument("--halo", default="gather",
+                    choices=["gather", "ring"],
+                    help="distributed halo exchange: one-shot "
+                         "all_gather or ppermute ring (O(V/P) memory)")
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
     ap.add_argument("--eval-every", type=int, default=5)
@@ -113,12 +117,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         dropout_rate=args.dropout, decay_rate=args.decay_rate,
         decay_steps=args.decay_steps, epochs=args.epochs,
         seed=args.seed, eval_every=args.eval_every, verbose=True,
-        aggr_impl=args.impl,
+        aggr_impl=args.impl, halo=args.halo,
         dtype=jnp.float32 if args.dtype == "float32" else jnp.bfloat16)
 
     if args.parts > 1:
         trainer = DistributedTrainer(model, ds, args.parts, cfg)
     else:
+        if args.halo == "ring":
+            print("error: --halo ring requires --parts > 1 (the ring "
+                  "rotates shards over a device mesh)", file=sys.stderr)
+            return 2
         trainer = Trainer(model, ds, cfg)
 
     if args.resume:

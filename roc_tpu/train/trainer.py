@@ -42,6 +42,10 @@ class TrainConfig:
     aggr_impl: str = "segment"   # "segment" | "blocked" | "pallas"
     chunk: int = 512
     dtype: Any = jnp.float32
+    # Halo exchange for the distributed step: "gather" (one-shot
+    # all_gather, the reference's whole-region semantics) or "ring"
+    # (ppermute rotation, O(V/P) peak memory; parallel/ring.py)
+    halo: str = "gather"
     # Symmetric-adjacency assumption for the aggregation backward (the
     # reference requires it, scattergather_kernel.cu:160-170).
     # None = verify host-side at setup (O(E log E)); True = trust the

@@ -103,3 +103,36 @@ def test_distributed_converges(dataset):
     m = t.evaluate()
     assert m["train_acc"] > 0.9
     assert m["test_acc"] > 0.6
+
+
+@pytest.mark.parametrize("num_parts", [2, 4, 8])
+def test_ring_halo_matches_gather(dataset, num_parts):
+    """halo='ring' (ppermute rotation, O(V/P) memory) must reproduce the
+    one-shot all_gather numerics exactly."""
+    model = build_gcn([dataset.in_dim, 16, dataset.num_classes],
+                      dropout_rate=0.0)
+    res = {}
+    for halo in ("gather", "ring"):
+        cfg = _no_dropout_cfg(halo=halo)
+        t = DistributedTrainer(model, dataset, num_parts, cfg)
+        t.train(epochs=5)
+        res[halo] = t
+    for k in res["gather"].params:
+        np.testing.assert_allclose(
+            np.asarray(res["gather"].params[k]),
+            np.asarray(res["ring"].params[k]), rtol=2e-4, atol=2e-5)
+    m_g, m_r = res["gather"].evaluate(), res["ring"].evaluate()
+    np.testing.assert_allclose(m_g["train_loss"], m_r["train_loss"],
+                               rtol=1e-3)
+
+
+def test_ring_tables_cover_all_edges(dataset):
+    from roc_tpu.core.partition import partition_graph
+    from roc_tpu.parallel.ring import build_ring_tables
+    pg = partition_graph(dataset.graph, 4, node_multiple=8)
+    rt = build_ring_tables(pg)
+    # count real (non-dummy) entries across all tables == num edges
+    total = 0
+    for a in rt.idx:
+        total += int((a != pg.part_nodes).sum())
+    assert total == dataset.graph.num_edges
