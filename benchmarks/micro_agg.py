@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Micro-benchmark: neighbor-aggregation implementations at Reddit scale.
+
+The reference's hot loop (``scattergather_kernel.cu:20-76``) is an
+O(E * F) irregular CSR sum; this script times our implementations of the
+same op on one chip to pick the framework default.
+
+Usage: python benchmarks/micro_agg.py [--nodes N] [--edges E] [--dim F]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bench(fn, iters=10):
+    """Median wall ms.  Synchronizes by fetching a scalar reduction of
+    the output — ``block_until_ready`` does not reliably synchronize
+    under the axon tunnel platform, so device->host fetch is the only
+    trustworthy barrier (its ~constant overhead is reported separately
+    by --calibrate)."""
+    import jax.numpy as jnp
+    out = fn()
+    float(jnp.sum(out))
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn()
+        float(jnp.sum(out))
+        times.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(times))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=232_965)
+    ap.add_argument("--edges", type=int, default=114_848_857)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--dtype", type=str, default="float32")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--impls", type=str,
+                    default="blocked:512,blocked:1024,scan:1024,"
+                            "scan:2048,scan:4096,ell")
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from roc_tpu.core.graph import random_csr
+    from roc_tpu.core.partition import padded_edge_list
+    from roc_tpu.ops.aggregate import aggregate, aggregate_ell
+
+    V, E, F = args.nodes, args.edges, args.dim
+    dev = jax.devices()[0]
+    print(f"# device={dev.platform} {dev.device_kind} V={V} E={E} F={F}")
+    # fetch-overhead calibration: trivial computation + same sync path
+    z = jnp.zeros((1024, F))
+    f0 = jax.jit(lambda x: x + 1.0)
+    print(f"# sync overhead ~{bench(lambda: f0(z), args.iters):.1f} ms "
+          f"(subtract from rows below)")
+    g = random_csr(V, E, seed=0)
+    dtype = getattr(jnp, args.dtype)
+    feats_np = np.random.RandomState(0).rand(V + 1, F).astype(np.float32)
+    feats_np[-1] = 0
+    feats = jnp.asarray(feats_np, dtype=dtype)
+    gb = E * F * feats.dtype.itemsize / 1e9
+
+    for spec in args.impls.split(","):
+        if ":" in spec:
+            impl, chunk = spec.split(":")
+            chunk = int(chunk)
+        else:
+            impl, chunk = spec, 1024
+        if impl == "ell":
+            from roc_tpu.core.ell import build_ell
+            t0 = time.time()
+            ell = build_ell(g)
+            prep = time.time() - t0
+            idx = tuple(jnp.asarray(i) for i in ell.idx)
+            pos = jnp.asarray(ell.row_pos)
+            f = jax.jit(lambda x: aggregate_ell(x, idx, pos, V))
+            ms = bench(lambda: f(feats), args.iters)
+            print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
+                  f"(prep {prep:.1f}s)")
+            continue
+        src, dst = padded_edge_list(g, multiple=chunk)
+        srcj, dstj = jnp.asarray(src), jnp.asarray(dst)
+        f = jax.jit(lambda x, s=srcj, d=dstj, i=impl, c=chunk:
+                    aggregate(x, s, d, V, impl=i, chunk=c))
+        try:
+            ms = bench(lambda: f(feats), args.iters)
+            print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s")
+        except Exception as e:  # noqa: BLE001 - report and continue
+            print(f"{spec:16s} FAILED: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

@@ -63,15 +63,21 @@ class EllTable:
                         row_pos=self.row_pos[p:p + 1])
 
 
-def _width_of(deg: int, min_width: int) -> int:
-    """Smallest power-of-two >= deg (floored at min_width).  Widths are
-    unbounded: a hub row of any degree gets its own wide bucket (the
-    aggregation kernel scan-chunks large buckets, so memory stays
-    bounded) — clamping would silently drop edges."""
-    w = min_width
-    while w < deg:
-        w *= 2
-    return w
+def row_widths(deg: np.ndarray, min_width: int) -> np.ndarray:
+    """Per-row bucket width: smallest power-of-two >= degree (floored at
+    ``min_width``); 0 for empty rows.  Widths are unbounded: a hub row
+    of any degree gets its own wide bucket (the aggregation kernel
+    scan-chunks large buckets, so memory stays bounded) — clamping
+    would silently drop edges.  Fully vectorized (exact integer
+    comparisons via a power table, no float log2)."""
+    deg = np.asarray(deg)
+    max_d = int(deg.max()) if deg.size else 1
+    powers = [min_width]
+    while powers[-1] < max_d:
+        powers.append(powers[-1] * 2)
+    powers = np.array(powers, dtype=np.int64)
+    w = powers[np.searchsorted(powers, deg, side="left")]
+    return np.where(deg > 0, w, 0).astype(np.int64)
 
 
 def build_ell(local_row_ptr: np.ndarray, col_idx: np.ndarray,
@@ -80,19 +86,23 @@ def build_ell(local_row_ptr: np.ndarray, col_idx: np.ndarray,
 
     local_row_ptr: int [n+1] offsets into ``col_idx`` (callers pass the
     *real* row count so padding rows/edges are excluded).  Returns
-    {width: [(row_id, srcs), ...]} as an intermediate for
-    :func:`stack_ell`.
+    ``{width: (rows, idx)}`` with ``rows`` int64 [R_w] row ids and
+    ``idx`` int32 [R_w, w] source indices (-1 padding to be replaced by
+    the dummy id at stack time).  Vectorized — no per-row Python.
     """
-    n = local_row_ptr.shape[0] - 1
-    deg = np.diff(local_row_ptr)
+    row_ptr = np.asarray(local_row_ptr, dtype=np.int64)
+    deg = np.diff(row_ptr)
+    widths = row_widths(deg, min_width)
     buckets: dict = {}
-    for v in range(n):
-        d = int(deg[v])
-        if d == 0:
-            continue
-        w = _width_of(d, min_width)
-        buckets.setdefault(w, []).append(
-            (v, col_idx[local_row_ptr[v]:local_row_ptr[v + 1]]))
+    for w in np.unique(widths[widths > 0]):
+        w = int(w)
+        rows = np.flatnonzero(widths == w)
+        grid = np.arange(w, dtype=np.int64)[None, :]         # [1, w]
+        valid = grid < deg[rows][:, None]                     # [R, w]
+        flat = row_ptr[rows][:, None] + grid                  # [R, w]
+        idx = np.full((rows.shape[0], w), -1, dtype=np.int32)
+        idx[valid] = col_idx[flat[valid]]
+        buckets[w] = (rows, idx)
     return buckets
 
 
@@ -105,7 +115,8 @@ def stack_ell(per_part_buckets: Sequence[dict], part_nodes: int,
     if not widths:
         widths = [8]
     rows_per_width = {
-        w: max((len(b.get(w, ())) for b in per_part_buckets), default=0)
+        w: max((b[w][0].shape[0] if w in b else 0
+                for b in per_part_buckets), default=0)
         for w in widths}
     # drop empty widths, keep at least one so shapes exist
     widths = [w for w in widths if rows_per_width[w] > 0] or [widths[0]]
@@ -124,9 +135,12 @@ def stack_ell(per_part_buckets: Sequence[dict], part_nodes: int,
         offset = 0
         for wi, w in enumerate(widths):
             R = max(rows_per_width[w], 1)
-            for slot, (v, srcs) in enumerate(b.get(w, ())):
-                idx_arrays[wi][p, slot, :len(srcs)] = srcs
-                row_pos[p, v] = offset + slot
+            if w in b:
+                rows, idx = b[w]
+                n = rows.shape[0]
+                block = idx_arrays[wi][p]
+                block[:n] = np.where(idx >= 0, idx, dummy)
+                row_pos[p, rows] = offset + np.arange(n, dtype=np.int32)
             offset += R
     return EllTable(widths=tuple(widths), idx=tuple(idx_arrays),
                     row_pos=row_pos)

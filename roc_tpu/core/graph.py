@@ -131,7 +131,14 @@ def check_symmetric(graph: Graph) -> bool:
 def load_lux(path: str) -> Graph:
     """Read a `.lux` binary graph (reference format, ``gnn.cc:756-801``):
     u32 num_nodes, u64 num_edges, num_nodes x u64 inclusive-end row
-    offsets, num_edges x u32 source ids."""
+    offsets, num_edges x u32 source ids.
+
+    Uses the native C++ reader (native/rocio.cc) when built; numpy
+    fallback otherwise."""
+    from .. import native
+    if native.available():
+        row_ptr, col_idx = native.load_lux(path)
+        return Graph(row_ptr=row_ptr, col_idx=col_idx)
     with open(path, "rb") as f:
         header = f.read(12)
         num_nodes, num_edges = struct.unpack("<IQ", header)
@@ -159,6 +166,11 @@ def add_self_edges(graph: Graph) -> Graph:
     """Ensure every vertex has a self edge (the `.add_self_edge.lux`
     preprocessing the reference assumes was done offline, ``gnn.cc:756``).
     Existing self edges are kept; missing ones are inserted."""
+    from .. import native
+    if native.available():
+        row_ptr, col_idx = native.add_self_edges(graph.row_ptr,
+                                                 graph.col_idx)
+        return Graph(row_ptr=row_ptr, col_idx=col_idx)
     V = graph.num_nodes
     dst = graph.edge_dst()
     has_self = np.zeros(V, dtype=bool)
@@ -205,6 +217,7 @@ def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
     """Load ``<prefix>.feats.csv`` (one comma-separated row per vertex),
     caching a ``.feats.bin`` float32 binary alongside exactly like
     ``load_task.cu:41-73``.  Returns float32 ``[num_nodes, in_dim]``."""
+    from .. import native
     bin_path = prefix + ".feats.bin"
     csv_path = prefix + ".feats.csv"
     if os.path.exists(bin_path):
@@ -212,8 +225,11 @@ def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
                            count=num_nodes * in_dim)
         assert data.size == num_nodes * in_dim, "truncated .feats.bin"
         return data.reshape(num_nodes, in_dim)
-    data = np.loadtxt(csv_path, delimiter=",", dtype=np.float32)
-    data = data.reshape(num_nodes, in_dim)
+    if native.available():
+        data = native.load_features_csv(csv_path, num_nodes, in_dim)
+    else:
+        data = np.loadtxt(csv_path, delimiter=",", dtype=np.float32)
+        data = data.reshape(num_nodes, in_dim)
     data.tofile(bin_path)
     return data
 
@@ -232,6 +248,9 @@ def load_mask(prefix: str, num_nodes: int) -> np.ndarray:
     """Load ``<prefix>.mask`` ("Train"/"Val"/"Test"/"None" per line,
     ``load_task.cu:169-183``).  Returns int32 ``[num_nodes]`` with
     MASK_* values."""
+    from .. import native
+    if native.available():
+        return native.load_mask(prefix + ".mask", num_nodes)
     out = np.empty(num_nodes, dtype=np.int32)
     with open(prefix + ".mask") as f:
         for v in range(num_nodes):

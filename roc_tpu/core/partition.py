@@ -35,8 +35,12 @@ def edge_balanced_bounds(row_ptr: np.ndarray, num_parts: int
     """Greedy edge-balanced split into ``num_parts`` contiguous inclusive
     vertex ranges ``[left, right]`` (reference ``gnn.cc:806-829``).
     Ranges may be empty (``left > right``) only in the padded tail."""
+    from .. import native
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     num_nodes = row_ptr.shape[0] - 1
+    if native.available():
+        return [tuple(b) for b in
+                native.edge_balanced_bounds(row_ptr, num_parts)]
     num_edges = int(row_ptr[-1])
     cap = (num_edges + num_parts - 1) // num_parts
     bounds: List[Tuple[int, int]] = []

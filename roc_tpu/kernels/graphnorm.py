@@ -1,0 +1,60 @@
+"""Pallas TPU kernel for in-degree normalization (GraphNorm).
+
+Reference: ``graphnorm_kernel.cu:45-55`` computes
+``out[v, :] = in[v, :] / sqrt(indegree(v))`` from CSR row pointers;
+applied before and after the neighbor sum it yields the symmetric GCN
+normalization ``D^-1/2 A D^-1/2``.  The op is its own linear transpose,
+so the reference reuses the forward kernel in backward
+(``graphnorm_kernel.cu:127-136``) — here that falls out of autodiff
+since the op is a broadcast multiply by a constant vector.
+
+On TPU the degrees are static per graph, so the kernel is a tiled
+broadcast scale: rows stream through VMEM in (block, lane-aligned)
+tiles, ``rsqrt`` runs on the VPU.  Zero-degree (padding) rows pass
+through unscaled (``max(deg, 1)`` — matching
+:func:`roc_tpu.ops.norm.indegree_norm`).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _norm_kernel(deg_ref, x_ref, out_ref):
+    deg = jnp.maximum(deg_ref[:].astype(jnp.float32), 1.0)  # [B, 1]
+    scale = jax.lax.rsqrt(deg)
+    out_ref[:] = (x_ref[:].astype(jnp.float32) * scale).astype(
+        out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("block",))
+def indegree_norm_pallas(x: jax.Array, in_degree: jax.Array,
+                         block: int = 1024) -> jax.Array:
+    """``x * rsqrt(max(in_degree, 1))[:, None]`` with rows tiled through
+    VMEM.  ``x``: [V, F]; ``in_degree``: int32 [V]."""
+    V, F = x.shape
+    B = min(block, V)
+    Vp = pl.cdiv(V, B) * B
+    if Vp != V:
+        x = jnp.pad(x, ((0, Vp - V), (0, 0)))
+        in_degree = jnp.pad(in_degree, (0, Vp - V))
+    deg2d = in_degree.reshape(Vp, 1)
+    out = pl.pallas_call(
+        _norm_kernel,
+        grid=(Vp // B,),
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, F), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((B, F), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Vp, F), x.dtype),
+    )(deg2d, x)
+    return out[:V]

@@ -1,0 +1,170 @@
+"""ctypes bindings for the native host-side data layer (librocio.so).
+
+The reference implements its entire data path in C++ host code inside
+CUDA task bodies (``load_task.cu``, ``gnn.cc:751-872``); here the same
+components live in ``native/rocio.cc`` behind a C ABI, loaded lazily
+via ctypes.  Every entry point has a pure-numpy fallback in
+``roc_tpu.core`` — the native library is a performance path, not a hard
+dependency, so ``available()`` gates all call sites.
+
+The library is built with ``make -C native`` (attempted automatically
+on first use if the toolchain is present).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.environ.get(
+    "ROC_TPU_NATIVE", os.path.join(_NATIVE_DIR, "librocio.so"))
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        makefile = os.path.join(_NATIVE_DIR, "Makefile")
+        if os.path.exists(makefile):
+            try:
+                subprocess.run(["make", "-C", _NATIVE_DIR],
+                               capture_output=True, timeout=120,
+                               check=False)
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.roc_lux_header.restype = ctypes.c_int
+    lib.roc_lux_read.restype = ctypes.c_int
+    lib.roc_lux_write.restype = ctypes.c_int
+    lib.roc_load_features_csv.restype = ctypes.c_int
+    lib.roc_load_mask.restype = ctypes.c_int
+    lib.roc_edge_balanced_bounds.restype = ctypes.c_int
+    lib.roc_add_self_edges.restype = ctypes.c_int64
+    lib.roc_ell_widths.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def load_lux(path: str) -> Tuple[np.ndarray, np.ndarray]:
+    """(row_ptr int64 [V+1], col_idx int32 [E]) from a .lux file."""
+    lib = _load()
+    assert lib is not None
+    nn = ctypes.c_uint32()
+    ne = ctypes.c_uint64()
+    rc = lib.roc_lux_header(path.encode(), ctypes.byref(nn),
+                            ctypes.byref(ne))
+    if rc != 0:
+        raise IOError(f"roc_lux_header({path}) failed: {rc}")
+    V, E = int(nn.value), int(ne.value)
+    row_ptr = np.empty(V + 1, dtype=np.int64)
+    col_idx = np.empty(E, dtype=np.int32)
+    rc = lib.roc_lux_read(path.encode(), V, E, _i64p(row_ptr),
+                          _i32p(col_idx))
+    if rc != 0:
+        raise IOError(f"roc_lux_read({path}) failed: {rc}")
+    return row_ptr, col_idx
+
+
+def save_lux(path: str, row_ptr: np.ndarray, col_idx: np.ndarray) -> None:
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
+    rc = lib.roc_lux_write(path.encode(), row_ptr.shape[0] - 1,
+                           col_idx.shape[0], _i64p(row_ptr),
+                           _i32p(col_idx))
+    if rc != 0:
+        raise IOError(f"roc_lux_write({path}) failed: {rc}")
+
+
+def load_features_csv(path: str, rows: int, cols: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    out = np.empty((rows, cols), dtype=np.float32)
+    rc = lib.roc_load_features_csv(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        rows, cols)
+    if rc != 0:
+        raise IOError(f"roc_load_features_csv({path}) failed: {rc}")
+    return out
+
+
+def load_mask(path: str, n: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    out = np.empty(n, dtype=np.int32)
+    rc = lib.roc_load_mask(path.encode(), _i32p(out), n)
+    if rc != 0:
+        raise IOError(f"roc_load_mask({path}) failed: {rc}")
+    return out
+
+
+def edge_balanced_bounds(row_ptr: np.ndarray, num_parts: int) -> np.ndarray:
+    """int64 [num_parts, 2] inclusive [left, right] ranges."""
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    bounds = np.empty((num_parts, 2), dtype=np.int64)
+    rc = lib.roc_edge_balanced_bounds(
+        _i64p(row_ptr), row_ptr.shape[0] - 1, num_parts, _i64p(bounds))
+    if rc != 0:
+        raise ValueError(f"roc_edge_balanced_bounds failed: {rc}")
+    return bounds
+
+
+def add_self_edges(row_ptr: np.ndarray, col_idx: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
+    V = row_ptr.shape[0] - 1
+    cap = col_idx.shape[0] + V
+    new_ptr = np.empty(V + 1, dtype=np.int64)
+    new_col = np.empty(cap, dtype=np.int32)
+    rc = lib.roc_add_self_edges(_i64p(row_ptr), _i32p(col_idx), V,
+                                _i64p(new_ptr), _i32p(new_col), cap)
+    if rc < 0:
+        raise ValueError(f"roc_add_self_edges failed: {rc}")
+    return new_ptr, new_col[: col_idx.shape[0] + int(rc)].copy()
+
+
+def ell_widths(row_ptr: np.ndarray, min_width: int = 8) -> np.ndarray:
+    """Per-row power-of-two ELL bucket width (0 for empty rows)."""
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    n = row_ptr.shape[0] - 1
+    out = np.empty(n, dtype=np.int32)
+    rc = lib.roc_ell_widths(_i64p(row_ptr), n, min_width, _i32p(out))
+    if rc != 0:
+        raise ValueError(f"roc_ell_widths failed: {rc}")
+    return out

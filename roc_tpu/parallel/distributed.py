@@ -148,6 +148,10 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         rt = build_ring_tables(pg)
         ring_idx = tuple(put(a) for a in rt.idx)
         ring_row_pos = put(rt.row_pos)
+        # the per-edge arrays are equally dead weight in ring mode
+        # (ring tables fully describe the aggregation); upload stubs
+        col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
+        edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
         labels=put(pad_nodes(dataset.labels, pg)),

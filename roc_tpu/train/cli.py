@@ -54,7 +54,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="graph partitions == mesh devices (the "
                          "reference's numMachines*numGPUs)")
     ap.add_argument("--impl", default="ell",
-                    choices=["segment", "blocked", "ell"],
+                    choices=["segment", "blocked", "scan", "ell", "pallas"],
                     help="aggregation backend")
     ap.add_argument("--halo", default="gather",
                     choices=["gather", "ring"],

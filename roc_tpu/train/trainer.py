@@ -39,7 +39,7 @@ class TrainConfig:
     seed: int = 1
     eval_every: int = 5
     verbose: bool = True
-    aggr_impl: str = "segment"   # "segment" | "blocked" | "pallas"
+    aggr_impl: str = "segment"   # segment|blocked|scan|ell|pallas
     chunk: int = 512
     dtype: Any = jnp.float32
     # Halo exchange for the distributed step: "gather" (one-shot

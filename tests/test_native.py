@@ -1,0 +1,107 @@
+"""Parity tests: native C++ data layer (native/rocio.cc via ctypes)
+vs the pure-numpy reference implementations."""
+
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from roc_tpu import native
+from roc_tpu.core import graph as G
+from roc_tpu.core import partition as P
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native librocio.so not built")
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return G.synthetic_graph(500, 12, seed=3, power_law=True)
+
+
+def test_lux_roundtrip(graph, tmp_path):
+    p = str(tmp_path / "t.lux")
+    G.save_lux(graph, p)
+    row_ptr, col_idx = native.load_lux(p)
+    assert np.array_equal(row_ptr, graph.row_ptr)
+    assert np.array_equal(col_idx, graph.col_idx)
+    p2 = str(tmp_path / "t2.lux")
+    native.save_lux(p2, graph.row_ptr, graph.col_idx)
+    g2 = G.load_lux(p2)
+    assert np.array_equal(g2.row_ptr, graph.row_ptr)
+    assert np.array_equal(g2.col_idx, graph.col_idx)
+
+
+def test_lux_read_rejects_corrupt(tmp_path):
+    p = str(tmp_path / "bad.lux")
+    with open(p, "wb") as f:
+        f.write(b"\x05\x00\x00\x00")  # header truncated
+    with pytest.raises(IOError):
+        native.load_lux(p)
+
+
+def test_features_csv(tmp_path):
+    feats = np.random.RandomState(0).randn(50, 7).astype(np.float32)
+    p = str(tmp_path / "x.feats.csv")
+    np.savetxt(p, feats, delimiter=",", fmt="%.6e")
+    got = native.load_features_csv(p, 50, 7)
+    np.testing.assert_allclose(got, feats, atol=1e-5)
+
+
+def test_features_csv_shape_mismatch_raises(tmp_path):
+    """A wrong column count must raise, not silently mis-align rows
+    (parity with the numpy fallback's reshape error)."""
+    feats = np.arange(16, dtype=np.float32).reshape(4, 4)
+    p = str(tmp_path / "x.feats.csv")
+    np.savetxt(p, feats, delimiter=",", fmt="%.1f")
+    with pytest.raises(IOError):
+        native.load_features_csv(p, 4, 2)   # under-declared cols
+    with pytest.raises(IOError):
+        native.load_features_csv(p, 4, 8)   # over-declared cols
+
+
+def test_mask_parser(tmp_path):
+    names = ["Train", "Val", "Test", "None"]
+    vals = np.random.RandomState(1).randint(0, 4, size=200)
+    p = str(tmp_path / "m.mask")
+    with open(p, "w") as f:
+        f.write("\n".join(names[v] for v in vals) + "\n")
+    got = native.load_mask(p, 200)
+    want = np.array([[1, 2, 3, 0][v] for v in vals], dtype=np.int32)
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("num_parts", [1, 2, 4, 7])
+def test_bounds_parity(graph, num_parts, monkeypatch):
+    nb = [tuple(b) for b in
+          native.edge_balanced_bounds(graph.row_ptr, num_parts)]
+    # force the pure-python sweep for comparison
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    pb = P.edge_balanced_bounds(graph.row_ptr, num_parts)
+    assert nb == pb
+
+
+def test_add_self_edges_parity(monkeypatch):
+    base = G.from_edge_list(np.array([0, 1, 2, 4, 2]),
+                            np.array([1, 2, 3, 4, 2]), 6)
+    row_ptr, col_idx = native.add_self_edges(base.row_ptr, base.col_idx)
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_tried", True)
+    ref = G.add_self_edges(base)
+    assert np.array_equal(row_ptr, ref.row_ptr)
+    assert np.array_equal(col_idx, ref.col_idx)
+
+
+def test_ell_widths(graph):
+    w = native.ell_widths(graph.row_ptr, 8)
+    deg = np.diff(graph.row_ptr)
+    for d, got in zip(deg, w):
+        if d == 0:
+            assert got == 0
+        else:
+            want = 8
+            while want < d:
+                want *= 2
+            assert got == want

@@ -9,7 +9,7 @@ import pytest
 from roc_tpu.core.graph import add_self_edges, synthetic_graph
 from roc_tpu.core.partition import padded_edge_list
 from roc_tpu.ops.aggregate import (aggregate_blocked, aggregate_mean,
-                                   aggregate_segment)
+                                   aggregate_scan, aggregate_segment)
 from roc_tpu.ops.dense import (AC_MODE_NONE, AC_MODE_RELU, dropout, linear)
 from roc_tpu.ops.loss import (masked_softmax_cross_entropy, perf_metrics,
                               summarize_metrics)
@@ -59,6 +59,37 @@ def test_aggregate_blocked_matches_segment(graph, feats):
     b = aggregate_blocked(x, src, dst, graph.num_nodes, chunk=64)
     np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("chunk", [32, 64, 256])
+def test_aggregate_scan_matches_segment(graph, feats, chunk):
+    src, dst = _padded(graph, chunk=chunk)
+    x = jnp.concatenate([jnp.asarray(feats),
+                         jnp.zeros((1, feats.shape[1]))], axis=0)
+    a = aggregate_segment(x, src, dst, graph.num_nodes)
+    b = aggregate_scan(x, src, dst, graph.num_nodes, chunk=chunk)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_aggregate_scan_hub_row_spans_chunks():
+    """A row whose degree is many times the chunk size exercises the
+    carry-record path (partials scatter-added across chunks)."""
+    V, hub_deg, chunk = 16, 300, 32
+    rng = np.random.RandomState(0)
+    dst = np.concatenate([np.arange(V), np.full(hub_deg, 7)])
+    src = np.concatenate([np.arange(V), rng.randint(0, V, hub_deg)])
+    from roc_tpu.core.graph import from_edge_list
+    g = from_edge_list(src, dst, V)
+    psrc, pdst = padded_edge_list(g, multiple=chunk)
+    x = np.zeros((V + 1, 5), dtype=np.float32)
+    x[:V] = rng.randn(V, 5)
+    a = aggregate_segment(jnp.asarray(x), jnp.asarray(psrc),
+                          jnp.asarray(pdst), V)
+    b = aggregate_scan(jnp.asarray(x), jnp.asarray(psrc),
+                       jnp.asarray(pdst), V, chunk=chunk)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-4)
 
 
 def test_aggregate_grad_is_transpose(graph, feats):
@@ -196,9 +227,9 @@ def test_aggregate_ell_hub_node():
     """A hub row far above the old width clamp must aggregate exactly
     (regression: widths are unbounded powers of two, never clamped)."""
     from roc_tpu.core.graph import from_edge_list, add_self_edges
-    from roc_tpu.core.ell import ell_from_graph, _width_of
+    from roc_tpu.core.ell import ell_from_graph, row_widths
     from roc_tpu.ops.aggregate import aggregate_ell
-    assert _width_of(70_000, 8) == 131072
+    assert row_widths(np.array([70_000]), 8)[0] == 131072
     V = 300
     hub_src = np.arange(V, dtype=np.int64)
     hub_dst = np.zeros(V, dtype=np.int64)
