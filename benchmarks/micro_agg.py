@@ -77,12 +77,12 @@ def main():
         else:
             impl, chunk = spec, 1024
         if impl == "ell":
-            from roc_tpu.core.ell import build_ell
+            from roc_tpu.core.ell import ell_from_graph
             t0 = time.time()
-            ell = build_ell(g)
+            ell = ell_from_graph(g.row_ptr, g.col_idx, V)
             prep = time.time() - t0
-            idx = tuple(jnp.asarray(i) for i in ell.idx)
-            pos = jnp.asarray(ell.row_pos)
+            idx = tuple(jnp.asarray(i[0]) for i in ell.idx)
+            pos = jnp.asarray(ell.row_pos[0])
             f = jax.jit(lambda x: aggregate_ell(x, idx, pos, V))
             ms = bench(lambda: f(feats), args.iters)
             print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "

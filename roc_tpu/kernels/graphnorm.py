@@ -10,9 +10,8 @@ since the op is a broadcast multiply by a constant vector.
 
 On TPU the degrees are static per graph, so the kernel is a tiled
 broadcast scale: rows stream through VMEM in (block, lane-aligned)
-tiles, ``rsqrt`` runs on the VPU.  Zero-degree (padding) rows pass
-through unscaled (``max(deg, 1)`` — matching
-:func:`roc_tpu.ops.norm.indegree_norm`).
+tiles, ``rsqrt`` runs on the VPU.  Zero-degree (padding) rows map to
+zero output, matching :func:`roc_tpu.ops.norm.inv_sqrt_degree`.
 """
 
 from __future__ import annotations
@@ -26,8 +25,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _norm_kernel(deg_ref, x_ref, out_ref):
-    deg = jnp.maximum(deg_ref[:].astype(jnp.float32), 1.0)  # [B, 1]
-    scale = jax.lax.rsqrt(deg)
+    deg = deg_ref[:].astype(jnp.float32)                     # [B, 1]
+    scale = jnp.where(deg > 0,
+                      jax.lax.rsqrt(jnp.maximum(deg, 1.0)), 0.0)
     out_ref[:] = (x_ref[:].astype(jnp.float32) * scale).astype(
         out_ref.dtype)
 

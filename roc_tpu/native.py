@@ -57,14 +57,29 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
-    lib.roc_lux_header.restype = ctypes.c_int
-    lib.roc_lux_read.restype = ctypes.c_int
-    lib.roc_lux_write.restype = ctypes.c_int
-    lib.roc_load_features_csv.restype = ctypes.c_int
-    lib.roc_load_mask.restype = ctypes.c_int
-    lib.roc_edge_balanced_bounds.restype = ctypes.c_int
-    lib.roc_add_self_edges.restype = ctypes.c_int64
-    lib.roc_ell_widths.restype = ctypes.c_int
+    # Full argtypes: int64_t params must not fall back to the 32-bit
+    # c_int default (graphs with > 2^31 edges are in scope for the
+    # streaming tier).
+    c = ctypes
+    i64, i32p, i64p, f32p = (c.c_int64, c.POINTER(c.c_int32),
+                             c.POINTER(c.c_int64), c.POINTER(c.c_float))
+    lib.roc_lux_header.restype = c.c_int
+    lib.roc_lux_header.argtypes = [c.c_char_p, c.POINTER(c.c_uint32),
+                                   c.POINTER(c.c_uint64)]
+    lib.roc_lux_read.restype = c.c_int
+    lib.roc_lux_read.argtypes = [c.c_char_p, i64, i64, i64p, i32p]
+    lib.roc_lux_write.restype = c.c_int
+    lib.roc_lux_write.argtypes = [c.c_char_p, i64, i64, i64p, i32p]
+    lib.roc_load_features_csv.restype = c.c_int
+    lib.roc_load_features_csv.argtypes = [c.c_char_p, f32p, i64, i64]
+    lib.roc_load_mask.restype = c.c_int
+    lib.roc_load_mask.argtypes = [c.c_char_p, i32p, i64]
+    lib.roc_edge_balanced_bounds.restype = c.c_int
+    lib.roc_edge_balanced_bounds.argtypes = [i64p, i64, i64, i64p]
+    lib.roc_add_self_edges.restype = c.c_int64
+    lib.roc_add_self_edges.argtypes = [i64p, i32p, i64, i64p, i32p, i64]
+    lib.roc_ell_widths.restype = c.c_int
+    lib.roc_ell_widths.argtypes = [i64p, i64, c.c_int32, i32p]
     _lib = lib
     return _lib
 

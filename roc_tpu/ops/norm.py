@@ -27,6 +27,14 @@ def inv_sqrt_degree(in_degree: jax.Array) -> jax.Array:
     return jnp.where(deg > 0, jax.lax.rsqrt(jnp.maximum(deg, 1.0)), 0.0)
 
 
-def indegree_norm(x: jax.Array, in_degree: jax.Array) -> jax.Array:
-    """x: [V, F]; in_degree: int32 [V].  Returns x / sqrt(indegree)."""
+def indegree_norm(x: jax.Array, in_degree: jax.Array,
+                  impl: str = "xla") -> jax.Array:
+    """x: [V, F]; in_degree: int32 [V].  Returns x / sqrt(indegree).
+
+    ``impl='pallas'`` routes through the explicit VMEM-tiled kernel
+    (kernels/graphnorm.py) — numerically identical; the XLA path is
+    the default because the multiply fuses into neighboring ops."""
+    if impl == "pallas":
+        from ..kernels.graphnorm import indegree_norm_pallas
+        return indegree_norm_pallas(x, in_degree)
     return x * inv_sqrt_degree(in_degree)[:, None].astype(x.dtype)

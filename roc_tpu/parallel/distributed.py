@@ -123,14 +123,20 @@ class ShardedData:
 def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   mesh: Mesh, dtype=jnp.float32,
                   aggr_impl: str = "segment",
-                  halo: str = "gather") -> ShardedData:
+                  halo: str = "gather",
+                  put=None) -> ShardedData:
+    """Build + upload the stacked per-part arrays.  ``put`` overrides
+    the upload (default: replicated-process ``device_put`` with the
+    parts sharding); parallel/multihost.py passes a local-shards-only
+    uploader for multi-host runs."""
     sh = NamedSharding(mesh, P("parts"))
     col_padded = remap_to_padded(pg)
     edge_dst = np.stack([
         np.repeat(np.arange(pg.part_nodes, dtype=np.int32),
                   np.diff(pg.part_row_ptr[p]))
         for p in range(pg.num_parts)])
-    put = lambda x: jax.device_put(x, sh)
+    if put is None:
+        put = lambda x: jax.device_put(x, sh)
     ell_idx = ()
     ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
     if aggr_impl == "ell" and halo != "ring":

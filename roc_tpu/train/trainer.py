@@ -52,6 +52,10 @@ class TrainConfig:
     # caller (skip the check, e.g. huge graphs); False = force exact
     # autodiff gradients (directed graphs; slow for the blocked impl).
     symmetric: Optional[bool] = None
+    # Observability (utils/profiling.py): profiler trace directory
+    # (TensorBoard format; None = off) and metrics JSONL path.
+    profile_dir: Optional[str] = None
+    metrics_path: Optional[str] = None
 
 
 def resolve_symmetric(dataset: Dataset,
@@ -111,6 +115,9 @@ class Trainer:
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
         self._train_step = jax.jit(self._train_step_impl)
         self._eval_step = jax.jit(self._eval_step_impl)
+        from ..utils.profiling import EpochTimer, MetricsLog
+        self.timer = EpochTimer()
+        self.metrics_log = MetricsLog(config.metrics_path)
 
     def _train_step_impl(self, params, opt_state, key, lr):
         def objective(p):
@@ -131,24 +138,37 @@ class Trainer:
     def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
         """Run ``epochs`` more epochs; the epoch counter persists across
         calls so lr decay and the eval cadence continue correctly."""
+        from ..utils.profiling import trace
         cfg = self.config
         epochs = epochs if epochs is not None else cfg.epochs
         history: List[Dict[str, float]] = []
-        for _ in range(epochs):
-            epoch = self.epoch
-            lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
-                            cfg.decay_rate, cfg.decay_steps)
-            self.key, step_key = jax.random.split(self.key)
-            self.params, self.opt_state, _ = self._train_step(
-                self.params, self.opt_state, step_key, lr)
-            if epoch % cfg.eval_every == 0:
-                m = summarize_metrics(jax.device_get(
-                    self._eval_step(self.params)))
-                m["epoch"] = epoch
-                history.append(m)
-                if cfg.verbose:
-                    print(format_metrics(epoch, m))
-            self.epoch += 1
+        # Steps are async-dispatched; honest per-epoch time is the wall
+        # clock between evals (whose device_get drains the queue)
+        # divided by the epochs in between.
+        t_last = time.perf_counter()
+        e_last = self.epoch
+        with trace(cfg.profile_dir):
+            for _ in range(epochs):
+                epoch = self.epoch
+                lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
+                                cfg.decay_rate, cfg.decay_steps)
+                self.key, step_key = jax.random.split(self.key)
+                self.params, self.opt_state, _ = self._train_step(
+                    self.params, self.opt_state, step_key, lr)
+                if epoch % cfg.eval_every == 0:
+                    m = summarize_metrics(jax.device_get(
+                        self._eval_step(self.params)))
+                    now = time.perf_counter()
+                    span = max(self.epoch + 1 - e_last, 1)
+                    m["epoch"] = epoch
+                    m["epoch_ms"] = (now - t_last) * 1e3 / span
+                    self.timer.laps_ms.append(m["epoch_ms"])
+                    t_last, e_last = now, self.epoch + 1
+                    history.append(m)
+                    self.metrics_log.log(m)
+                    if cfg.verbose:
+                        print(format_metrics(epoch, m))
+                self.epoch += 1
         return history
 
     def evaluate(self) -> Dict[str, float]:

@@ -1,0 +1,137 @@
+"""Tracing / profiling / observability subsystem.
+
+The reference has no profiling subsystem of its own — only Legion log
+categories and commented-out ``Realm::Clock`` micro-timers
+(``activation_kernel.cu:40,62-63``, ``gnn.cc:796-805``; SURVEY.md §5
+calls this a gap to fill, not copy).  The TPU-native equivalents:
+
+- :func:`trace` — context manager around ``jax.profiler`` emitting a
+  TensorBoard/Perfetto trace directory (the analog of Legion's
+  ``-lg:prof`` logs).
+- :class:`annotate` — ``jax.profiler.TraceAnnotation`` wrapper so epoch
+  phases (forward/backward/update/eval) show up as named spans.
+- :class:`EpochTimer` — honest wall-clock epoch timing.  Under the
+  axon-tunneled TPU, ``block_until_ready`` does NOT synchronize, so
+  ``sync`` fetches a scalar reduction of a device array — the only
+  reliable barrier (see benchmarks/micro_agg.py).
+- :class:`MetricsLog` — structured training-metrics history with JSONL
+  export; the rebuild of the reference's stdout-only ``PerfMetrics``
+  prints (``softmax_kernel.cu:141-152``) as a queryable artifact.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Profile the enclosed block into ``log_dir`` (TensorBoard trace
+    format).  No-op when ``log_dir`` is falsy, so call sites can thread
+    a config value through unconditionally."""
+    if not log_dir:
+        yield
+        return
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named span in profiler traces (forward/backward/update/eval)."""
+    import jax
+    return jax.profiler.TraceAnnotation(name)
+
+
+def sync(x: Any) -> None:
+    """Reliable device barrier: fetch a scalar derived from ``x``.
+    ``jax.block_until_ready`` is not sufficient under the axon relay."""
+    import jax
+    import jax.numpy as jnp
+    leaves = jax.tree_util.tree_leaves(x)
+    if leaves:
+        float(jnp.sum(leaves[0]))
+
+
+@dataclass
+class EpochTimer:
+    """Wall-clock per-epoch timer with warmup separation.
+
+    The first ``warmup`` laps (compile + cache effects) are recorded but
+    excluded from the summary statistics.
+    """
+
+    warmup: int = 1
+    laps_ms: List[float] = field(default_factory=list)
+    _t0: Optional[float] = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, sync_on: Any = None) -> float:
+        assert self._t0 is not None, "start() not called"
+        if sync_on is not None:
+            sync(sync_on)
+        ms = (time.perf_counter() - self._t0) * 1e3
+        self.laps_ms.append(ms)
+        self._t0 = None
+        return ms
+
+    @contextlib.contextmanager
+    def lap(self, sync_on: Any = None) -> Iterator[None]:
+        self.start()
+        try:
+            yield
+        finally:
+            self.stop(sync_on=sync_on)
+
+    def summary(self) -> Dict[str, float]:
+        steady = self.laps_ms[self.warmup:] or self.laps_ms
+        arr = np.asarray(steady, dtype=np.float64)
+        return {
+            "laps": len(self.laps_ms),
+            "warmup_ms": float(sum(self.laps_ms[:self.warmup])),
+            "mean_ms": float(arr.mean()) if arr.size else 0.0,
+            "median_ms": float(np.median(arr)) if arr.size else 0.0,
+            "p90_ms": float(np.percentile(arr, 90)) if arr.size else 0.0,
+            "min_ms": float(arr.min()) if arr.size else 0.0,
+        }
+
+
+class MetricsLog:
+    """Append-only training metrics history with JSONL export.  The
+    file handle opens lazily on first :meth:`log` (constructing many
+    trainers must not accumulate descriptors)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.records: List[Dict[str, float]] = []
+        self._fh = None
+
+    def log(self, record: Dict[str, Any]) -> None:
+        rec = {k: (float(v) if isinstance(v, (int, float, np.floating,
+                                              np.integer)) else v)
+               for k, v in record.items()}
+        self.records.append(rec)
+        if self.path:
+            if self._fh is None:
+                self._fh = open(self.path, "a")
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+    def last(self) -> Optional[Dict[str, float]]:
+        return self.records[-1] if self.records else None

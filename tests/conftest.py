@@ -11,6 +11,11 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test (interpret-mode kernels)")
+
 # The axon sitecustomize can override JAX_PLATFORMS after env setup;
 # force the CPU platform explicitly so the 8 virtual devices exist.
 jax.config.update("jax_platforms", "cpu")

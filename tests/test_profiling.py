@@ -1,0 +1,67 @@
+"""Observability subsystem tests (utils/profiling.py) + trainer hooks."""
+
+import json
+import os
+
+import numpy as np
+
+from roc_tpu.utils.profiling import EpochTimer, MetricsLog, sync, trace
+
+
+def test_epoch_timer_summary():
+    t = EpochTimer(warmup=1)
+    for ms in (100.0, 10.0, 12.0, 11.0):
+        t.laps_ms.append(ms)
+    s = t.summary()
+    assert s["laps"] == 4
+    assert s["warmup_ms"] == 100.0
+    assert 10.0 <= s["median_ms"] <= 12.0
+    assert s["min_ms"] == 10.0
+
+
+def test_epoch_timer_lap_context():
+    t = EpochTimer()
+    with t.lap():
+        pass
+    assert len(t.laps_ms) == 1 and t.laps_ms[0] >= 0.0
+
+
+def test_sync_fetches():
+    import jax.numpy as jnp
+    sync({"a": jnp.ones((3, 3))})  # must not raise
+    sync([])                        # empty pytree ok
+
+
+def test_metrics_log_jsonl(tmp_path):
+    p = str(tmp_path / "metrics.jsonl")
+    log = MetricsLog(p)
+    log.log({"epoch": 0, "train_loss": np.float32(1.5)})
+    log.log({"epoch": 5, "train_loss": 1.2})
+    log.close()
+    lines = [json.loads(l) for l in open(p)]
+    assert lines[0]["train_loss"] == 1.5
+    assert log.last()["epoch"] == 5
+
+
+def test_trace_noop_without_dir():
+    with trace(None):
+        pass
+
+
+def test_trainer_logs_metrics(tmp_path):
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+
+    ds = synthetic_dataset(64, 6, in_dim=8, num_classes=3, seed=0)
+    p = str(tmp_path / "m.jsonl")
+    cfg = TrainConfig(epochs=6, eval_every=2, verbose=False,
+                      metrics_path=p, symmetric=True)
+    tr = Trainer(build_gcn([8, 8, 3]), ds, cfg)
+    hist = tr.train()
+    tr.metrics_log.close()
+    assert len(hist) == 3
+    recs = [json.loads(l) for l in open(p)]
+    assert [r["epoch"] for r in recs] == [0, 2, 4]
+    assert all("epoch_ms" in r and r["epoch_ms"] > 0 for r in recs)
+    assert tr.timer.summary()["laps"] == 3
