@@ -104,7 +104,7 @@ class StreamingAggregator:
                  out_dtype=jnp.float32) -> jax.Array:
         F = feats_host.shape[1]
         out = jnp.zeros((self.num_rows, F), dtype=out_dtype)
-        add = jax.jit(_block_scatter_add, static_argnames=())
+        add = _block_scatter_add_jit
         for plan in self.plans:
             block = jax.device_put(np.ascontiguousarray(
                 feats_host[plan.lo:plan.hi])).astype(out_dtype)
@@ -120,3 +120,7 @@ def _block_scatter_add(out, block, src_local, dst):
     g = block[src_local]
     return out.at[dst].add(g, indices_are_sorted=True,
                            unique_indices=False)
+
+
+# module-level jit: the dispatch cache survives across aggregator calls
+_block_scatter_add_jit = jax.jit(_block_scatter_add, donate_argnums=(0,))

@@ -36,11 +36,12 @@ from ..ops.norm import indegree_norm
 
 # AggrType mirror (gnn.h:75-80); the reference declares SUM/AVG/MAX/MIN
 # but implements only SUM.  Here SUM and AVG ride the symmetric-vjp CSR
-# path; MAX uses exact autodiff (it is nonlinear, so the reference's
-# kernel-reuse trick does not apply).
+# path; MAX/MIN use exact autodiff (nonlinear, so the reference's
+# kernel-reuse trick does not apply; MIN = -MAX(-x)).
 AGGR_SUM = "sum"
 AGGR_AVG = "avg"
 AGGR_MAX = "max"
+AGGR_MIN = "min"
 
 
 @dataclass
@@ -134,6 +135,8 @@ class GraphContext:
             return s / deg[:, None]
         if aggr == AGGR_MAX:
             return self._max_fwd(x)
+        if aggr == AGGR_MIN:
+            return -self._max_fwd(-x)
         raise ValueError(f"unknown aggregator: {aggr}")
 
     def _max_fwd(self, x: jax.Array) -> jax.Array:

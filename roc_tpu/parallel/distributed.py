@@ -51,15 +51,22 @@ from ..train.trainer import (TrainConfig, format_metrics,
                              resolve_symmetric)
 
 
-def make_mesh(num_parts: int, devices: Optional[List] = None) -> Mesh:
+def make_mesh(num_parts: Optional[int] = None,
+              devices: Optional[List] = None) -> Mesh:
     """1-D mesh over graph partitions.  One partition per device — the
     reference sets numParts = numMachines * numGPUs the same way
-    (``gnn.cc:62,754``)."""
+    (``gnn.cc:62,754``).  ``num_parts=None`` uses every device.
+
+    ``jax.devices()`` orders devices process-major, so consecutive
+    partitions land on the same host — ring-halo hops cross DCN once
+    per host (parallel/multihost.py relies on this layout)."""
     if devices is None:
-        devices = jax.devices()[:num_parts]
-    assert len(devices) == num_parts, (
+        devices = jax.devices()
+    if num_parts is None:
+        num_parts = len(devices)
+    assert len(devices) >= num_parts, (
         f"need {num_parts} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices), ("parts",))
+    return Mesh(np.asarray(devices[:num_parts]), ("parts",))
 
 
 def remap_to_padded(pg: PartitionedGraph) -> np.ndarray:
@@ -200,6 +207,9 @@ class DistributedTrainer:
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
+        from ..utils.profiling import EpochTimer, MetricsLog
+        self.timer = EpochTimer()
+        self.metrics_log = MetricsLog(config.metrics_path)
 
     # ---- step builders ----
 
@@ -245,6 +255,8 @@ class DistributedTrainer:
                                           train=True)
                 return masked_softmax_cross_entropy(logits, labels, mask)
 
+            if self.config.remat:
+                local_loss = jax.checkpoint(local_loss)
             local_l, grads = jax.value_and_grad(local_loss)(params)
             # the reference's replica-sum gradient allreduce
             # (optimizer_kernel.cu:88-94) as an ICI psum
@@ -297,24 +309,38 @@ class DistributedTrainer:
     # ---- loop ----
 
     def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
+        import time as _time
+        from ..utils.profiling import trace
         cfg = self.config
         d = self.data
         epochs = epochs if epochs is not None else cfg.epochs
         history: List[Dict[str, float]] = []
-        for _ in range(epochs):
-            epoch = self.epoch
-            lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
-                            cfg.decay_rate, cfg.decay_steps)
-            self.key, step_key = jax.random.split(self.key)
-            self.params, self.opt_state, _ = self._train_step(
-                self.params, self.opt_state, d.feats, d.labels, d.mask,
-                d.edge_src, d.edge_dst, d.in_degree, d.ell_idx,
-                d.ell_row_pos, d.ring_idx, d.ring_row_pos, step_key, lr)
-            if epoch % cfg.eval_every == 0:
-                history.append(self._eval(epoch))
-                if cfg.verbose:
-                    print(format_metrics(epoch, history[-1]))
-            self.epoch += 1
+        t_last = _time.perf_counter()
+        e_last = self.epoch
+        with trace(cfg.profile_dir):
+            for _ in range(epochs):
+                epoch = self.epoch
+                lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
+                                cfg.decay_rate, cfg.decay_steps)
+                self.key, step_key = jax.random.split(self.key)
+                self.params, self.opt_state, _ = self._train_step(
+                    self.params, self.opt_state, d.feats, d.labels,
+                    d.mask, d.edge_src, d.edge_dst, d.in_degree,
+                    d.ell_idx, d.ell_row_pos, d.ring_idx, d.ring_row_pos,
+                    step_key, lr)
+                if epoch % cfg.eval_every == 0:
+                    m = self._eval(epoch)
+                    now = _time.perf_counter()
+                    span = max(self.epoch + 1 - e_last, 1)
+                    m["epoch_ms"] = (now - t_last) * 1e3 / span
+                    self.timer.laps_ms.append(m["epoch_ms"])
+                    t_last, e_last = now, self.epoch + 1
+                    history.append(m)
+                    self.metrics_log.log(m)
+                    if cfg.verbose:
+                        print(format_metrics(epoch, m))
+                self.epoch += 1
+        self.metrics_log.close()
         return history
 
     def _eval(self, epoch: int) -> Dict[str, float]:

@@ -68,20 +68,12 @@ def init_distributed(coordinator_address: Optional[str] = None,
 
 def make_parts_mesh(num_parts: Optional[int] = None,
                     devices: Optional[List] = None) -> Mesh:
-    """1-D ``'parts'`` mesh across all processes' devices.
-
-    ``jax.devices()`` orders devices process-major, so consecutive
-    partitions map to the same host and partition<->device adjacency
-    matches DCN locality (ring halo hops cross DCN once per host, not
-    once per device).
-    """
-    if devices is None:
-        devices = jax.devices()
-    if num_parts is None:
-        num_parts = len(devices)
-    assert len(devices) >= num_parts, (
-        f"need {num_parts} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:num_parts]), ("parts",))
+    """1-D ``'parts'`` mesh across all processes' devices — alias of
+    :func:`roc_tpu.parallel.distributed.make_mesh` (one constructor,
+    one partition->device layout; see its docstring for the DCN
+    locality invariant)."""
+    from .distributed import make_mesh
+    return make_mesh(num_parts, devices)
 
 
 def process_local_parts(mesh: Mesh) -> List[int]:

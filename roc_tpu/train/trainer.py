@@ -56,6 +56,11 @@ class TrainConfig:
     # (TensorBoard format; None = off) and metrics JSONL path.
     profile_dir: Optional[str] = None
     metrics_path: Optional[str] = None
+    # Memory policy (the TPU analog of the reference's FB-cache
+    # residency tuning, resourcemanager.h:30): rematerialize the
+    # forward pass in backward instead of saving activations — trades
+    # one extra forward of FLOPs for O(layers) less activation memory.
+    remat: bool = False
 
 
 def resolve_symmetric(dataset: Dataset,
@@ -113,7 +118,10 @@ class Trainer:
         self.params = model.init_params(init_key, dtype=config.dtype)
         self.opt_state = adam_init(self.params)
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
-        self._train_step = jax.jit(self._train_step_impl)
+        # donate params + opt state: the update writes them in place
+        # instead of holding two copies (XLA buffer donation)
+        self._train_step = jax.jit(self._train_step_impl,
+                                   donate_argnums=(0, 1))
         self._eval_step = jax.jit(self._eval_step_impl)
         from ..utils.profiling import EpochTimer, MetricsLog
         self.timer = EpochTimer()
@@ -125,6 +133,8 @@ class Trainer:
                                          self.mask, self.gctx, key=key,
                                          train=True)
             return loss
+        if self.config.remat:
+            objective = jax.checkpoint(objective)
         loss, grads = jax.value_and_grad(objective)(params)
         params, opt_state = adam_update(params, grads, opt_state, lr,
                                         self.adam_cfg)
@@ -169,6 +179,9 @@ class Trainer:
                     if cfg.verbose:
                         print(format_metrics(epoch, m))
                 self.epoch += 1
+        # bound fds across many trainers; the log lazily reopens in
+        # append mode if train() is called again
+        self.metrics_log.close()
         return history
 
     def evaluate(self) -> Dict[str, float]:

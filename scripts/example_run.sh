@@ -1,0 +1,5 @@
+#!/bin/sh
+# The reference's known-good Reddit config (example_run.sh:1):
+# lr .01, weight-decay 1e-4, lr-decay .97, dropout .5,
+# layers 602-256-41, 3000 epochs.
+sh "$(dirname "$0")/test.sh" 0.01 0.0001 0.97 0.5 602-256-41 3000 "$@"

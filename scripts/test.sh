@@ -1,0 +1,12 @@
+#!/bin/sh
+# Run-script analog of the reference's test.sh (test.sh:8): positional
+# hyperparameters -> the training CLI on a dataset directory.
+#   usage: sh scripts/test.sh <lr> <wd> <decay-rate> <dropout> <layers> <epochs> [extra args...]
+# The reference's Legion resource flags (-ll:gpu/-ll:cpu/-ll:fsize/
+# -ll:zsize) have no TPU analog: XLA owns HBM, --parts picks the mesh.
+set -e
+LR=$1; WD=$2; DR=$3; DROP=$4; LAYERS=$5; EPOCHS=$6
+shift 6 || true
+exec python -m roc_tpu.train.cli \
+    -lr "$LR" -decay "$WD" -decay-rate "$DR" -dropout "$DROP" \
+    -layers "$LAYERS" -e "$EPOCHS" -file dataset/reddit-dgl "$@"

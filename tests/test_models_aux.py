@@ -67,6 +67,22 @@ def test_max_aggregator_matches_numpy(dataset):
                                    err_msg=impl)
 
 
+def test_min_aggregator_matches_numpy(dataset):
+    from roc_tpu.models.builder import AGGR_MIN
+    g = dataset.graph
+    feats = dataset.features
+    want = np.zeros_like(feats)
+    for v in range(g.num_nodes):
+        srcs = g.col_idx[g.row_ptr[v]:g.row_ptr[v + 1]]
+        if len(srcs):
+            want[v] = feats[srcs].min(axis=0)
+    for impl in ("segment", "ell"):
+        gctx = make_graph_context(dataset, aggr_impl=impl)
+        got = np.asarray(gctx.aggregate(jnp.asarray(feats), AGGR_MIN))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6,
+                                   err_msg=impl)
+
+
 def test_checkpoint_roundtrip(dataset, tmp_path):
     from roc_tpu.utils.checkpoint import (checkpoint_trainer,
                                           restore_trainer)
