@@ -148,45 +148,60 @@ class Trainer:
     def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
         """Run ``epochs`` more epochs; the epoch counter persists across
         calls so lr decay and the eval cadence continue correctly."""
-        from ..utils.profiling import trace
-        cfg = self.config
-        epochs = epochs if epochs is not None else cfg.epochs
-        history: List[Dict[str, float]] = []
-        # Steps are async-dispatched; honest per-epoch time is the wall
-        # clock between evals (whose device_get drains the queue)
-        # divided by the epochs in between.
-        t_last = time.perf_counter()
-        e_last = self.epoch
-        with trace(cfg.profile_dir):
-            for _ in range(epochs):
-                epoch = self.epoch
-                lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
-                                cfg.decay_rate, cfg.decay_steps)
-                self.key, step_key = jax.random.split(self.key)
-                self.params, self.opt_state, _ = self._train_step(
-                    self.params, self.opt_state, step_key, lr)
-                if epoch % cfg.eval_every == 0:
-                    m = summarize_metrics(jax.device_get(
-                        self._eval_step(self.params)))
-                    now = time.perf_counter()
-                    span = max(self.epoch + 1 - e_last, 1)
-                    m["epoch"] = epoch
-                    m["epoch_ms"] = (now - t_last) * 1e3 / span
-                    self.timer.laps_ms.append(m["epoch_ms"])
-                    t_last, e_last = now, self.epoch + 1
-                    history.append(m)
-                    self.metrics_log.log(m)
-                    if cfg.verbose:
-                        print(format_metrics(epoch, m))
-                self.epoch += 1
-        # bound fds across many trainers; the log lazily reopens in
-        # append mode if train() is called again
-        self.metrics_log.close()
-        return history
+        def do_step(step_key, lr):
+            self.params, self.opt_state, _ = self._train_step(
+                self.params, self.opt_state, step_key, lr)
+
+        return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
     def evaluate(self) -> Dict[str, float]:
         return summarize_metrics(jax.device_get(
             self._eval_step(self.params)))
+
+
+def run_epoch_loop(tr, epochs: Optional[int], do_step,
+                   do_eval) -> List[Dict[str, float]]:
+    """The reference epoch loop (``gnn.cc:99-111``), shared by the
+    single-device and distributed trainers: staircase lr decay,
+    async-dispatched train step, every-``eval_every``-epoch eval with
+    metrics logging and honest timing.
+
+    ``tr`` provides config/epoch/key/timer/metrics_log state;
+    ``do_step(step_key, lr)`` runs one training step (async);
+    ``do_eval()`` returns the summarized metrics dict (its device
+    fetch is the synchronization point — steps are async-dispatched,
+    so per-epoch time is wall clock between evals divided by the
+    epochs in between)."""
+    from ..utils.profiling import trace
+    cfg = tr.config
+    epochs = epochs if epochs is not None else cfg.epochs
+    history: List[Dict[str, float]] = []
+    t_last = time.perf_counter()
+    e_last = tr.epoch
+    with trace(cfg.profile_dir):
+        for _ in range(epochs):
+            epoch = tr.epoch
+            lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
+                            cfg.decay_rate, cfg.decay_steps)
+            tr.key, step_key = jax.random.split(tr.key)
+            do_step(step_key, lr)
+            if epoch % cfg.eval_every == 0:
+                m = do_eval()
+                now = time.perf_counter()
+                span = max(tr.epoch + 1 - e_last, 1)
+                m["epoch"] = epoch
+                m["epoch_ms"] = (now - t_last) * 1e3 / span
+                tr.timer.laps_ms.append(m["epoch_ms"])
+                t_last, e_last = now, tr.epoch + 1
+                history.append(m)
+                tr.metrics_log.log(m)
+                if cfg.verbose:
+                    print(format_metrics(epoch, m))
+            tr.epoch += 1
+    # bound fds across many trainers; the log lazily reopens in
+    # append mode if train() is called again
+    tr.metrics_log.close()
+    return history
 
 
 def format_metrics(epoch: int, m: Dict[str, float]) -> str:

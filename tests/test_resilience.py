@@ -1,0 +1,87 @@
+"""Failure detection + checkpoint-restart recovery tests."""
+
+import math
+
+import numpy as np
+import pytest
+
+from roc_tpu.core.graph import synthetic_dataset
+from roc_tpu.models.gcn import build_gcn
+from roc_tpu.train.trainer import TrainConfig, Trainer
+from roc_tpu.utils.resilience import (CheckpointRotation, NumericFailure,
+                                      check_finite, train_with_recovery)
+
+
+@pytest.fixture()
+def trainer():
+    ds = synthetic_dataset(64, 6, in_dim=8, num_classes=3, seed=0)
+    cfg = TrainConfig(epochs=100, eval_every=2, verbose=False,
+                      symmetric=True)
+    return Trainer(build_gcn([8, 8, 3]), ds, cfg)
+
+
+def test_check_finite():
+    check_finite({"train_loss": 1.0, "epoch": 3})
+    with pytest.raises(NumericFailure):
+        check_finite({"train_loss": float("nan"), "epoch": 3})
+    with pytest.raises(NumericFailure):
+        check_finite({"train_loss": float("inf"), "epoch": 3})
+
+
+def test_rotation_keeps_last_k(trainer, tmp_path):
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    for _ in range(4):
+        trainer.train(epochs=1)
+        rot.save(trainer)
+    assert rot.existing() == [3, 4]
+
+
+def test_recovery_resumes_after_crash(trainer, tmp_path):
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    train_with_recovery(trainer, 6, rot, checkpoint_every=3)
+    assert trainer.epoch == 6
+    # simulate a process crash: brand-new trainer, same command
+    ds = synthetic_dataset(64, 6, in_dim=8, num_classes=3, seed=0)
+    cfg = TrainConfig(epochs=100, eval_every=2, verbose=False,
+                      symmetric=True)
+    t2 = Trainer(build_gcn([8, 8, 3]), ds, cfg)
+    train_with_recovery(t2, 10, rot, checkpoint_every=3)
+    assert t2.epoch == 10
+
+
+def test_recovery_retries_on_numeric_failure(trainer, tmp_path):
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    train_with_recovery(trainer, 2, rot, checkpoint_every=2)
+    fails = {"n": 0}
+    orig_train = trainer.train
+
+    def flaky_train(epochs=None):
+        hist = orig_train(epochs=epochs)
+        if fails["n"] < 2:
+            fails["n"] += 1
+            hist[-1]["train_loss"] = float("nan")
+        return hist
+
+    trainer.train = flaky_train
+    seen = []
+    train_with_recovery(trainer, 6, rot, checkpoint_every=2,
+                        max_retries=3,
+                        on_failure=lambda e: seen.append(str(e)))
+    assert trainer.epoch == 6
+    assert len(seen) == 2
+
+
+def test_recovery_gives_up_after_max_retries(trainer, tmp_path):
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    train_with_recovery(trainer, 2, rot, checkpoint_every=2)
+    orig_train = trainer.train
+
+    def always_nan(epochs=None):
+        hist = orig_train(epochs=epochs)
+        hist[-1]["train_loss"] = float("nan")
+        return hist
+
+    trainer.train = always_nan
+    with pytest.raises(NumericFailure):
+        train_with_recovery(trainer, 8, rot, checkpoint_every=2,
+                            max_retries=1)
