@@ -14,6 +14,7 @@
 // Error returns are negative; 0 is success.
 
 #include <cerrno>
+#include <charconv>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
@@ -143,53 +144,107 @@ int roc_lux_write(const char* path, int64_t num_nodes, int64_t num_edges,
 // Orders of magnitude faster than np.loadtxt on Reddit-scale matrices.
 // ---------------------------------------------------------------------------
 
+namespace {
+inline bool is_csv_sep(char c) {
+  return c == ',' || c == '\n' || c == '\r' || c == ' ' || c == '\t';
+}
+
+// Locale-independent float parse of [tok, end).  Prefers
+// std::from_chars (GCC 11+ ships the float overload); older libstdc++
+// falls back to strtof with temporary NUL termination — *end is
+// writable in both call sites (a separator byte, or the sentinel slot
+// past the chunk buffer).  Returns false on malformed input.
+inline bool parse_float_tok(char* tok, char* end, float* v) {
+  if (*tok == '+') ++tok;  // from_chars rejects the leading '+'
+                           // that strtof/np.loadtxt accept
+#if defined(__cpp_lib_to_chars) && __cpp_lib_to_chars >= 201611L
+  auto res = std::from_chars(tok, end, *v);
+  return res.ec == std::errc{} && res.ptr == end;
+#else
+  char saved = *end;
+  *end = '\0';
+  char* stop = nullptr;
+  errno = 0;
+  *v = strtof(tok, &stop);
+  *end = saved;
+  return stop == end && errno != ERANGE;
+#endif
+}
+}  // namespace
+
 int roc_load_features_csv(const char* path, float* out, int64_t rows,
                           int64_t cols) {
   FILE* f = fopen(path, "rb");
   if (!f) return kErrOpen;
   FileCloser closer{f};
-  // Stream the whole file through a buffer; strtof handles separators.
-  if (fseek(f, 0, SEEK_END) != 0) return kErrRead;
-  long size = ftell(f);
-  if (size < 0) return kErrRead;
-  if (fseek(f, 0, SEEK_SET) != 0) return kErrRead;
-  char* data = (char*)malloc((size_t)size + 1);
-  if (!data) return kErrRead;
-  size_t got = fread(data, 1, (size_t)size, f);
-  data[got] = '\0';
-  char* p = data;
-  int64_t total = rows * cols;
+  // Fixed-size chunked parse (constant memory at any file size); a
+  // token split across a chunk boundary is carried to the front of the
+  // next chunk.  std::from_chars is locale-independent — strtof under
+  // a non-C LC_NUMERIC would reject valid '.'-separated files.
+  constexpr size_t kBuf = size_t{1} << 22;  // 4 MiB
+  char* buf = (char*)malloc(kBuf + 1);
+  if (!buf) return kErrRead;
+  const int64_t total = rows * cols;
   int64_t i = 0;
-  int extra = 0;  // values beyond rows*cols -> shape mismatch
-  while (true) {
-    char* end = nullptr;
-    errno = 0;
-    float v = strtof(p, &end);
-    if (end == p) {
-      // skip non-numeric separator bytes (commas, newlines, spaces)
-      if (*p == '\0') break;
-      ++p;
-      continue;
+  size_t carry = 0;
+  int rc = kOk;
+  for (;;) {
+    size_t got = fread(buf + carry, 1, kBuf - carry, f);
+    if (got == 0 && ferror(f)) {
+      // a mid-file I/O failure is a read error, not a shape mismatch
+      free(buf);
+      return kErrRead;
     }
-    if (i < total) {
-      out[i] = v;
-    } else {
-      extra = 1;  // file holds more values than the declared shape
-      break;
+    size_t len = carry + got;
+    const bool eof = got == 0;
+    carry = 0;
+    char* p = buf;
+    char* const lim = buf + len;
+    while (p < lim) {
+      if (is_csv_sep(*p)) {
+        ++p;
+        continue;
+      }
+      char* tok = p;
+      while (p < lim && !is_csv_sep(*p)) ++p;
+      if (p == lim && !eof) {
+        // token may continue in the next chunk
+        carry = (size_t)(lim - tok);
+        if (carry == kBuf) {
+          rc = kErrFormat;  // single token larger than the buffer
+        } else {
+          memmove(buf, tok, carry);
+        }
+        break;
+      }
+      float v;
+      if (!parse_float_tok(tok, p, &v)) {
+        rc = kErrFormat;
+        break;
+      }
+      if (i >= total) {
+        // file holds more values than the declared shape
+        rc = kErrFormat;
+        break;
+      }
+      out[i++] = v;
     }
-    ++i;
-    p = end;
+    if (rc != kOk || eof) break;
   }
-  free(data);
+  free(buf);
   // Exact-count check: a wrong `cols` mis-aligns every row, so both
   // under- and over-full files are format errors (the numpy fallback's
   // reshape raises in the same cases).
-  return (i == total && !extra) ? kOk : kErrFormat;
+  return (rc == kOk && i == total) ? kOk : (rc != kOk ? rc : kErrFormat);
 }
 
 // ---------------------------------------------------------------------------
-// Mask parser: one of "Train"/"Val"/"Test"/"None" per line ->
-// int32 {1, 2, 3, 0} (MaskType order, reference gnn.h:98-103).
+// Mask parser: one of "Train"/"Val"/"Test"/"None" per line -> int32
+// {1, 2, 3, 0} — the framework's MASK_* encoding (roc_tpu/core/graph.py
+// MASK_TRAIN/VAL/TEST/NONE and its numpy fallback).  Note the reference
+// enum MaskType orders TRAIN=0/VAL=1/TEST=2/NONE=3 (gnn.h:98-103); only
+// the on-disk tokens are shared, not the integer values.  Tokens are
+// compared whole, like the numpy fallback — no prefix acceptance.
 // ---------------------------------------------------------------------------
 
 int roc_load_mask(const char* path, int32_t* out, int64_t n) {
@@ -199,24 +254,24 @@ int roc_load_mask(const char* path, int32_t* out, int64_t n) {
   char line[64];
   for (int64_t v = 0; v < n; ++v) {
     if (!fgets(line, sizeof(line), f)) return kErrRead;
-    switch (line[0]) {
-      case 'T':
-        if (line[1] == 'r') {
-          out[v] = 1;  // Train
-        } else if (line[1] == 'e') {
-          out[v] = 3;  // Test
-        } else {
-          return kErrFormat;
-        }
-        break;
-      case 'V':
-        out[v] = 2;  // Val
-        break;
-      case 'N':
-        out[v] = 0;  // None
-        break;
-      default:
-        return kErrFormat;
+    // strip surrounding whitespace like the fallback's str.strip()
+    char* tok = line;
+    while (*tok == ' ' || *tok == '\t') ++tok;
+    size_t end = strlen(tok);
+    while (end > 0 && (tok[end - 1] == '\n' || tok[end - 1] == '\r' ||
+                       tok[end - 1] == ' ' || tok[end - 1] == '\t'))
+      --end;
+    tok[end] = '\0';
+    if (strcmp(tok, "Train") == 0) {
+      out[v] = 1;
+    } else if (strcmp(tok, "Val") == 0) {
+      out[v] = 2;
+    } else if (strcmp(tok, "Test") == 0) {
+      out[v] = 3;
+    } else if (strcmp(tok, "None") == 0) {
+      out[v] = 0;
+    } else {
+      return kErrFormat;
     }
   }
   return kOk;

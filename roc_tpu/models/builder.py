@@ -161,10 +161,14 @@ class GraphContext:
             cat = jnp.concatenate(outs + [tail], axis=0)
             out = cat[self.ell_row_pos]
         else:
-            if self.aggr_impl == "blocked":
+            if self.aggr_impl in ("blocked", "scan", "pallas"):
+                # guard every chunked-sum impl, not just 'blocked':
+                # falling through to the segment path would materialize
+                # the full [E, F] per-edge matrix — an OOM on exactly
+                # the large graphs those impls target
                 raise NotImplementedError(
-                    "AGGR_MAX has no blocked implementation; use "
-                    "aggr_impl='ell' (big graphs) or 'segment' — the "
+                    f"AGGR_MAX has no {self.aggr_impl!r} implementation; "
+                    "use aggr_impl='ell' (big graphs) or 'segment' — the "
                     "segment path materializes the full [E, F] per-edge "
                     "matrix")
             g = full[self.edge_src]

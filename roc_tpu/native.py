@@ -37,12 +37,29 @@ def _i32p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
 
 
+def _stale() -> bool:
+    """True when a previously built .so is older than its source —
+    rebuilding then keeps native tests validating current code (the
+    binary is a build artifact, never checked in).  A library pinned
+    via ROC_TPU_NATIVE is trusted as-is (the env var is an explicit
+    operator override)."""
+    if "ROC_TPU_NATIVE" in os.environ:
+        return False
+    try:
+        lib_mtime = os.path.getmtime(_LIB_PATH)
+        return any(
+            os.path.getmtime(os.path.join(_NATIVE_DIR, f)) > lib_mtime
+            for f in ("rocio.cc", "Makefile"))
+    except OSError:
+        return False
+
+
 def _load() -> Optional[ctypes.CDLL]:
     global _lib, _tried
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB_PATH):
+    if not os.path.exists(_LIB_PATH) or _stale():
         makefile = os.path.join(_NATIVE_DIR, "Makefile")
         if os.path.exists(makefile):
             try:

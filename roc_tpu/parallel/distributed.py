@@ -46,9 +46,8 @@ from ..core.partition import PartitionedGraph, partition_graph
 from ..models.builder import GraphContext, Model
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics, summarize_metrics
 from ..train.optimizer import (AdamConfig, AdamState, adam_init,
-                               adam_update, decayed_lr)
-from ..train.trainer import (TrainConfig, format_metrics,
-                             resolve_symmetric)
+                               adam_update)
+from ..train.trainer import TrainConfig, resolve_symmetric
 
 
 def make_mesh(num_parts: Optional[int] = None,
@@ -137,34 +136,33 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     parts sharding); parallel/multihost.py passes a local-shards-only
     uploader for multi-host runs."""
     sh = NamedSharding(mesh, P("parts"))
-    col_padded = remap_to_padded(pg)
-    edge_dst = np.stack([
-        np.repeat(np.arange(pg.part_nodes, dtype=np.int32),
-                  np.diff(pg.part_row_ptr[p]))
-        for p in range(pg.num_parts)])
     if put is None:
         put = lambda x: jax.device_put(x, sh)
     ell_idx = ()
     ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
-    if aggr_impl == "ell" and halo != "ring":
-        # ring mode has its own per-shard tables; the gather-mode ELL
-        # arrays would be dead weight (a second O(E) copy on device)
-        table = ell_from_padded_parts(
-            pg.part_row_ptr, col_padded, pg.real_nodes,
-            pg.part_nodes, dummy=pg.num_parts * pg.part_nodes)
-        ell_idx = tuple(put(a) for a in table.idx)
-        ell_row_pos = put(table.row_pos)
     ring_idx = ()
     ring_row_pos = put(np.zeros((pg.num_parts, 1, 1), dtype=np.int32))
     if halo == "ring":
+        # ring tables fully describe the aggregation — skip the O(E)
+        # per-edge array construction entirely and upload stubs
         from .ring import build_ring_tables
         rt = build_ring_tables(pg)
         ring_idx = tuple(put(a) for a in rt.idx)
         ring_row_pos = put(rt.row_pos)
-        # the per-edge arrays are equally dead weight in ring mode
-        # (ring tables fully describe the aggregation); upload stubs
         col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
         edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
+    else:
+        col_padded = remap_to_padded(pg)
+        edge_dst = np.stack([
+            np.repeat(np.arange(pg.part_nodes, dtype=np.int32),
+                      np.diff(pg.part_row_ptr[p]))
+            for p in range(pg.num_parts)])
+        if aggr_impl == "ell":
+            table = ell_from_padded_parts(
+                pg.part_row_ptr, col_padded, pg.real_nodes,
+                pg.part_nodes, dummy=pg.num_parts * pg.part_nodes)
+            ell_idx = tuple(put(a) for a in table.idx)
+            ell_row_pos = put(table.row_pos)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
         labels=put(pad_nodes(dataset.labels, pg)),
@@ -309,39 +307,21 @@ class DistributedTrainer:
     # ---- loop ----
 
     def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
-        import time as _time
-        from ..utils.profiling import trace
-        cfg = self.config
+        from ..train.trainer import run_epoch_loop
         d = self.data
-        epochs = epochs if epochs is not None else cfg.epochs
-        history: List[Dict[str, float]] = []
-        t_last = _time.perf_counter()
-        e_last = self.epoch
-        with trace(cfg.profile_dir):
-            for _ in range(epochs):
-                epoch = self.epoch
-                lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
-                                cfg.decay_rate, cfg.decay_steps)
-                self.key, step_key = jax.random.split(self.key)
-                self.params, self.opt_state, _ = self._train_step(
-                    self.params, self.opt_state, d.feats, d.labels,
-                    d.mask, d.edge_src, d.edge_dst, d.in_degree,
-                    d.ell_idx, d.ell_row_pos, d.ring_idx, d.ring_row_pos,
-                    step_key, lr)
-                if epoch % cfg.eval_every == 0:
-                    m = self._eval(epoch)
-                    now = _time.perf_counter()
-                    span = max(self.epoch + 1 - e_last, 1)
-                    m["epoch_ms"] = (now - t_last) * 1e3 / span
-                    self.timer.laps_ms.append(m["epoch_ms"])
-                    t_last, e_last = now, self.epoch + 1
-                    history.append(m)
-                    self.metrics_log.log(m)
-                    if cfg.verbose:
-                        print(format_metrics(epoch, m))
-                self.epoch += 1
-        self.metrics_log.close()
-        return history
+
+        def do_step(step_key, lr):
+            self.params, self.opt_state, _ = self._train_step(
+                self.params, self.opt_state, d.feats, d.labels,
+                d.mask, d.edge_src, d.edge_dst, d.in_degree,
+                d.ell_idx, d.ell_row_pos, d.ring_idx, d.ring_row_pos,
+                step_key, lr)
+
+        return run_epoch_loop(self, epochs, do_step, self.evaluate)
+
+    def sync(self) -> None:
+        """Block until all dispatched train steps have finished."""
+        jax.block_until_ready(self.params)
 
     def _eval(self, epoch: int) -> Dict[str, float]:
         d = self.data

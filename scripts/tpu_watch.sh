@@ -1,0 +1,21 @@
+#!/bin/sh
+# Background TPU-availability watcher: retry the backend claim with
+# backoff, logging the first success.  Used during development to grab
+# the (single, tunneled, sometimes-busy) chip as soon as it frees up.
+LOG=${1:-/tmp/tpu_watch.log}
+: > "$LOG"
+n=0
+while true; do
+  n=$((n + 1))
+  echo "[$(date +%H:%M:%S)] attempt $n" >> "$LOG"
+  if timeout 180 python - >> "$LOG" 2>&1 <<'EOF'
+import jax
+ds = jax.devices()
+print("CLAIMED:", [(d.platform, d.device_kind) for d in ds])
+EOF
+  then
+    echo "[$(date +%H:%M:%S)] TPU AVAILABLE" >> "$LOG"
+    exit 0
+  fi
+  sleep 60
+done
