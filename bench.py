@@ -3,52 +3,144 @@
 
 Protocol (BASELINE.md): the reference repo publishes no numbers, so the
 recorded baseline is the reference's canonical workload shape — the
-2-layer 602-256-41 GCN on Reddit (232,965 nodes, ~114.6M edges with self
+2-layer 602-256-41 GCN on Reddit (232,965 nodes, ~114.8M edges with self
 edges, ``example_run.sh:1`` / ``test.sh:8``) — run full-graph,
 full-batch with dropout 0.5, Adam, masked softmax-CE, exactly like
-``gnn.cc:99-111``'s epoch loop.  Since real Reddit data is not available
-in this sandbox, a deterministic synthetic graph with matched V/E/degree
-skew is used; epoch time is independent of edge identity.
+``gnn.cc:99-111``'s epoch loop.  When real Reddit data is not available,
+a deterministic synthetic graph with matched V/E/degree skew is used;
+epoch time is independent of edge identity.
 
 Prints ONE JSON line on stdout:
   {"metric": ..., "value": ..., "unit": "ms", "vs_baseline": ...}
 
-vs_baseline: ratio of the round-1 recorded epoch time (BASELINE_EPOCH_MS,
-our own first measurement on a v5e chip — see BASELINE.md) to this run's
-epoch time; >1.0 means faster than the recorded baseline.
+vs_baseline: ratio of the recorded baseline epoch time for this metric
+(benchmarks/measured_baselines.json — a real prior measurement on this
+hardware, recorded with provenance) to this run's; >1.0 is faster.  If
+no baseline has been recorded yet, vs_baseline is 1.0 and the line
+carries "baseline": "unrecorded".
+
+Robustness (the TPU is reached through a single-claim tunnel that can be
+busy or transiently unavailable): the default entry point is a PARENT
+process that runs the real benchmark in a child subprocess under a hard
+timeout with bounded retries + backoff, and emits a parseable failure
+JSON line instead of a traceback if every attempt fails.  The child is
+terminated with SIGTERM, never SIGKILL — hard-killing a claim holder can
+wedge the tunnel relay for subsequent processes.
 """
 
 import argparse
 import json
+import os
+import signal
+import subprocess
 import sys
 import time
 
 import numpy as np
 
 
-# Round-1 recorded epoch time on one TPU v5e chip (ms).  Updated whenever
-# the protocol or hardware changes; see BASELINE.md.
-BASELINE_EPOCH_MS = 1600.0
-
 REDDIT_NODES = 232_965
 REDDIT_EDGES = 114_848_857  # 114,615,892 + 232,965 self edges
 
+METRIC = "full_graph_gcn_reddit_scale_epoch_time"
 
-def main():
+_BASELINES_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "benchmarks", "measured_baselines.json")
+
+
+def build_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=REDDIT_NODES)
     ap.add_argument("--edges", type=int, default=REDDIT_EDGES)
     ap.add_argument("--layers", type=str, default="602-256-41")
-    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--epochs", type=int, default=10)
     ap.add_argument("--chunk", type=int, default=512)
-    ap.add_argument("--impl", type=str, default="blocked")
+    # ell is the production default for big graphs (CLI default too,
+    # roc_tpu/train/cli.py); 'blocked' would time a serial-scan path
+    # the real training runs never use
+    ap.add_argument("--impl", type=str, default="ell")
     ap.add_argument("--dtype", type=str, default="float32")
     ap.add_argument("--small", action="store_true",
                     help="tiny smoke-test scale (CI / CPU)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (skip the TPU claim)")
-    args = ap.parse_args()
+    ap.add_argument("--child", action="store_true",
+                    help="run the benchmark body in this process "
+                         "(internal; the default parent mode wraps it "
+                         "in timeout+retry)")
+    ap.add_argument("--timeout", type=float, default=1500.0,
+                    help="per-attempt wall-clock limit (s)")
+    ap.add_argument("--retries", type=int, default=2,
+                    help="extra attempts after the first failure")
+    ap.add_argument("--backoff", type=float, default=60.0,
+                    help="initial delay between attempts (s), doubled "
+                         "each retry")
+    return ap
 
+
+def _read_baseline():
+    """Recorded prior measurement for this metric, or None."""
+    try:
+        with open(_BASELINES_PATH) as f:
+            entry = json.load(f).get(METRIC)
+        return float(entry["epoch_ms"]), entry
+    except (OSError, KeyError, TypeError, ValueError):
+        return None, None
+
+
+def failure_json(error: str, attempts: int) -> str:
+    return json.dumps({
+        "metric": METRIC,
+        "value": None,
+        "unit": "ms",
+        "vs_baseline": None,
+        "error": error,
+        "attempts": attempts,
+    })
+
+
+def parent(args, argv) -> int:
+    """Retry/timeout supervisor around the child benchmark process."""
+    attempts = args.retries + 1
+    delay = args.backoff
+    err = "unknown"
+    for n in range(attempts):
+        print(f"# attempt {n + 1}/{attempts} (timeout {args.timeout:.0f}s)",
+              file=sys.stderr)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child"] + argv,
+            stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+        try:
+            out, _ = proc.communicate(timeout=args.timeout)
+            if proc.returncode == 0:
+                for line in reversed(out.splitlines()):
+                    line = line.strip()
+                    if line.startswith("{"):
+                        print(line)
+                        return 0
+                err = "child exited 0 without a JSON line"
+            else:
+                err = f"child exited rc={proc.returncode}"
+        except subprocess.TimeoutExpired:
+            # SIGTERM only: SIGKILL on a TPU-claim holder can wedge the
+            # tunnel relay for every subsequent process
+            proc.terminate()
+            try:
+                proc.communicate(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.communicate()
+            err = f"timeout after {args.timeout:.0f}s"
+        print(f"# attempt {n + 1} failed: {err}", file=sys.stderr)
+        if n < attempts - 1:
+            print(f"# backing off {delay:.0f}s", file=sys.stderr)
+            time.sleep(delay)
+            delay *= 2
+    print(failure_json(err, attempts))
+    return 1
+
+
+def child(args) -> None:
     if args.small:
         args.nodes, args.edges = 2048, 32768
 
@@ -56,16 +148,15 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    from roc_tpu.core.graph import random_csr
-    from roc_tpu.core.partition import padded_edge_list
-    from roc_tpu.models.builder import GraphContext
+    from roc_tpu.core.graph import Dataset, random_csr
     from roc_tpu.models.gcn import build_gcn
-    from roc_tpu.core.graph import Dataset, MASK_TRAIN
     from roc_tpu.train.trainer import TrainConfig, Trainer
 
     layers = [int(x) for x in args.layers.split("-")]
+    t0 = time.time()
     dev = jax.devices()[0]
-    print(f"# device: {dev.platform} {dev.device_kind}", file=sys.stderr)
+    print(f"# device: {dev.platform} {dev.device_kind} "
+          f"(claim {time.time() - t0:.1f}s)", file=sys.stderr)
 
     t0 = time.time()
     graph = random_csr(args.nodes, args.edges, seed=0)
@@ -93,30 +184,48 @@ def main():
     t0 = time.time()
     trainer = Trainer(model, ds, cfg)
     trainer.epoch = 1  # skip the epoch-0 eval trigger
-    # warmup: compile + 1 step
-    trainer.train(epochs=1)
-    jax.block_until_ready(trainer.params)
+    # warmup: compile + 2 steps
+    trainer.train(epochs=2)
+    trainer.sync()
     print(f"# compile+warmup: {time.time()-t0:.1f}s", file=sys.stderr)
 
     times = []
     for _ in range(args.epochs):
         t0 = time.time()
         trainer.train(epochs=1)
-        jax.block_until_ready(trainer.params)
+        trainer.sync()
         times.append((time.time() - t0) * 1000.0)
     epoch_ms = float(np.median(times))
-    print(f"# epoch times (ms): {[round(t,1) for t in times]}",
+    print(f"# epoch times (ms): {[round(t, 1) for t in times]}",
           file=sys.stderr)
     m = trainer.evaluate()
     print(f"# final train_acc={m['train_acc']:.3f} "
           f"test_acc={m['test_acc']:.3f}", file=sys.stderr)
 
-    print(json.dumps({
-        "metric": "full_graph_gcn_reddit_scale_epoch_time",
+    baseline_ms, entry = _read_baseline()
+    result = {
+        "metric": METRIC,
         "value": round(epoch_ms, 2),
         "unit": "ms",
-        "vs_baseline": round(BASELINE_EPOCH_MS / epoch_ms, 3),
-    }))
+        "vs_baseline": (round(baseline_ms / epoch_ms, 3)
+                        if baseline_ms else 1.0),
+    }
+    if baseline_ms is None:
+        result["baseline"] = "unrecorded"
+    else:
+        result["baseline_ms"] = baseline_ms
+        result["baseline_recorded"] = entry.get("recorded", "?")
+    print(json.dumps(result))
+
+
+def main():
+    ap = build_parser()
+    args = ap.parse_args()
+    if args.child:
+        child(args)
+        return
+    argv = [a for a in sys.argv[1:] if a != "--child"]
+    sys.exit(parent(args, argv))
 
 
 if __name__ == "__main__":

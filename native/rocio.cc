@@ -238,6 +238,94 @@ int roc_load_features_csv(const char* path, float* out, int64_t rows,
   return (rc == kOk && i == total) ? kOk : (rc != kOk ? rc : kErrFormat);
 }
 
+// Partition-local CSV read: skip `row_lo` newline-terminated lines,
+// then parse (row_hi - row_lo) * cols floats.  The skip scans chunks
+// counting '\n' without tokenizing — the reference loader's
+// skip-to-rowLeft behavior (load_task.cu:41-51) for text features.
+int roc_load_features_csv_rows(const char* path, float* out,
+                               int64_t row_lo, int64_t row_hi,
+                               int64_t cols) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return kErrOpen;
+  FileCloser closer{f};
+  constexpr size_t kBuf = size_t{1} << 22;
+  char* buf = (char*)malloc(kBuf + 1);
+  if (!buf) return kErrRead;
+  // Phase 1: skip row_lo lines.
+  int64_t lines = 0;
+  size_t resume = 0;  // unconsumed bytes at buf start for phase 2
+  size_t len = 0;
+  char* p = nullptr;
+  while (lines < row_lo) {
+    len = fread(buf, 1, kBuf, f);
+    if (len == 0) {
+      free(buf);
+      return ferror(f) ? kErrRead : kErrFormat;  // fewer lines than rows
+    }
+    p = buf;
+    char* const lim = buf + len;
+    while (p < lim && lines < row_lo) {
+      char* nl = (char*)memchr(p, '\n', (size_t)(lim - p));
+      if (!nl) {
+        p = lim;
+        break;
+      }
+      ++lines;
+      p = nl + 1;
+    }
+    if (lines == row_lo) {
+      resume = (size_t)(buf + len - p);
+      memmove(buf, p, resume);
+      break;
+    }
+  }
+  // Phase 2: parse exactly (row_hi - row_lo) * cols values, reusing the
+  // chunked tokenizer with the carried tail.
+  const int64_t total = (row_hi - row_lo) * cols;
+  int64_t i = 0;
+  size_t carry = resume;
+  int rc = kOk;
+  while (i < total) {
+    size_t got = fread(buf + carry, 1, kBuf - carry, f);
+    if (got == 0 && ferror(f)) {
+      free(buf);
+      return kErrRead;
+    }
+    size_t n = carry + got;
+    const bool eof = got == 0;
+    carry = 0;
+    char* q = buf;
+    char* const lim = buf + n;
+    while (q < lim && i < total) {
+      if (is_csv_sep(*q)) {
+        ++q;
+        continue;
+      }
+      char* tok = q;
+      while (q < lim && !is_csv_sep(*q)) ++q;
+      if (q == lim && !eof) {
+        carry = (size_t)(lim - tok);
+        if (carry == kBuf) {
+          rc = kErrFormat;
+        } else {
+          memmove(buf, tok, carry);
+        }
+        break;
+      }
+      float v;
+      if (!parse_float_tok(tok, q, &v)) {
+        rc = kErrFormat;
+        break;
+      }
+      out[i++] = v;
+    }
+    if (rc != kOk || (eof && i < total)) break;
+  }
+  free(buf);
+  if (rc != kOk) return rc;
+  return i == total ? kOk : kErrFormat;
+}
+
 // ---------------------------------------------------------------------------
 // Mask parser: one of "Train"/"Val"/"Test"/"None" per line -> int32
 // {1, 2, 3, 0} — the framework's MASK_* encoding (roc_tpu/core/graph.py

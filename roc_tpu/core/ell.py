@@ -106,44 +106,74 @@ def build_ell(local_row_ptr: np.ndarray, col_idx: np.ndarray,
     return buckets
 
 
+def ell_shape_plan(part_in_degree: np.ndarray, real_nodes: np.ndarray,
+                   min_width: int = 8) -> Tuple[Tuple[int, ...], dict]:
+    """Global uniform bucket shapes from degrees alone (O(V) metadata —
+    no column data), so multi-host processes can each build only their
+    own partitions' tables (:func:`place_ell_part`) and still agree on
+    the SPMD-required identical shapes.
+
+    Returns ``(widths, rows_per_width)`` where ``rows_per_width[w]`` is
+    the max row count of bucket ``w`` over all partitions (floored at
+    1 so shapes always exist)."""
+    counts: dict = {}
+    for p in range(part_in_degree.shape[0]):
+        n = int(real_nodes[p])
+        if n == 0:
+            continue
+        w = row_widths(part_in_degree[p, :n], min_width)
+        for wv, c in zip(*np.unique(w[w > 0], return_counts=True)):
+            counts[int(wv)] = max(counts.get(int(wv), 0), int(c))
+    widths = tuple(sorted(counts)) or (min_width,)
+    return widths, {w: max(counts.get(w, 0), 1) for w in widths}
+
+
+def place_ell_part(buckets: dict, widths: Tuple[int, ...],
+                   rows_per_width: dict, part_nodes: int,
+                   dummy: int) -> Tuple[list, np.ndarray]:
+    """Place one partition's buckets (from :func:`build_ell`) into the
+    globally planned uniform shapes.  Returns ``(idx_arrays, row_pos)``
+    with one int32 [rows_w, w] array per width and int32 [part_nodes]
+    output positions (zero slot == total planned rows)."""
+    idx_arrays = []
+    total_rows = sum(rows_per_width[w] for w in widths)
+    row_pos = np.full(part_nodes, total_rows, dtype=np.int32)
+    offset = 0
+    for w in widths:
+        R = rows_per_width[w]
+        arr = np.full((R, w), dummy, dtype=np.int32)
+        if w in buckets:
+            rows, idx = buckets[w]
+            n = rows.shape[0]
+            assert n <= R, (w, n, R)
+            arr[:n] = np.where(idx >= 0, idx, dummy)
+            row_pos[rows] = offset + np.arange(n, dtype=np.int32)
+        idx_arrays.append(arr)
+        offset += R
+    return idx_arrays, row_pos
+
+
 def stack_ell(per_part_buckets: Sequence[dict], part_nodes: int,
               dummy: int) -> EllTable:
     """Unify bucket structure across partitions and stack into the
     equal-shape arrays shard_map needs."""
     P = len(per_part_buckets)
     widths = sorted({w for b in per_part_buckets for w in b})
-    if not widths:
-        widths = [8]
     rows_per_width = {
         w: max((b[w][0].shape[0] if w in b else 0
                 for b in per_part_buckets), default=0)
         for w in widths}
     # drop empty widths, keep at least one so shapes exist
-    widths = [w for w in widths if rows_per_width[w] > 0] or [widths[0]]
+    widths = tuple(w for w in widths if rows_per_width[w] > 0) or (8,)
+    rows_per_width = {w: max(rows_per_width.get(w, 0), 1) for w in widths}
 
-    idx_arrays = []
-    for w in widths:
-        R = max(rows_per_width[w], 1)
-        arr = np.full((P, R, w), dummy, dtype=np.int32)
-        idx_arrays.append(arr)
-
-    total_rows = sum(max(rows_per_width[w], 1) for w in widths)
-    # trailing zero slot for degree-0 rows
-    row_pos = np.full((P, part_nodes), total_rows, dtype=np.int32)
-
-    for p, b in enumerate(per_part_buckets):
-        offset = 0
-        for wi, w in enumerate(widths):
-            R = max(rows_per_width[w], 1)
-            if w in b:
-                rows, idx = b[w]
-                n = rows.shape[0]
-                block = idx_arrays[wi][p]
-                block[:n] = np.where(idx >= 0, idx, dummy)
-                row_pos[p, rows] = offset + np.arange(n, dtype=np.int32)
-            offset += R
-    return EllTable(widths=tuple(widths), idx=tuple(idx_arrays),
-                    row_pos=row_pos)
+    per_part = [place_ell_part(b, widths, rows_per_width, part_nodes,
+                               dummy) for b in per_part_buckets]
+    idx_arrays = tuple(
+        np.stack([per_part[p][0][wi] for p in range(P)])
+        for wi in range(len(widths)))
+    row_pos = np.stack([per_part[p][1] for p in range(P)])
+    return EllTable(widths=widths, idx=idx_arrays, row_pos=row_pos)
 
 
 def ell_from_padded_parts(part_row_ptr: np.ndarray,

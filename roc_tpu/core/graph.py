@@ -128,6 +128,55 @@ def check_symmetric(graph: Graph) -> bool:
 # .lux binary format
 # ---------------------------------------------------------------------------
 
+def _read_slice(f, offset: int, count: int, dtype: str) -> np.ndarray:
+    """Seek + read a typed slice.  All partition-local binary reads go
+    through here so tests can spy on exactly which byte ranges a host
+    touches (the reference's per-partition loader contract,
+    ``load_task.cu:41-51,201-245``)."""
+    f.seek(offset)
+    out = np.fromfile(f, dtype=dtype, count=count)
+    assert out.size == count, f"truncated read at {offset} (+{count})"
+    return out
+
+
+def load_lux_header(path: str) -> tuple:
+    """(num_nodes, num_edges) from a `.lux` header without reading the
+    body."""
+    with open(path, "rb") as f:
+        return struct.unpack("<IQ", f.read(12))
+
+
+def load_lux_rows(path: str, row_lo: int, row_hi: int) -> tuple:
+    """Partition-local `.lux` read: only rows ``[row_lo, row_hi)``.
+
+    Reads the (row_hi - row_lo + 1)-entry offset slice and exactly the
+    partition's column-index bytes — the reference loader's skip-to-
+    rowLeft behavior (``load_task.cu:41-51,201-245``) — instead of the
+    whole file.  Returns ``(local_row_ptr, col_idx)`` with
+    ``local_row_ptr`` int64 [n+1] rebased to 0.
+    """
+    num_nodes, num_edges = load_lux_header(path)
+    assert 0 <= row_lo <= row_hi <= num_nodes, (row_lo, row_hi, num_nodes)
+    n = row_hi - row_lo
+    header = 12
+    with open(path, "rb") as f:
+        # offsets are u64 *inclusive ends*; row v's edges end at off[v]
+        # and start at off[v-1] (0 for v == 0)
+        lo_off = 0 if row_lo == 0 else int(_read_slice(
+            f, header + (row_lo - 1) * 8, 1, "<u8")[0])
+        if n == 0:
+            return np.zeros(1, dtype=np.int64), np.zeros(0, dtype=np.int32)
+        ends = _read_slice(f, header + row_lo * 8, n, "<u8").astype(
+            np.int64)
+        assert (np.diff(ends) >= 0).all() and ends[0] >= lo_off
+        col_base = header + num_nodes * 8
+        e0, e1 = lo_off, int(ends[-1])
+        col = _read_slice(f, col_base + e0 * 4, e1 - e0, "<u4")
+    local_ptr = np.zeros(n + 1, dtype=np.int64)
+    local_ptr[1:] = ends - lo_off
+    return local_ptr, col.astype(np.int32)
+
+
 def load_lux(path: str) -> Graph:
     """Read a `.lux` binary graph (reference format, ``gnn.cc:756-801``):
     u32 num_nodes, u64 num_edges, num_nodes x u64 inclusive-end row
@@ -213,13 +262,34 @@ def from_edge_list(src: np.ndarray, dst: np.ndarray, num_nodes: int,
 # Feature / label / mask loaders (reference load_task.cu:25-199)
 # ---------------------------------------------------------------------------
 
-def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
+def load_features(prefix: str, num_nodes: int, in_dim: int,
+                  rows: Optional[tuple] = None) -> np.ndarray:
     """Load ``<prefix>.feats.csv`` (one comma-separated row per vertex),
     caching a ``.feats.bin`` float32 binary alongside exactly like
-    ``load_task.cu:41-73``.  Returns float32 ``[num_nodes, in_dim]``."""
+    ``load_task.cu:41-73``.  Returns float32 ``[num_nodes, in_dim]``.
+
+    ``rows=(lo, hi)`` reads only that half-open row range — from the
+    ``.bin`` cache it is an exact byte-range read (the reference's
+    per-partition skip-to-rowLeft, ``load_task.cu:41-51``); from the CSV
+    the native parser line-skips to ``lo``, and the numpy fallback
+    parses only the needed lines."""
     from .. import native
     bin_path = prefix + ".feats.bin"
     csv_path = prefix + ".feats.csv"
+    if rows is not None:
+        lo, hi = rows
+        assert 0 <= lo <= hi <= num_nodes
+        if os.path.exists(bin_path):
+            with open(bin_path, "rb") as f:
+                data = _read_slice(f, lo * in_dim * 4, (hi - lo) * in_dim,
+                                   np.float32)
+            return data.reshape(hi - lo, in_dim)
+        if native.available():
+            return native.load_features_csv_rows(csv_path, lo, hi, in_dim)
+        data = np.loadtxt(_iter_lines(csv_path, lo, hi), delimiter=",",
+                          dtype=np.float32, ndmin=2)
+        assert data.shape == (hi - lo, in_dim), data.shape
+        return data
     if os.path.exists(bin_path):
         data = np.fromfile(bin_path, dtype=np.float32,
                            count=num_nodes * in_dim)
@@ -234,30 +304,51 @@ def load_features(prefix: str, num_nodes: int, in_dim: int) -> np.ndarray:
     return data
 
 
-def load_labels(prefix: str, num_nodes: int, num_classes: int) -> np.ndarray:
+def _iter_lines(path: str, lo: int, hi: int):
+    """Yield lines [lo, hi) of a text file (the numpy-fallback line
+    skip for partition-local CSV/label/mask reads)."""
+    import itertools
+    with open(path) as f:
+        yield from itertools.islice(f, lo, hi)
+
+
+def load_labels(prefix: str, num_nodes: int, num_classes: int,
+                rows: Optional[tuple] = None) -> np.ndarray:
     """Load ``<prefix>.label`` (one class index per line,
-    ``load_task.cu:118-123``).  Returns int32 ``[num_nodes]``; one-hot is
-    formed on device by the loss."""
-    labels = np.loadtxt(prefix + ".label", dtype=np.int64)[:num_nodes]
-    assert labels.shape[0] == num_nodes
+    ``load_task.cu:118-123``).  Returns int32 ``[num_nodes]`` (or the
+    ``rows=(lo, hi)`` slice); one-hot is formed on device by the loss."""
+    if rows is not None:
+        lo, hi = rows
+        labels = np.loadtxt(_iter_lines(prefix + ".label", lo, hi),
+                            dtype=np.int64, ndmin=1)
+        n = hi - lo
+    else:
+        labels = np.loadtxt(prefix + ".label", dtype=np.int64,
+                            ndmin=1)[:num_nodes]
+        n = num_nodes
+    assert labels.shape[0] == n
     assert ((labels >= 0) & (labels < num_classes)).all()
     return labels.astype(np.int32)
 
 
-def load_mask(prefix: str, num_nodes: int) -> np.ndarray:
+def load_mask(prefix: str, num_nodes: int,
+              rows: Optional[tuple] = None) -> np.ndarray:
     """Load ``<prefix>.mask`` ("Train"/"Val"/"Test"/"None" per line,
-    ``load_task.cu:169-183``).  Returns int32 ``[num_nodes]`` with
-    MASK_* values."""
+    ``load_task.cu:169-183``).  Returns int32 ``[num_nodes]`` (or the
+    ``rows=(lo, hi)`` slice) with MASK_* values."""
     from .. import native
-    if native.available():
+    if rows is None and native.available():
         return native.load_mask(prefix + ".mask", num_nodes)
-    out = np.empty(num_nodes, dtype=np.int32)
-    with open(prefix + ".mask") as f:
-        for v in range(num_nodes):
-            line = f.readline().strip()
-            if line not in _MASK_NAMES:
-                raise ValueError(f"Unrecognized mask: {line!r}")
-            out[v] = _MASK_NAMES[line]
+    lo, hi = rows if rows is not None else (0, num_nodes)
+    out = np.empty(hi - lo, dtype=np.int32)
+    if hi == lo:
+        return out
+    for i, line in enumerate(_iter_lines(prefix + ".mask", lo, hi)):
+        line = line.strip()
+        if line not in _MASK_NAMES:
+            raise ValueError(f"Unrecognized mask: {line!r}")
+        out[i] = _MASK_NAMES[line]
+    assert i == hi - lo - 1, "truncated .mask"
     return out
 
 
@@ -275,6 +366,26 @@ class Dataset:
     @property
     def in_dim(self) -> int:
         return int(self.features.shape[1])
+
+
+def save_dataset(ds: "Dataset", prefix: str, csv: bool = True,
+                 feats_bin: bool = True) -> None:
+    """Write a dataset in the reference on-disk layout (the format
+    ``load_task.cu:25-199`` consumes): ``<prefix>.add_self_edge.lux``,
+    ``.feats.csv`` and/or ``.feats.bin``, ``.label``, ``.mask``.  The
+    graph is written as-is — callers ensure self edges are present
+    (``add_self_edges``) to honor the filename's contract."""
+    save_lux(ds.graph, prefix + ".add_self_edge.lux")
+    if csv:
+        np.savetxt(prefix + ".feats.csv", ds.features, delimiter=",",
+                   fmt="%.7g")
+    if feats_bin:
+        ds.features.astype(np.float32).tofile(prefix + ".feats.bin")
+    np.savetxt(prefix + ".label", ds.labels, fmt="%d")
+    names = {v: k for k, v in _MASK_NAMES.items()}
+    with open(prefix + ".mask", "w") as f:
+        for m in ds.mask:
+            f.write(names[int(m)] + "\n")
 
 
 def load_dataset(prefix: str, in_dim: int, num_classes: int,

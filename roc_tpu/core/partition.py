@@ -65,10 +65,13 @@ def _round_up(x: int, m: int) -> int:
 
 
 @dataclass
-class PartitionedGraph:
-    """A graph split into ``num_parts`` equal-shaped shards for a 1-D
-    device mesh.  All per-part arrays are stacked on a leading parts axis
-    so they shard cleanly with ``NamedSharding(P('parts'))``.
+class PartitionPlan:
+    """Partition metadata computable from ``row_ptr`` alone — O(V), no
+    edge data.  Each host derives the full plan cheaply (the offsets
+    section of a `.lux` is ~8 bytes/vertex) and then loads/builds ONLY
+    its own partitions' O(E/P) column data (:func:`partition_col`),
+    matching the reference's per-partition loader tasks
+    (``load_task.cu:201-245``).
 
     Conventions:
       - ``part_row_ptr[p]`` is a *local* CSR over the part's padded rows:
@@ -79,9 +82,6 @@ class PartitionedGraph:
         rely on "a chunk of C sorted edges spans <= C rows".  Padding
         edges point at the dummy zero-feature source, so a real last row
         absorbing them just adds zeros.
-      - ``part_col_idx[p]`` holds *global* source ids; padding edges point
-        at the dummy source id ``num_nodes`` (a zero feature row appended
-        by the training layer).
       - ``node_offset[p]`` is the global id of the part's first row;
         global row ``g`` lives at part ``p``, local row ``g - node_offset[p]``.
     """
@@ -96,7 +96,6 @@ class PartitionedGraph:
     real_nodes: np.ndarray       # int32 [P] un-padded row counts
     real_edges: np.ndarray       # int64 [P]
     part_row_ptr: np.ndarray     # int32 [P, part_nodes+1] local offsets
-    part_col_idx: np.ndarray     # int32 [P, part_edges] global src ids
     part_in_degree: np.ndarray   # int32 [P, part_nodes] real in-degrees
 
     @property
@@ -109,6 +108,13 @@ class PartitionedGraph:
         """Global source id used by padding edges; its feature row must be
         zero."""
         return self.num_nodes
+
+    def edge_range(self, p: int) -> Tuple[int, int]:
+        """Global [e0, e1) edge extent of partition ``p``'s real edges
+        (parts cover contiguous vertex ranges in order, so their edges
+        are consecutive in global CSR order)."""
+        e0 = int(self.real_edges[:p].sum())
+        return e0, e0 + int(self.real_edges[p])
 
     def local_to_global(self) -> np.ndarray:
         """int32 [P, part_nodes] map of padded local rows to global node
@@ -128,6 +134,20 @@ class PartitionedGraph:
         return self.local_to_global().reshape(-1)
 
 
+@dataclass
+class PartitionedGraph(PartitionPlan):
+    """A :class:`PartitionPlan` plus every partition's column data —
+    the fully materialized form used single-process (multi-host code
+    keeps only local parts' columns via :func:`partition_col`).
+
+    ``part_col_idx[p]`` holds *global* source ids; padding edges point
+    at the dummy source id ``num_nodes`` (a zero feature row appended
+    by the training layer).
+    """
+
+    part_col_idx: np.ndarray     # int32 [P, part_edges] global src ids
+
+
 def padded_edge_list(graph: Graph, multiple: int = 1024
                      ) -> Tuple[np.ndarray, np.ndarray]:
     """Single-device analog of the partition padding: return
@@ -145,17 +165,21 @@ def padded_edge_list(graph: Graph, multiple: int = 1024
     return src, dst
 
 
-def partition_graph(graph: Graph, num_parts: int,
-                    node_multiple: int = 8,
-                    edge_multiple: int = 128) -> PartitionedGraph:
-    """Partition ``graph`` into ``num_parts`` equal-shaped padded shards
-    using the reference's edge-balanced greedy bounds."""
-    bounds = edge_balanced_bounds(graph.row_ptr, num_parts)
-    V, E = graph.num_nodes, graph.num_edges
+def partition_plan(row_ptr: np.ndarray, num_parts: int,
+                   node_multiple: int = 8,
+                   edge_multiple: int = 128) -> PartitionPlan:
+    """Everything about the partitioning derivable from the global row
+    pointers alone (bounds, padded shapes, local row CSRs, degrees) —
+    the O(V) metadata every host computes; column data is loaded
+    per-partition afterwards (:func:`partition_col`)."""
+    row_ptr = np.asarray(row_ptr, dtype=np.int64)
+    bounds = edge_balanced_bounds(row_ptr, num_parts)
+    V = row_ptr.shape[0] - 1
+    E = int(row_ptr[-1])
     real_nodes = np.array([max(r - l + 1, 0) for l, r in bounds],
                           dtype=np.int32)
     real_edges = np.array(
-        [int(graph.row_ptr[r + 1] - graph.row_ptr[l]) if r >= l else 0
+        [int(row_ptr[r + 1] - row_ptr[l]) if r >= l else 0
          for l, r in bounds], dtype=np.int64)
     part_nodes = _round_up(max(int(real_nodes.max()), 1), node_multiple)
     part_edges = _round_up(max(int(real_edges.max()), 1), edge_multiple)
@@ -163,18 +187,15 @@ def partition_graph(graph: Graph, num_parts: int,
     node_offset = np.array([l for l, _ in bounds], dtype=np.int32)
     node_offset = np.minimum(node_offset, V)  # empty tail parts
     part_row_ptr = np.zeros((num_parts, part_nodes + 1), dtype=np.int32)
-    part_col_idx = np.full((num_parts, part_edges), V, dtype=np.int32)
     part_in_degree = np.zeros((num_parts, part_nodes), dtype=np.int32)
-
     for p, (l, r) in enumerate(bounds):
         if r < l:
             # empty part: every edge is padding; row 0 absorbs them all.
             part_row_ptr[p, 1:] = part_edges
             continue
         n = r - l + 1
-        e0 = int(graph.row_ptr[l])
-        e1 = int(graph.row_ptr[r + 1])
-        local_ptr = (graph.row_ptr[l:r + 2] - e0).astype(np.int32)
+        e0 = int(row_ptr[l])
+        local_ptr = (row_ptr[l:r + 2] - e0).astype(np.int32)
         part_row_ptr[p, :n + 1] = local_ptr
         # Padding edges attach immediately after the real edges, on the
         # first padded row (local row n) — or, when n == part_nodes, on
@@ -182,12 +203,38 @@ def partition_graph(graph: Graph, num_parts: int,
         # zero feature row.  Every row after that has zero edges, so
         # part_row_ptr[-1] == part_edges always holds.
         part_row_ptr[p, min(n, part_nodes - 1) + 1:] = part_edges
-        part_col_idx[p, :e1 - e0] = graph.col_idx[e0:e1]
-        part_in_degree[p, :n] = np.diff(graph.row_ptr[l:r + 2])
-
-    return PartitionedGraph(
+        part_in_degree[p, :n] = np.diff(row_ptr[l:r + 2])
+    return PartitionPlan(
         num_nodes=V, num_edges=E, num_parts=num_parts,
         part_nodes=part_nodes, part_edges=part_edges, bounds=bounds,
         node_offset=node_offset, real_nodes=real_nodes,
         real_edges=real_edges, part_row_ptr=part_row_ptr,
-        part_col_idx=part_col_idx, part_in_degree=part_in_degree)
+        part_in_degree=part_in_degree)
+
+
+def partition_col(plan: PartitionPlan, col_slice, p: int) -> np.ndarray:
+    """One partition's padded column array (int32 [part_edges], global
+    source ids, padding == num_nodes).  ``col_slice(e0, e1)`` returns
+    the global ``col_idx[e0:e1]`` — a memory view single-process, a
+    seek+read for file-backed hosts — so a host materializes only its
+    own partitions' O(E/P) edges (reference ``load_task.cu:201-245``)."""
+    out = np.full(plan.part_edges, plan.num_nodes, dtype=np.int32)
+    e0, e1 = plan.edge_range(p)
+    if e1 > e0:
+        out[:e1 - e0] = col_slice(e0, e1)
+    return out
+
+
+def partition_graph(graph: Graph, num_parts: int,
+                    node_multiple: int = 8,
+                    edge_multiple: int = 128) -> PartitionedGraph:
+    """Partition ``graph`` into ``num_parts`` equal-shaped padded shards
+    using the reference's edge-balanced greedy bounds — the fully
+    materialized single-process form (plan + every part's columns)."""
+    plan = partition_plan(graph.row_ptr, num_parts,
+                          node_multiple=node_multiple,
+                          edge_multiple=edge_multiple)
+    col_slice = lambda e0, e1: graph.col_idx[e0:e1]
+    part_col_idx = np.stack([partition_col(plan, col_slice, p)
+                             for p in range(num_parts)])
+    return PartitionedGraph(**vars(plan), part_col_idx=part_col_idx)

@@ -1,0 +1,145 @@
+"""Partition-local dataset sources.
+
+The reference never loads whole tensors on every node: each partition's
+loader task reads only its ``[rowLeft, rowRight]`` slice of the graph,
+features, labels and mask (``load_task.cu:41-51`` skips to rowLeft;
+``load_task.cu:201-245`` does per-partition binary reads).  A
+:class:`DataSource` is the same contract for this framework: row-sliced
+accessors that a multi-host ``shard_dataset_local`` drives so a host
+materializes only its own partitions' O(V/P + E/P) data.
+
+Two implementations:
+
+- :class:`ArraySource` — wraps an in-memory :class:`Dataset` (slices are
+  views; the degenerate single-host case, and what tests use).
+- :class:`FileSource` — reads the reference on-disk layout
+  (``.lux``/``.feats.csv|.bin``/``.label``/``.mask``) with seek-based
+  slice reads (``core/graph.py`` row-sliced loaders), never touching
+  bytes outside the requested rows except the O(V) `.lux` offset
+  section every host needs for partition bounds.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from . import graph as _graph
+from .graph import (Dataset, Graph, load_features, load_labels,
+                    load_lux_header, load_mask)
+
+
+class DataSource:
+    """Row-sliced access to one dataset.  All ranges are half-open."""
+
+    num_nodes: int
+    num_edges: int
+    in_dim: int
+    num_classes: int
+
+    def row_ptr(self) -> np.ndarray:
+        """Global int64 [V+1] CSR row pointers (O(V) — the one global
+        structure every host reads, for partition bounds)."""
+        raise NotImplementedError
+
+    def col_slice(self, e0: int, e1: int) -> np.ndarray:
+        """Global source ids of edges [e0, e1)."""
+        raise NotImplementedError
+
+    def features(self, lo: int, hi: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def labels(self, lo: int, hi: int) -> np.ndarray:
+        raise NotImplementedError
+
+    def mask(self, lo: int, hi: int) -> np.ndarray:
+        raise NotImplementedError
+
+
+@dataclass
+class ArraySource(DataSource):
+    """In-memory dataset as a row-sliced source (slices are views)."""
+
+    dataset: Dataset
+
+    def __post_init__(self):
+        self.num_nodes = self.dataset.graph.num_nodes
+        self.num_edges = self.dataset.graph.num_edges
+        self.in_dim = self.dataset.in_dim
+        self.num_classes = self.dataset.num_classes
+
+    def row_ptr(self) -> np.ndarray:
+        return self.dataset.graph.row_ptr
+
+    def col_slice(self, e0: int, e1: int) -> np.ndarray:
+        return self.dataset.graph.col_idx[e0:e1]
+
+    def features(self, lo: int, hi: int) -> np.ndarray:
+        return self.dataset.features[lo:hi]
+
+    def labels(self, lo: int, hi: int) -> np.ndarray:
+        return self.dataset.labels[lo:hi]
+
+    def mask(self, lo: int, hi: int) -> np.ndarray:
+        return self.dataset.mask[lo:hi]
+
+
+class FileSource(DataSource):
+    """Reference-layout on-disk dataset with seek-based slice reads.
+
+    ``prefix`` follows ``load_dataset``: ``<prefix>.add_self_edge.lux``
+    (or ``<prefix>.lux``), ``.feats.csv``/``.feats.bin``, ``.label``,
+    ``.mask``.  The `.lux` must already contain self edges for the
+    partition-local path (offline preprocessing, like the reference
+    assumes, ``gnn.cc:756``) — in-framework self-edge insertion would
+    need the whole graph resident.
+    """
+
+    def __init__(self, prefix: str, in_dim: int, num_classes: int):
+        self.prefix = prefix
+        self.in_dim = in_dim
+        self.num_classes = num_classes
+        lux = prefix + ".add_self_edge.lux"
+        self.lux_path = lux if os.path.exists(lux) else prefix + ".lux"
+        self.num_nodes, self.num_edges = load_lux_header(self.lux_path)
+        self._row_ptr: Optional[np.ndarray] = None
+
+    def row_ptr(self) -> np.ndarray:
+        if self._row_ptr is None:
+            with open(self.lux_path, "rb") as f:
+                # module-qualified so the loader spy tests can intercept
+                ends = _graph._read_slice(f, 12, self.num_nodes, "<u8")
+            rp = np.zeros(self.num_nodes + 1, dtype=np.int64)
+            rp[1:] = ends.astype(np.int64)
+            assert (np.diff(rp) >= 0).all() and rp[-1] == self.num_edges
+            self._row_ptr = rp
+        return self._row_ptr
+
+    def col_slice(self, e0: int, e1: int) -> np.ndarray:
+        base = 12 + self.num_nodes * 8
+        with open(self.lux_path, "rb") as f:
+            col = _graph._read_slice(f, base + e0 * 4, e1 - e0, "<u4")
+        return col.astype(np.int32)
+
+    def features(self, lo: int, hi: int) -> np.ndarray:
+        return load_features(self.prefix, self.num_nodes, self.in_dim,
+                             rows=(lo, hi))
+
+    def labels(self, lo: int, hi: int) -> np.ndarray:
+        return load_labels(self.prefix, self.num_nodes, self.num_classes,
+                           rows=(lo, hi))
+
+    def mask(self, lo: int, hi: int) -> np.ndarray:
+        return load_mask(self.prefix, self.num_nodes, rows=(lo, hi))
+
+
+def as_source(data) -> DataSource:
+    """Coerce a Dataset (or pass through a DataSource)."""
+    if isinstance(data, DataSource):
+        return data
+    if isinstance(data, Dataset):
+        return ArraySource(data)
+    raise TypeError(f"not a Dataset or DataSource: {type(data)!r}")

@@ -44,6 +44,13 @@ AGGR_MAX = "max"
 AGGR_MIN = "min"
 
 
+def _on_cpu() -> bool:
+    """True when the default backend is CPU — Pallas TPU kernels then
+    run in interpreter mode (tests / virtual-device rigs)."""
+    import jax as _jax
+    return _jax.default_backend() == "cpu"
+
+
 @dataclass
 class GraphContext:
     """Per-device view of the (partitioned) graph inside a step function.
@@ -80,15 +87,16 @@ class GraphContext:
     # "ring" = ppermute rotation overlapping per-shard aggregation
     # (parallel/ring.py) — O(V/P * F) peak memory instead of O(V * F)
     halo: str = "gather"
-    ring_idx: Tuple[jax.Array, ...] = ()     # [S, rows_b, width_b] each
-    ring_row_pos: Optional[jax.Array] = None  # [S, num_rows]
+    # flat per-source-shard ring edge lists: (src, dst), each int32
+    # [S, pair_edges] — this device's slice (parallel/ring.py)
+    ring_idx: Tuple[jax.Array, ...] = ()
     axis_name: str = "parts"
 
     def _sum_fwd(self, x: jax.Array) -> jax.Array:
         """Halo exchange + local CSR sum: ``out = A_p @ gather(x)``."""
         if self.halo == "ring":
             from ..parallel.ring import ring_aggregate
-            return ring_aggregate(x, self.ring_idx, self.ring_row_pos,
+            return ring_aggregate(x, self.ring_idx[0], self.ring_idx[1],
                                   axis_name=self.axis_name)
         full = self.gather_features(x)
         # append the dummy zero source row that padding edges point at
@@ -97,6 +105,11 @@ class GraphContext:
         if self.aggr_impl == "ell":
             return aggregate_ell(full, self.ell_idx, self.ell_row_pos,
                                  self.num_rows)
+        if self.aggr_impl == "pallas":
+            from ..kernels.ell_spmm import ell_aggregate_pallas
+            return ell_aggregate_pallas(full, self.ell_idx,
+                                        self.ell_row_pos, self.num_rows,
+                                        interpret=_on_cpu())
         return aggregate(full, self.edge_src, self.edge_dst,
                          self.num_rows, impl=self.aggr_impl,
                          chunk=self.chunk)
@@ -151,7 +164,9 @@ class GraphContext:
         full = jnp.concatenate([full, zero], axis=0)
         dummy = full.shape[0] - 1
         neg = jnp.asarray(-jnp.inf, dtype=full.dtype)
-        if self.aggr_impl == "ell":
+        if self.aggr_impl in ("ell", "pallas"):
+            # "pallas" carries the same ELL tables; MAX is a cold path,
+            # so the XLA ELL reduction serves both
             outs = []
             for idx in self.ell_idx:
                 g = full[idx]                              # [R, W, F]
@@ -161,7 +176,7 @@ class GraphContext:
             cat = jnp.concatenate(outs + [tail], axis=0)
             out = cat[self.ell_row_pos]
         else:
-            if self.aggr_impl in ("blocked", "scan", "pallas"):
+            if self.aggr_impl in ("blocked", "scan", "pallas_csr"):
                 # guard every chunked-sum impl, not just 'blocked':
                 # falling through to the segment path would materialize
                 # the full [E, F] per-edge matrix — an OOM on exactly

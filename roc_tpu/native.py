@@ -89,6 +89,9 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.roc_lux_write.argtypes = [c.c_char_p, i64, i64, i64p, i32p]
     lib.roc_load_features_csv.restype = c.c_int
     lib.roc_load_features_csv.argtypes = [c.c_char_p, f32p, i64, i64]
+    lib.roc_load_features_csv_rows.restype = c.c_int
+    lib.roc_load_features_csv_rows.argtypes = [c.c_char_p, f32p, i64,
+                                               i64, i64]
     lib.roc_load_mask.restype = c.c_int
     lib.roc_load_mask.argtypes = [c.c_char_p, i32p, i64]
     lib.roc_edge_balanced_bounds.restype = c.c_int
@@ -146,6 +149,20 @@ def load_features_csv(path: str, rows: int, cols: int) -> np.ndarray:
         rows, cols)
     if rc != 0:
         raise IOError(f"roc_load_features_csv({path}) failed: {rc}")
+    return out
+
+
+def load_features_csv_rows(path: str, row_lo: int, row_hi: int,
+                           cols: int) -> np.ndarray:
+    """Partition-local CSV feature read: rows [row_lo, row_hi)."""
+    lib = _load()
+    assert lib is not None
+    out = np.empty((row_hi - row_lo, cols), dtype=np.float32)
+    rc = lib.roc_load_features_csv_rows(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        row_lo, row_hi, cols)
+    if rc != 0:
+        raise IOError(f"roc_load_features_csv_rows({path}) failed: {rc}")
     return out
 
 

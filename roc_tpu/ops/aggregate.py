@@ -34,8 +34,14 @@ Three implementations, one semantics:
   XLA row-gather dominates all impls — see benchmarks/micro_agg.py —
   so the practical default for big graphs is ``ell``, whose reduce is
   a dense reshape-sum.)
-- ``pallas`` (kernels/spmm.py): the ``scan`` algorithm with the per-chunk
-  segmented reduction fused into a single Pallas TPU kernel.
+- ``pallas`` (kernels/ell_spmm.py): the ELL layout driven by a
+  one-launch-per-bucket Pallas kernel — scalar-readable index blocks in
+  SMEM, per-row feature DMA HBM->VMEM with a rotating pipeline, fp32
+  VMEM accumulation; dispatched via GraphContext (needs the ELL tables,
+  not an edge list).
+- ``pallas_csr`` (kernels/spmm.py): the ``scan`` algorithm with the
+  per-chunk segmented reduction fused into a Pallas TPU kernel
+  (superseded by ``pallas``; kept as the edge-list-contract kernel).
 
 All take per-edge *global* source ids and produce rows for the local
 destination range, so they drop into the shard_map step unchanged (the
@@ -214,12 +220,18 @@ def aggregate(feats: jax.Array, edge_src: jax.Array, edge_dst: jax.Array,
         return aggregate_scan(feats, edge_src, edge_dst, num_rows,
                               chunk=chunk)
     if impl == "pallas":
+        raise ValueError(
+            "impl='pallas' is the one-launch ELL kernel "
+            "(kernels/ell_spmm.py) and needs the ELL tables, not an "
+            "edge list — route through GraphContext (aggr_impl='pallas') "
+            "or call ell_aggregate_pallas directly")
+    if impl == "pallas_csr":
         try:
             from ..kernels.spmm import csr_spmm_pallas
         except ImportError as e:
             raise NotImplementedError(
-                "the pallas aggregation kernel is not available in this "
-                "build; use impl='blocked'") from e
+                "the pallas_csr aggregation kernel is not available in "
+                "this build; use impl='blocked'") from e
         return csr_spmm_pallas(feats, edge_src, edge_dst, num_rows,
                                chunk=chunk)
     raise ValueError(f"unknown aggregate impl: {impl}")

@@ -68,23 +68,28 @@ def make_mesh(num_parts: Optional[int] = None,
     return Mesh(np.asarray(devices[:num_parts]), ("parts",))
 
 
-def remap_to_padded(pg: PartitionedGraph) -> np.ndarray:
-    """Remap the partitioned col_idx from global vertex ids to *padded
+def remap_col_to_padded(plan, col: np.ndarray) -> np.ndarray:
+    """Remap one partition's col array from global vertex ids to *padded
     row coordinates* (the row layout of the all-gathered feature matrix):
-    global id g in part p maps to ``p * part_nodes + (g - node_offset[p])``;
-    the dummy source maps to ``num_parts * part_nodes`` (the appended zero
-    row)."""
-    offsets = np.asarray([l for l, _ in pg.bounds] + [pg.num_nodes],
+    global id g living in part p maps to
+    ``p * part_nodes + (g - node_offset[p])``; the dummy source maps to
+    ``num_parts * part_nodes`` (the appended zero row)."""
+    offsets = np.asarray([l for l, _ in plan.bounds] + [plan.num_nodes],
                          dtype=np.int64)
-    col = pg.part_col_idx.astype(np.int64)  # [P, E_p], global ids
-    dummy = pg.num_parts * pg.part_nodes
+    col = np.asarray(col, dtype=np.int64)
+    dummy = plan.num_parts * plan.part_nodes
     out = np.full(col.shape, dummy, dtype=np.int64)
-    real = col < pg.num_nodes
+    real = col < plan.num_nodes
     g = col[real]
-    p = np.searchsorted(offsets[1:pg.num_parts + 1], g, side="right")
-    out[real] = p * pg.part_nodes + (g - offsets[p])
+    p = np.searchsorted(offsets[1:plan.num_parts + 1], g, side="right")
+    out[real] = p * plan.part_nodes + (g - offsets[p])
     assert (out <= dummy).all() and (out >= 0).all()
     return out.astype(np.int32)
+
+
+def remap_to_padded(pg: PartitionedGraph) -> np.ndarray:
+    """All-parts form of :func:`remap_col_to_padded` ([P, E_p] in/out)."""
+    return remap_col_to_padded(pg, pg.part_col_idx)
 
 
 def pad_nodes(arr: np.ndarray, pg: PartitionedGraph,
@@ -122,8 +127,7 @@ class ShardedData:
     in_degree: jax.Array   # [P, part_nodes]      P('parts')
     ell_idx: Tuple[jax.Array, ...] = ()   # per bucket [P, rows_b, width_b]
     ell_row_pos: jax.Array = None         # [P, part_nodes]
-    ring_idx: Tuple[jax.Array, ...] = ()  # per bucket [P, S, rows_b, width_b]
-    ring_row_pos: jax.Array = None        # [P, S, part_nodes]
+    ring_idx: Tuple[jax.Array, ...] = ()  # (src, dst) [P, S, pair_edges]
 
 
 def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
@@ -141,14 +145,12 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     ell_idx = ()
     ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
     ring_idx = ()
-    ring_row_pos = put(np.zeros((pg.num_parts, 1, 1), dtype=np.int32))
     if halo == "ring":
         # ring tables fully describe the aggregation — skip the O(E)
         # per-edge array construction entirely and upload stubs
         from .ring import build_ring_tables
         rt = build_ring_tables(pg)
-        ring_idx = tuple(put(a) for a in rt.idx)
-        ring_row_pos = put(rt.row_pos)
+        ring_idx = (put(rt.src), put(rt.dst))
         col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
         edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
     else:
@@ -157,7 +159,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
             np.repeat(np.arange(pg.part_nodes, dtype=np.int32),
                       np.diff(pg.part_row_ptr[p]))
             for p in range(pg.num_parts)])
-        if aggr_impl == "ell":
+        if aggr_impl in ("ell", "pallas"):
             table = ell_from_padded_parts(
                 pg.part_row_ptr, col_padded, pg.real_nodes,
                 pg.part_nodes, dummy=pg.num_parts * pg.part_nodes)
@@ -173,7 +175,6 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
         ring_idx=ring_idx,
-        ring_row_pos=ring_row_pos,
     )
 
 
@@ -234,7 +235,7 @@ class DistributedTrainer:
 
         def step(params, opt_state, feats, labels, mask, edge_src,
                  edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
-                 ring_row_pos, key, lr):
+                 key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
@@ -244,8 +245,7 @@ class DistributedTrainer:
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
                 ell_row_pos=ell_row_pos[0],
-                ring_idx=tuple(a[0] for a in ring_idx),
-                ring_row_pos=ring_row_pos[0])
+                ring_idx=tuple(a[0] for a in ring_idx))
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
@@ -267,7 +267,7 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
+                      spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_r, spec_r),
             out_specs=(spec_r, spec_r, spec_r),
             check_vma=False)
@@ -279,8 +279,7 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, feats, labels, mask, edge_src, edge_dst,
-                 in_degree, ell_idx, ell_row_pos, ring_idx,
-                 ring_row_pos):
+                 in_degree, ell_idx, ell_row_pos, ring_idx):
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
                                              in_degree[0])
@@ -289,8 +288,7 @@ class DistributedTrainer:
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
                 ell_row_pos=ell_row_pos[0],
-                ring_idx=tuple(a[0] for a in ring_idx),
-                ring_row_pos=ring_row_pos[0])
+                ring_idx=tuple(a[0] for a in ring_idx))
             logits = self.model.apply(params, feats, gctx, key=None,
                                       train=False)
             m = perf_metrics(logits, labels, mask)
@@ -300,7 +298,7 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_p),
+                      spec_p, spec_p, spec_p, spec_p),
             out_specs=spec_r, check_vma=False)
         return jax.jit(sm)
 
@@ -314,8 +312,7 @@ class DistributedTrainer:
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, d.feats, d.labels,
                 d.mask, d.edge_src, d.edge_dst, d.in_degree,
-                d.ell_idx, d.ell_row_pos, d.ring_idx, d.ring_row_pos,
-                step_key, lr)
+                d.ell_idx, d.ell_row_pos, d.ring_idx, step_key, lr)
 
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
@@ -328,7 +325,7 @@ class DistributedTrainer:
         m = summarize_metrics(jax.device_get(self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
             d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
-            d.ring_idx, d.ring_row_pos)))
+            d.ring_idx)))
         m["epoch"] = epoch
         return m
 

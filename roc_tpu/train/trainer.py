@@ -80,7 +80,9 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     edge_src, edge_dst = padded_edge_list(g, multiple=chunk)
     ell_idx: tuple = ()
     ell_row_pos = None
-    if aggr_impl == "ell":
+    if aggr_impl in ("ell", "pallas"):
+        # both consume the degree-bucketed ELL layout; "pallas" runs it
+        # through the one-launch DMA kernel (kernels/ell_spmm.py)
         from ..core.ell import ell_from_graph
         table = ell_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
         ell_idx = tuple(jnp.asarray(a[0]) for a in table.idx)
