@@ -106,22 +106,31 @@ def build_ell(local_row_ptr: np.ndarray, col_idx: np.ndarray,
     return buckets
 
 
-def ell_shape_plan(part_in_degree: np.ndarray, real_nodes: np.ndarray,
+def ell_shape_plan(part_row_ptr: np.ndarray, real_nodes: np.ndarray,
                    min_width: int = 8) -> Tuple[Tuple[int, ...], dict]:
-    """Global uniform bucket shapes from degrees alone (O(V) metadata —
-    no column data), so multi-host processes can each build only their
-    own partitions' tables (:func:`place_ell_part`) and still agree on
-    the SPMD-required identical shapes.
+    """Global uniform bucket shapes from row pointers alone (O(V)
+    metadata — no column data), so multi-host processes can each build
+    only their own partitions' tables (:func:`place_ell_part`) and still
+    agree on the SPMD-required identical shapes.
+
+    The plan MUST see the exact degrees :func:`build_ell` will see:
+    ``np.diff(part_row_ptr[p, :n + 1])``.  These differ from the real
+    in-degrees when ``real_nodes[p] == part_nodes`` — padding edges then
+    have no padding row to live on and inflate the last real row's
+    degree, so planning from real degrees would omit that row's
+    (larger) bucket width and :func:`place_ell_part` would reject the
+    table.
 
     Returns ``(widths, rows_per_width)`` where ``rows_per_width[w]`` is
     the max row count of bucket ``w`` over all partitions (floored at
     1 so shapes always exist)."""
     counts: dict = {}
-    for p in range(part_in_degree.shape[0]):
+    for p in range(part_row_ptr.shape[0]):
         n = int(real_nodes[p])
         if n == 0:
             continue
-        w = row_widths(part_in_degree[p, :n], min_width)
+        deg = np.diff(part_row_ptr[p, :n + 1].astype(np.int64))
+        w = row_widths(deg, min_width)
         for wv, c in zip(*np.unique(w[w > 0], return_counts=True)):
             counts[int(wv)] = max(counts.get(int(wv), 0), int(c))
     widths = tuple(sorted(counts)) or (min_width,)
@@ -134,7 +143,16 @@ def place_ell_part(buckets: dict, widths: Tuple[int, ...],
     """Place one partition's buckets (from :func:`build_ell`) into the
     globally planned uniform shapes.  Returns ``(idx_arrays, row_pos)``
     with one int32 [rows_w, w] array per width and int32 [part_nodes]
-    output positions (zero slot == total planned rows)."""
+    output positions (zero slot == total planned rows).  Raises if the
+    built buckets contain a width the plan lacks — a plan/build
+    disagreement must fail loudly, not silently drop those rows'
+    edges."""
+    extra = set(buckets) - set(widths)
+    if extra:
+        raise ValueError(
+            f"ELL plan/build mismatch: built bucket widths {sorted(extra)} "
+            f"absent from planned widths {list(widths)} — the shape plan "
+            "was derived from different degrees than the bucket build")
     idx_arrays = []
     total_rows = sum(rows_per_width[w] for w in widths)
     row_pos = np.full(part_nodes, total_rows, dtype=np.int32)
@@ -145,7 +163,10 @@ def place_ell_part(buckets: dict, widths: Tuple[int, ...],
         if w in buckets:
             rows, idx = buckets[w]
             n = rows.shape[0]
-            assert n <= R, (w, n, R)
+            if n > R:
+                raise ValueError(
+                    f"ELL plan/build mismatch: bucket width {w} has {n} "
+                    f"rows but the plan allows {R}")
             arr[:n] = np.where(idx >= 0, idx, dummy)
             row_pos[rows] = offset + np.arange(n, dtype=np.int32)
         idx_arrays.append(arr)

@@ -135,7 +135,9 @@ def _read_slice(f, offset: int, count: int, dtype: str) -> np.ndarray:
     ``load_task.cu:41-51,201-245``)."""
     f.seek(offset)
     out = np.fromfile(f, dtype=dtype, count=count)
-    assert out.size == count, f"truncated read at {offset} (+{count})"
+    if out.size != count:
+        raise IOError(f"truncated read at {offset} (+{count}): "
+                      f"got {out.size} items")
     return out
 
 
@@ -156,7 +158,9 @@ def load_lux_rows(path: str, row_lo: int, row_hi: int) -> tuple:
     ``local_row_ptr`` int64 [n+1] rebased to 0.
     """
     num_nodes, num_edges = load_lux_header(path)
-    assert 0 <= row_lo <= row_hi <= num_nodes, (row_lo, row_hi, num_nodes)
+    if not 0 <= row_lo <= row_hi <= num_nodes:
+        raise ValueError(f"bad row range [{row_lo}, {row_hi}) for "
+                         f"{num_nodes} nodes")
     n = row_hi - row_lo
     header = 12
     with open(path, "rb") as f:
@@ -168,7 +172,9 @@ def load_lux_rows(path: str, row_lo: int, row_hi: int) -> tuple:
             return np.zeros(1, dtype=np.int64), np.zeros(0, dtype=np.int32)
         ends = _read_slice(f, header + row_lo * 8, n, "<u8").astype(
             np.int64)
-        assert (np.diff(ends) >= 0).all() and ends[0] >= lo_off
+        if not ((np.diff(ends) >= 0).all() and ends[0] >= lo_off):
+            raise ValueError(f"{path}: non-monotone row offsets in "
+                             f"rows [{row_lo}, {row_hi})")
         col_base = header + num_nodes * 8
         e0, e1 = lo_off, int(ends[-1])
         col = _read_slice(f, col_base + e0 * 4, e1 - e0, "<u4")
@@ -193,11 +199,17 @@ def load_lux(path: str) -> Graph:
         num_nodes, num_edges = struct.unpack("<IQ", header)
         raw_rows = np.fromfile(f, dtype="<u8", count=num_nodes)
         col_idx = np.fromfile(f, dtype="<u4", count=num_edges)
-    assert raw_rows.shape[0] == num_nodes, "truncated .lux row offsets"
-    assert col_idx.shape[0] == num_edges, "truncated .lux col indices"
-    # Monotonicity asserts mirror gnn.cc:798-800.
-    assert (np.diff(raw_rows.astype(np.int64)) >= 0).all()
-    assert raw_rows[-1] == num_edges
+    if raw_rows.shape[0] != num_nodes:
+        raise IOError(f"{path}: truncated .lux row offsets")
+    if col_idx.shape[0] != num_edges:
+        raise IOError(f"{path}: truncated .lux col indices")
+    # Monotonicity checks mirror gnn.cc:798-800 (ValueError, not assert:
+    # data validation must survive python -O).
+    if not (np.diff(raw_rows.astype(np.int64)) >= 0).all():
+        raise ValueError(f"{path}: non-monotone row offsets")
+    if raw_rows[-1] != num_edges:
+        raise ValueError(f"{path}: row offsets end at {raw_rows[-1]}, "
+                         f"expected {num_edges}")
     row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
     row_ptr[1:] = raw_rows.astype(np.int64)
     return Graph(row_ptr=row_ptr, col_idx=col_idx.astype(np.int32))
@@ -278,7 +290,9 @@ def load_features(prefix: str, num_nodes: int, in_dim: int,
     csv_path = prefix + ".feats.csv"
     if rows is not None:
         lo, hi = rows
-        assert 0 <= lo <= hi <= num_nodes
+        if not 0 <= lo <= hi <= num_nodes:
+            raise ValueError(f"bad row range [{lo}, {hi}) for "
+                             f"{num_nodes} nodes")
         if os.path.exists(bin_path):
             with open(bin_path, "rb") as f:
                 data = _read_slice(f, lo * in_dim * 4, (hi - lo) * in_dim,
@@ -288,12 +302,16 @@ def load_features(prefix: str, num_nodes: int, in_dim: int,
             return native.load_features_csv_rows(csv_path, lo, hi, in_dim)
         data = np.loadtxt(_iter_lines(csv_path, lo, hi), delimiter=",",
                           dtype=np.float32, ndmin=2)
-        assert data.shape == (hi - lo, in_dim), data.shape
+        if data.shape != (hi - lo, in_dim):
+            raise ValueError(f"{csv_path}: rows [{lo}, {hi}) parsed to "
+                             f"{data.shape}, expected {(hi - lo, in_dim)}")
         return data
     if os.path.exists(bin_path):
         data = np.fromfile(bin_path, dtype=np.float32,
                            count=num_nodes * in_dim)
-        assert data.size == num_nodes * in_dim, "truncated .feats.bin"
+        if data.size != num_nodes * in_dim:
+            raise IOError(f"{bin_path}: truncated .feats.bin "
+                          f"({data.size} of {num_nodes * in_dim} floats)")
         return data.reshape(num_nodes, in_dim)
     if native.available():
         data = native.load_features_csv(csv_path, num_nodes, in_dim)
@@ -326,8 +344,12 @@ def load_labels(prefix: str, num_nodes: int, num_classes: int,
         labels = np.loadtxt(prefix + ".label", dtype=np.int64,
                             ndmin=1)[:num_nodes]
         n = num_nodes
-    assert labels.shape[0] == n
-    assert ((labels >= 0) & (labels < num_classes)).all()
+    if labels.shape[0] != n:
+        raise ValueError(f"{prefix}.label: got {labels.shape[0]} rows, "
+                         f"expected {n}")
+    if not ((labels >= 0) & (labels < num_classes)).all():
+        raise ValueError(f"{prefix}.label: class index outside "
+                         f"[0, {num_classes})")
     return labels.astype(np.int32)
 
 
@@ -343,12 +365,16 @@ def load_mask(prefix: str, num_nodes: int,
     out = np.empty(hi - lo, dtype=np.int32)
     if hi == lo:
         return out
+    count = 0
     for i, line in enumerate(_iter_lines(prefix + ".mask", lo, hi)):
         line = line.strip()
         if line not in _MASK_NAMES:
             raise ValueError(f"Unrecognized mask: {line!r}")
         out[i] = _MASK_NAMES[line]
-    assert i == hi - lo - 1, "truncated .mask"
+        count = i + 1
+    if count != hi - lo:
+        raise ValueError(
+            f"truncated .mask: wanted rows [{lo}, {hi}), got {count}")
     return out
 
 

@@ -317,8 +317,11 @@ class DistributedTrainer:
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
     def sync(self) -> None:
-        """Block until all dispatched train steps have finished."""
-        jax.block_until_ready(self.params)
+        """Block until all dispatched train steps have finished.  Uses
+        the fetch-based barrier: ``block_until_ready`` does not reliably
+        synchronize under the axon TPU relay (utils/profiling.py)."""
+        from ..utils.profiling import sync
+        sync(self.params)
 
     def _eval(self, epoch: int) -> Dict[str, float]:
         d = self.data

@@ -189,7 +189,10 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                             np.int32)
     ring_idx = ()
     if aggr_impl in ("ell", "pallas"):
-        widths, rows_per_width = ell_shape_plan(pg.part_in_degree,
+        # plan from part_row_ptr — the SAME degrees part_tables' bucket
+        # build sees (padding edges can inflate the last real row's
+        # degree when real_nodes[p] == part_nodes; see ell_shape_plan)
+        widths, rows_per_width = ell_shape_plan(pg.part_row_ptr,
                                                 pg.real_nodes)
         dummy = P * pn
 

@@ -50,6 +50,32 @@ def test_make_sharded_array_roundtrip():
         np.testing.assert_array_equal(shards[d], data[i:i + 1])
 
 
+def test_local_ell_plan_matches_global_on_full_part():
+    """Regression (round-2 advisor, high): when real_nodes[p] ==
+    part_nodes, padding edges inflate the last real row's local-CSR
+    degree; the shape plan must be derived from those SAME degrees or
+    the local ELL tables silently drop that row's edges and diverge
+    from shard_dataset's."""
+    from roc_tpu.parallel.distributed import shard_dataset
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=11)
+    # node_multiple=1: the largest partition is exactly full
+    pg = partition_graph(ds.graph, 4, node_multiple=1, edge_multiple=128)
+    full = np.flatnonzero(pg.real_nodes == pg.part_nodes)
+    assert full.size, "fixture must contain a full partition"
+    pad_edges = pg.part_edges - pg.real_edges[full]
+    assert (pad_edges > 0).any(), "full partition needs padding edges"
+
+    mesh = mh.make_parts_mesh(4)
+    loc = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="ell")
+    glo = shard_dataset(ds, pg, mesh, aggr_impl="ell")
+    assert len(loc.ell_idx) == len(glo.ell_idx)
+    for a, b in zip(loc.ell_idx, glo.ell_idx):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(loc.ell_row_pos),
+                                  np.asarray(glo.ell_row_pos))
+
+
 @pytest.mark.parametrize("halo", ["gather", "ring"])
 def test_distributed_trainer_on_local_shards(halo):
     from roc_tpu.parallel.distributed import DistributedTrainer

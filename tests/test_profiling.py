@@ -62,6 +62,13 @@ def test_trainer_logs_metrics(tmp_path):
     tr.metrics_log.close()
     assert len(hist) == 3
     recs = [json.loads(l) for l in open(p)]
-    assert [r["epoch"] for r in recs] == [0, 2, 4]
+    # evals land on eval_every - 1 phase so laps never include compile
+    assert [r["epoch"] for r in recs] == [1, 3, 5]
     assert all("epoch_ms" in r and r["epoch_ms"] > 0 for r in recs)
-    assert tr.timer.summary()["laps"] == 3
+    # the compile step is barriered and reported once, on the first eval
+    assert "compile_ms" in recs[0] and recs[0]["compile_ms"] > 0
+    assert all("compile_ms" not in r for r in recs[1:])
+    # timer = 1 warmup (compile) lap + 3 steady laps
+    s = tr.timer.summary()
+    assert s["laps"] == 4
+    assert s["warmup_ms"] == recs[0]["compile_ms"]
