@@ -1,0 +1,174 @@
+"""Memory placement policy: estimate per-device HBM and choose a plan.
+
+The reference actively manages device-memory residency: a 4-slot
+framebuffer cache sized from ``maxHidden`` with best-fit slot
+assignment (``resourcemanager.cc:29-57``, ``load_task.cu:365-374``),
+backed by zero-copy host memory for everything that doesn't fit
+(``types.cu:22-32``).  The TPU analog is a *plan*, not a cache: XLA
+owns HBM, so the policy's job is to pick, before compilation, which
+combination of mechanisms keeps the step's peak footprint inside the
+budget:
+
+- ``halo``: one-shot ``all_gather`` (fast, materializes the global
+  [V, H] feature matrix per device) vs the ``ppermute`` ring (O(V/P)
+  peak, parallel/ring.py);
+- ``features``: HBM-resident input features vs host-resident features
+  streamed through the first layer (core/streaming.py — the direct
+  analog of the reference's ZC->FB staging);
+- ``remat``: recompute activations in backward instead of saving them
+  (``jax.checkpoint``).
+
+:func:`choose_memory_plan` estimates the footprint of each viable
+combination (cheapest-first) and returns the first that fits, so a
+graph sized past the gather budget trains via ring or streaming with
+no user flags — the reference needs no flags for its cache either.
+The decision is echoed at trainer setup like the reference's config
+print (``gnn.cc:48-60``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
+
+# Activation-liveness factors: a GCN-family layer keeps roughly this
+# many [V_p, H] intermediates alive for backward (dropout out, linear
+# out, two norms, aggregation out, relu out) without remat; with
+# jax.checkpoint only the layer boundaries survive.
+_ACT_FACTOR_SAVED = 6
+_ACT_FACTOR_REMAT = 2
+# Default usable fraction of physical HBM (XLA reserves workspace,
+# and the estimate is deliberately coarse).
+_USABLE = 0.85
+_DEFAULT_HBM = 16 * 1024**3  # v5e physical per chip
+
+
+def detect_hbm_bytes(default: int = _DEFAULT_HBM) -> int:
+    """Per-device HBM budget: ``memory_stats()['bytes_limit']`` when the
+    backend exposes it (the axon relay may not), else the v5e default;
+    scaled by the usable fraction either way."""
+    try:
+        import jax
+        stats = jax.devices()[0].memory_stats()
+        limit = int(stats.get("bytes_limit", 0))
+        if limit > 0:
+            return int(limit * _USABLE)
+    except Exception:  # noqa: BLE001 - any backend without stats
+        pass
+    return int(_DEFAULT_HBM * _USABLE)
+
+
+@dataclass
+class MemoryPlan:
+    """A chosen residency/exchange configuration + its evidence."""
+    halo: str            # "gather" | "ring"
+    features: str        # "hbm" | "host"
+    remat: bool
+    fits: bool           # False = even the last-resort plan over budget
+    est_bytes: int       # estimate for the chosen plan
+    budget_bytes: int
+    candidates: Dict[str, int]  # plan-name -> estimated bytes
+    reason: str
+
+    @property
+    def name(self) -> str:
+        return (f"halo={self.halo} features={self.features} "
+                f"remat={self.remat}")
+
+    def echo(self) -> str:
+        gib = 1024**3
+        return (f"# memory plan: {self.name} — est "
+                f"{self.est_bytes / gib:.2f} GiB of "
+                f"{self.budget_bytes / gib:.2f} GiB budget; {self.reason}")
+
+
+def estimate_plan_bytes(num_nodes: int, num_edges: int,
+                        layer_dims: Sequence[int], num_parts: int = 1,
+                        dtype_bytes: int = 4, halo: str = "gather",
+                        features: str = "hbm", remat: bool = False,
+                        ring_padding: float = 1.7) -> int:
+    """Coarse per-device peak-HBM estimate for one train step.
+
+    ``layer_dims`` is the CLI layer spec (in-dim, hidden..., classes).
+    Deliberately simple and slightly pessimistic — the policy needs
+    ordering between plans, not byte-exact numbers."""
+    V_p = -(-num_nodes // num_parts)
+    E_p = -(-num_edges // num_parts)
+    b = dtype_bytes
+    F = layer_dims[0]
+    hiddens = list(layer_dims[1:])
+    h_max = max(hiddens + [F])
+
+    # replicated params + Adam m/v
+    w = sum(layer_dims[i] * layer_dims[i + 1]
+            for i in range(len(layer_dims) - 1))
+    total = 3 * w * b
+
+    # input features
+    if features == "hbm":
+        total += V_p * F * b
+    else:
+        total += 65536 * F * b  # one streamed block + dY reuse
+
+    # edge tables: ELL idx ~ E_p int32 (+ row positions)
+    total += E_p * 4 + V_p * 4
+    if halo == "ring":
+        total += int(2 * E_p * 4 * ring_padding)  # src+dst flat tables
+
+    # live activations
+    act = _ACT_FACTOR_REMAT if remat else _ACT_FACTOR_SAVED
+    act_bytes = sum(V_p * h * b * act for h in hiddens)
+    if features == "hbm":
+        # first dropout output is [V_p, F]
+        act_bytes += V_p * F * b * (1 if remat else 2)
+    total += act_bytes
+
+    # halo transient: the gathered global matrix vs two ring buffers
+    if halo == "gather":
+        total += num_parts * V_p * h_max * b
+    else:
+        total += 2 * V_p * h_max * b
+    return total
+
+
+def choose_memory_plan(num_nodes: int, num_edges: int,
+                       layer_dims: Sequence[int], num_parts: int = 1,
+                       dtype_bytes: int = 4,
+                       hbm_bytes: Optional[int] = None,
+                       head_streamable: bool = True) -> MemoryPlan:
+    """First-fit over plans ordered cheapest-compute-first.
+
+    Order: gather/hbm -> gather/hbm+remat -> ring (P>1, +-remat) ->
+    host-streamed features (P==1, head_streamable models).  The ring is
+    the distributed answer to >HBM (SURVEY §5), host streaming the
+    single-device one (the reference's ZC tier, ``types.cu:22-32``).
+    If nothing fits, the last candidate is returned with
+    ``fits=False`` — the caller proceeds (estimates are pessimistic)
+    with the warning in the echo."""
+    budget = hbm_bytes if hbm_bytes is not None else detect_hbm_bytes()
+    cands: List = [("gather/hbm", "gather", "hbm", False),
+                   ("gather/hbm/remat", "gather", "hbm", True)]
+    if num_parts > 1:
+        cands += [("ring/hbm", "ring", "hbm", False),
+                  ("ring/hbm/remat", "ring", "hbm", True)]
+    elif head_streamable:
+        cands += [("gather/host", "gather", "host", False),
+                  ("gather/host/remat", "gather", "host", True)]
+    est = {}
+    for name, halo, feats, remat in cands:
+        est[name] = estimate_plan_bytes(
+            num_nodes, num_edges, layer_dims, num_parts, dtype_bytes,
+            halo=halo, features=feats, remat=remat)
+    for name, halo, feats, remat in cands:
+        if est[name] <= budget:
+            return MemoryPlan(
+                halo=halo, features=feats, remat=remat, fits=True,
+                est_bytes=est[name], budget_bytes=budget,
+                candidates=est,
+                reason=f"first fit of {len(cands)} candidates")
+    name, halo, feats, remat = cands[-1]
+    return MemoryPlan(
+        halo=halo, features=feats, remat=remat, fits=False,
+        est_bytes=est[name], budget_bytes=budget, candidates=est,
+        reason="NO plan fits the budget — proceeding with the smallest "
+               "(estimates are pessimistic); expect allocator pressure")

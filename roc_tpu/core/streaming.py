@@ -25,6 +25,7 @@ host RAM and streams row blocks through HBM:
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
@@ -124,3 +125,81 @@ def _block_scatter_add(out, block, src_local, dst):
 
 # module-level jit: the dispatch cache survives across aggregator calls
 _block_scatter_add_jit = jax.jit(_block_scatter_add, donate_argnums=(0,))
+
+
+class StreamedHead:
+    """First model layer (``dropout -> linear``) computed from
+    host-resident features, with the matching streamed weight gradient.
+
+    This is the *integrated* form of :func:`streamed_linear` — the
+    piece that makes ``TrainConfig(features="host")`` a training path,
+    not just a forward helper.  Forward: per 65536-row block, stage the
+    block to HBM, apply inverted dropout (key folded per block), matmul
+    into the ``[V, H]`` output; JAX's async dispatch overlaps block
+    k+1's transfer with block k's compute.  Backward: given the
+    cotangent ``dY`` of the projected activations (from autodiff of the
+    device-resident tail), ``dW = sum_b dropout(X_b)^T @ dY_b`` with
+    the SAME per-block keys, so the recomputed masks match the forward
+    exactly.  The raw ``[V, F]`` feature matrix never resides on device
+    — the reference's ZC->FB staging loop (``types.cu:22-32``) with the
+    FB cache slot replaced by the block transient.
+
+    Note the RNG stream differs from the in-HBM path (one key per
+    block instead of one for the whole matrix): both are valid
+    inverted-dropout samplings; numerics match exactly in eval mode.
+    """
+
+    def __init__(self, rate: float, block_rows: int = 65536):
+        self.rate = float(rate)
+        self.block_rows = block_rows
+
+    def _keys(self, key, n_blocks: int):
+        if key is None:
+            return [None] * n_blocks
+        return [jax.random.fold_in(key, b) for b in range(n_blocks)]
+
+    def _blocks(self, V: int):
+        return [(lo, min(lo + self.block_rows, V))
+                for lo in range(0, V, self.block_rows)]
+
+    def forward(self, weight: jax.Array, feats_host: np.ndarray,
+                key: Optional[jax.Array], train: bool) -> jax.Array:
+        """[V, H] projected activations, device-resident."""
+        blocks = self._blocks(feats_host.shape[0])
+        keys = self._keys(key, len(blocks))
+        outs = []
+        for (lo, hi), k in zip(blocks, keys):
+            x = jax.device_put(np.ascontiguousarray(feats_host[lo:hi]))
+            x = x.astype(weight.dtype)
+            outs.append(_head_fwd_block(x, weight, self.rate, k,
+                                        train and key is not None))
+        return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+    def wgrad(self, feats_host: np.ndarray, dY: jax.Array,
+              key: Optional[jax.Array], train: bool) -> jax.Array:
+        """dL/dW for the head linear, streamed: recomputes each block's
+        dropout with the same folded key as :meth:`forward`."""
+        blocks = self._blocks(feats_host.shape[0])
+        keys = self._keys(key, len(blocks))
+        dW = jnp.zeros((feats_host.shape[1], dY.shape[1]),
+                       dtype=dY.dtype)
+        for (lo, hi), k in zip(blocks, keys):
+            x = jax.device_put(np.ascontiguousarray(feats_host[lo:hi]))
+            x = x.astype(dY.dtype)
+            dW = _head_wgrad_block(dW, x, dY[lo:hi], self.rate, k,
+                                   train and key is not None)
+        return dW
+
+
+@functools.partial(jax.jit, static_argnames=("rate", "use_mask"))
+def _head_fwd_block(x, weight, rate, key, use_mask):
+    from ..ops.dense import dropout
+    return dropout(x, rate if use_mask else 0.0, key, use_mask) @ weight
+
+
+@functools.partial(jax.jit, static_argnames=("rate", "use_mask"),
+                   donate_argnums=(0,))
+def _head_wgrad_block(dW, x, dy, rate, key, use_mask):
+    from ..ops.dense import dropout
+    d = dropout(x, rate if use_mask else 0.0, key, use_mask)
+    return dW + d.T @ dy

@@ -18,8 +18,9 @@ Per chunk of ``C`` sorted edges the kernel fuses, in one VMEM pass:
    post-scan scatter-add, so output windows are written exactly once).
 
 The feature gather itself stays in XLA (``feats[src]`` — the TPU's
-dynamic-gather path, which micro-benchmarks show is the irreducible
-cost at ~tens of ns/row); everything after it lands in this kernel.
+dynamic-gather path, the irreducible cost: ~5.3 ns/row measured on
+v5e at V=50k E=10M F=256, benchmarks/measured_baselines.json);
+everything after it lands in this kernel.
 VMEM working set is O(C * (C + F)), independent of E.
 """
 

@@ -193,6 +193,39 @@ class GraphContext:
         return jnp.where(jnp.isfinite(out), out, 0.0).astype(full.dtype)
 
 
+def _gctx_flatten(g: GraphContext):
+    children = (g.edge_src, g.edge_dst, g.in_degree, g.ell_idx,
+                g.ell_row_pos, g.ring_idx)
+    aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
+           g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name)
+    return children, aux
+
+
+def _gctx_unflatten(aux, children):
+    (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
+     symmetric, halo, axis_name) = aux
+    edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx = \
+        children
+    return GraphContext(
+        edge_src=edge_src, edge_dst=edge_dst, in_degree=in_degree,
+        num_rows=num_rows, gathered_rows=gathered_rows,
+        gather_features=gather_features, psum=psum,
+        aggr_impl=aggr_impl, chunk=chunk, symmetric=symmetric,
+        ell_idx=ell_idx, ell_row_pos=ell_row_pos, halo=halo,
+        ring_idx=ring_idx, axis_name=axis_name)
+
+
+# GraphContext is a pytree so the graph tables travel as jit ARGUMENTS.
+# Closure-capturing them embeds the edge/ELL index arrays (hundreds of
+# MB at Reddit scale) as HLO *constants* — bloating the executable and
+# overflowing the axon remote-compile request (HTTP 413, observed at
+# V=233k/E=115M).  The callables/static config ride in aux_data; the
+# same context object is passed every step, so jit's static-equality
+# check hits the cache.
+jax.tree_util.register_pytree_node(GraphContext, _gctx_flatten,
+                                   _gctx_unflatten)
+
+
 @dataclass(frozen=True)
 class TensorHandle:
     """Symbolic tensor produced by builder calls (the analog of the
@@ -271,6 +304,41 @@ class Model:
                 attrs: Optional[Dict[str, Any]] = None) -> TensorHandle:
         self._ops.append(_Op(kind, inputs, dim, param, attrs or {}))
         return TensorHandle(len(self._ops) - 1, dim)
+
+    # ---- streaming support ----
+
+    def streamable_head(self):
+        """``(dropout_rate, linear_param_name, tail_model)`` when the op
+        list starts ``input -> dropout -> linear`` and the first two
+        intermediates have no other consumer — the pattern the
+        host-feature streaming tier (core/streaming.py StreamedHead)
+        can split off.  ``tail_model`` interprets ops[3:] against the
+        projected ``[V, H]`` activations as its input and SHARES the
+        original param names (do not call ``init_params`` on it).
+        Returns None for any other head shape (e.g. GIN aggregates raw
+        features; deep-GCN residuals consume the dropout output twice);
+        callers fall back to in-HBM features or ring halo."""
+        ops = self._ops
+        if len(ops) < 4:
+            return None
+        if not (ops[1].kind == "dropout" and ops[1].inputs == (0,)):
+            return None
+        if not (ops[2].kind == "linear" and ops[2].inputs == (1,)):
+            return None
+        for op in ops[3:]:
+            if any(i < 2 for i in op.inputs):
+                return None
+        if self._loss_op is not None and self._loss_op < 3:
+            return None
+        tail = Model(in_dim=ops[2].dim)
+        for op in ops[3:]:
+            tail._ops.append(_Op(
+                op.kind,
+                tuple(0 if i == 2 else i - 2 for i in op.inputs),
+                op.dim, op.param, dict(op.attrs)))
+        tail._loss_op = (self._loss_op - 2
+                         if self._loss_op is not None else None)
+        return ops[1].attrs["rate"], ops[2].param, tail
 
     # ---- params ----
 

@@ -128,6 +128,9 @@ class ShardedData:
     ell_idx: Tuple[jax.Array, ...] = ()   # per bucket [P, rows_b, width_b]
     ell_row_pos: jax.Array = None         # [P, part_nodes]
     ring_idx: Tuple[jax.Array, ...] = ()  # (src, dst) [P, S, pair_edges]
+    # padded slots / real edges of the ring tables (halo='ring' only);
+    # surfaced so trainer setup can echo the SPMD-uniformity cost
+    ring_padding_ratio: Optional[float] = None
 
 
 def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
@@ -145,12 +148,14 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     ell_idx = ()
     ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
     ring_idx = ()
+    ring_padding_ratio = None
     if halo == "ring":
         # ring tables fully describe the aggregation — skip the O(E)
         # per-edge array construction entirely and upload stubs
         from .ring import build_ring_tables
         rt = build_ring_tables(pg)
         ring_idx = (put(rt.src), put(rt.dst))
+        ring_padding_ratio = rt.padding_ratio
         col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
         edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
     else:
@@ -175,6 +180,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
         ring_idx=ring_idx,
+        ring_padding_ratio=ring_padding_ratio,
     )
 
 
@@ -186,6 +192,14 @@ class DistributedTrainer:
                  config: TrainConfig = TrainConfig(),
                  mesh: Optional[Mesh] = None):
         self.model = model
+        from ..train.trainer import apply_memory_autopilot
+        config = apply_memory_autopilot(model, dataset, config,
+                                        num_parts=num_parts)
+        if config.features == "host":
+            raise NotImplementedError(
+                "features='host' streaming is single-device only; the "
+                "distributed >HBM mechanism is halo='ring' (the "
+                "autopilot picks it automatically for parts > 1)")
         self.config = config
         self.epoch = 0
         self.symmetric = resolve_symmetric(dataset, config.symmetric)
@@ -197,6 +211,16 @@ class DistributedTrainer:
                                   dtype=config.dtype,
                                   aggr_impl=config.aggr_impl,
                                   halo=config.halo)
+        if config.halo == "ring" and config.verbose:
+            # startup echo like the reference's config print
+            # (gnn.cc:48-60): make the SPMD padding cost visible, and
+            # say out loud that ring tables subsume the aggr impl
+            import sys
+            print(f"# halo=ring: P={self.pg.num_parts} "
+                  f"pair_edges={self.data.ring_idx[0].shape[2]} "
+                  f"padding_ratio={self.data.ring_padding_ratio:.2f} "
+                  f"(aggr_impl={config.aggr_impl!r} unused: ring tables "
+                  f"drive the aggregation)", file=sys.stderr)
         key = jax.random.PRNGKey(config.seed)
         self.key, init_key = jax.random.split(key)
         repl = NamedSharding(self.mesh, P())

@@ -62,6 +62,19 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "all_gather or ppermute ring (O(V/P) memory)")
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16"])
+    ap.add_argument("--memory", default="auto",
+                    choices=["auto", "manual"],
+                    help="auto (default): estimate per-device HBM and "
+                         "pick halo/features/remat (core/memory.py), "
+                         "echoing the decision; explicit --halo/"
+                         "--features flags switch back to manual")
+    ap.add_argument("--features", default="hbm",
+                    choices=["hbm", "host"],
+                    help="input-feature residency: device HBM, or host "
+                         "RAM streamed through the first layer "
+                         "(>HBM graphs, single device)")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize activations in backward")
     ap.add_argument("--eval-every", type=int, default=5)
     ap.add_argument("--checkpoint", type=str, default=None,
                     help="save params+opt state here after training")
@@ -112,12 +125,18 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin}
     model = build[args.model](layers, dropout_rate=args.dropout)
+    memory = args.memory
+    if memory == "auto" and (args.halo != "gather"
+                             or args.features != "hbm" or args.remat):
+        # explicit residency flags win over the autopilot
+        memory = "manual"
     cfg = TrainConfig(
         learning_rate=args.lr, weight_decay=args.weight_decay,
         dropout_rate=args.dropout, decay_rate=args.decay_rate,
         decay_steps=args.decay_steps, epochs=args.epochs,
         seed=args.seed, eval_every=args.eval_every, verbose=True,
-        aggr_impl=args.impl, halo=args.halo,
+        aggr_impl=args.impl, halo=args.halo, memory=memory,
+        features=args.features, remat=args.remat,
         dtype=jnp.float32 if args.dtype == "float32" else jnp.bfloat16)
 
     if args.parts > 1:

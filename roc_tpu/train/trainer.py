@@ -56,11 +56,24 @@ class TrainConfig:
     # (TensorBoard format; None = off) and metrics JSONL path.
     profile_dir: Optional[str] = None
     metrics_path: Optional[str] = None
-    # Memory policy (the TPU analog of the reference's FB-cache
-    # residency tuning, resourcemanager.h:30): rematerialize the
-    # forward pass in backward instead of saving activations — trades
-    # one extra forward of FLOPs for O(layers) less activation memory.
+    # Memory policy (the TPU analog of the reference's FB-cache +
+    # zero-copy residency design, resourcemanager.h:30, types.cu:22-32):
+    # - remat: rematerialize the forward pass in backward instead of
+    #   saving activations — one extra forward of FLOPs for O(layers)
+    #   less activation memory.
+    # - features: "hbm" keeps the input features device-resident;
+    #   "host" keeps them in host RAM and streams the first layer
+    #   (dropout -> linear) through HBM in row blocks, forward AND
+    #   weight-gradient (core/streaming.py StreamedHead).  Requires a
+    #   streamable model head (Model.streamable_head).
+    # - memory: "manual" uses halo/features/remat as given; "auto" runs
+    #   core/memory.choose_memory_plan over the dataset/model shapes
+    #   and overrides them with the first plan that fits hbm_bytes
+    #   (None = detect), echoing the decision at setup.
     remat: bool = False
+    features: str = "hbm"
+    memory: str = "manual"
+    hbm_bytes: Optional[int] = None
 
 
 def resolve_symmetric(dataset: Dataset,
@@ -69,6 +82,34 @@ def resolve_symmetric(dataset: Dataset,
         from ..core.graph import check_symmetric
         return check_symmetric(dataset.graph)
     return symmetric
+
+
+def apply_memory_autopilot(model: Model, dataset: Dataset,
+                           config: TrainConfig,
+                           num_parts: int = 1) -> TrainConfig:
+    """Resolve ``memory='auto'`` into concrete halo/features/remat via
+    core/memory.choose_memory_plan, echoing the decision like the
+    reference's startup config print (``gnn.cc:48-60``).  No-op for
+    ``memory='manual'``."""
+    if config.memory != "auto":
+        return config
+    import dataclasses
+    import sys
+    from ..core.memory import choose_memory_plan
+    dims = [model._ops[0].dim] + [op.dim for op in model._ops
+                                  if op.kind == "linear"]
+    plan = choose_memory_plan(
+        dataset.graph.num_nodes, dataset.graph.num_edges, dims,
+        num_parts=num_parts,
+        dtype_bytes=jnp.dtype(config.dtype).itemsize,
+        hbm_bytes=config.hbm_bytes,
+        head_streamable=model.streamable_head() is not None)
+    if config.verbose:
+        print(plan.echo(), file=sys.stderr)
+    return dataclasses.replace(
+        config, memory="manual", features=plan.features,
+        remat=plan.remat,
+        halo=plan.halo if num_parts > 1 else config.halo)
 
 
 def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
@@ -107,12 +148,12 @@ class Trainer:
     def __init__(self, model: Model, dataset: Dataset,
                  config: TrainConfig = TrainConfig()):
         self.model = model
+        config = apply_memory_autopilot(model, dataset, config)
         self.config = config
         self.epoch = 0
         self.gctx = make_graph_context(dataset, config.aggr_impl,
                                        config.chunk,
                                        symmetric=config.symmetric)
-        self.feats = jnp.asarray(dataset.features, dtype=config.dtype)
         self.labels = jnp.asarray(dataset.labels)
         self.mask = jnp.asarray(dataset.mask)
         key = jax.random.PRNGKey(config.seed)
@@ -120,6 +161,31 @@ class Trainer:
         self.params = model.init_params(init_key, dtype=config.dtype)
         self.opt_state = adam_init(self.params)
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
+        self._head = None
+        if config.features == "host":
+            # host-resident features streamed through the first layer
+            # (the reference's ZC tier, types.cu:22-32)
+            head = model.streamable_head()
+            if head is None:
+                raise NotImplementedError(
+                    "features='host' needs a streamable model head "
+                    "(input -> dropout -> linear with no other "
+                    "consumer; Model.streamable_head).  This model's "
+                    "first layer consumes raw features elsewhere — use "
+                    "features='hbm', or partition with --parts/halo="
+                    "'ring' to shrink per-device residency")
+            rate, self._head_param, self._tail_model = head
+            from ..core.streaming import StreamedHead
+            self._head = StreamedHead(rate)
+            self.feats_host = np.ascontiguousarray(
+                np.asarray(dataset.features, dtype=np.float32))
+            self.feats = None
+            self._tail_grad = jax.jit(self._tail_grad_impl)
+            self._tail_eval = jax.jit(self._tail_eval_impl)
+            self._apply_update = jax.jit(self._apply_update_impl,
+                                         donate_argnums=(0, 1))
+        else:
+            self.feats = jnp.asarray(dataset.features, dtype=config.dtype)
         # Dataset tensors are jitted *arguments*, not closure captures:
         # capturing them would embed a second copy of the feature matrix
         # as an executable constant and recompile per Trainer instance
@@ -133,10 +199,13 @@ class Trainer:
         self.metrics_log = MetricsLog(config.metrics_path)
 
     def _train_step_impl(self, params, opt_state, key, lr, feats,
-                         labels, mask):
+                         labels, mask, gctx):
+        # gctx arrives as a jit ARGUMENT (GraphContext is a pytree):
+        # closure-capturing it would embed the edge/ELL tables as HLO
+        # constants — see the register_pytree_node note in builder.py
         def objective(p):
             loss, _ = self.model.loss_fn(p, feats, labels, mask,
-                                         self.gctx, key=key, train=True)
+                                         gctx, key=key, train=True)
             return loss
         if self.config.remat:
             objective = jax.checkpoint(objective)
@@ -145,18 +214,59 @@ class Trainer:
                                         self.adam_cfg)
         return params, opt_state, loss
 
-    def _eval_step_impl(self, params, feats, labels, mask):
-        logits = self.model.apply(params, feats, self.gctx,
+    def _eval_step_impl(self, params, feats, labels, mask, gctx):
+        logits = self.model.apply(params, feats, gctx,
                                   key=None, train=False)
         return perf_metrics(logits, labels, mask)
+
+    # ---- host-feature streaming path (config.features == "host") ----
+
+    def _tail_grad_impl(self, params, y, key, labels, mask, gctx):
+        """Loss + grads of the device-resident tail w.r.t. (params, Y);
+        dY feeds the streamed head weight gradient."""
+        def objective(p, yy):
+            loss, _ = self._tail_model.loss_fn(p, yy, labels, mask,
+                                               gctx, key=key,
+                                               train=True)
+            return loss
+        if self.config.remat:
+            objective = jax.checkpoint(objective)
+        loss, (gp, gy) = jax.value_and_grad(objective, argnums=(0, 1))(
+            params, y)
+        return loss, gp, gy
+
+    def _tail_eval_impl(self, params, y, labels, mask, gctx):
+        logits = self._tail_model.apply(params, y, gctx,
+                                        key=None, train=False)
+        return perf_metrics(logits, labels, mask)
+
+    def _apply_update_impl(self, params, opt_state, grads, lr):
+        return adam_update(params, grads, opt_state, lr, self.adam_cfg)
+
+    def _streamed_step(self, step_key, lr):
+        head_key, tail_key = jax.random.split(step_key)
+        w0 = self.params[self._head_param]
+        y = self._head.forward(w0, self.feats_host, head_key, True)
+        _, grads, gy = self._tail_grad(self.params, y, tail_key,
+                                       self.labels, self.mask,
+                                       self.gctx)
+        grads[self._head_param] = self._head.wgrad(
+            self.feats_host, gy, head_key, True)
+        self.params, self.opt_state = self._apply_update(
+            self.params, self.opt_state, grads, lr)
+
+    # ---- loop ----
 
     def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
         """Run ``epochs`` more epochs; the epoch counter persists across
         calls so lr decay and the eval cadence continue correctly."""
         def do_step(step_key, lr):
+            if self._head is not None:
+                self._streamed_step(step_key, lr)
+                return
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, step_key, lr, self.feats,
-                self.labels, self.mask)
+                self.labels, self.mask, self.gctx)
 
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
@@ -168,9 +278,15 @@ class Trainer:
         sync(self.params)
 
     def evaluate(self) -> Dict[str, float]:
+        if self._head is not None:
+            y = self._head.forward(self.params[self._head_param],
+                                   self.feats_host, None, False)
+            return summarize_metrics(jax.device_get(
+                self._tail_eval(self.params, y, self.labels, self.mask,
+                                self.gctx)))
         return summarize_metrics(jax.device_get(
             self._eval_step(self.params, self.feats, self.labels,
-                            self.mask)))
+                            self.mask, self.gctx)))
 
 
 def run_epoch_loop(tr, epochs: Optional[int], do_step,
