@@ -419,7 +419,19 @@ def parent(args, argv) -> int:
                 time.sleep(min(delay, max(remaining() - 60, 0)))
                 delay *= 2
         else:
-            rec = _run_stage(name, eff_timeout, argv)
+            # measurement stages retry too — the single-claim tunnel
+            # can transiently fail any fresh child, not just the probe
+            # (observed: a full-stage rc=1 with ~690s of deadline left)
+            while True:
+                rec = _run_stage(name, eff_timeout, argv)
+                budget = remaining() - 20.0 - _TERM_GRACE
+                if rec.get("ok") or budget < min_budget:
+                    break
+                print(f"# {name} retry in 30s ({budget:.0f}s left)",
+                      file=sys.stderr)
+                time.sleep(30)
+                eff_timeout = min(timeout,
+                                  remaining() - 20.0 - _TERM_GRACE)
         results[name] = rec
 
         # persist measurements as baselines the moment they exist;

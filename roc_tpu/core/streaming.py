@@ -193,8 +193,12 @@ class StreamedHead:
 
 @functools.partial(jax.jit, static_argnames=("rate", "use_mask"))
 def _head_fwd_block(x, weight, rate, key, use_mask):
-    from ..ops.dense import dropout
-    return dropout(x, rate if use_mask else 0.0, key, use_mask) @ weight
+    # dense.linear, not a bare @: the in-HBM path accumulates fp32 at
+    # HIGHEST precision and the streamed path must match bit-for-bit
+    # semantics (Model.streamable_head guarantees activation == NONE)
+    from ..ops.dense import AC_MODE_NONE, dropout, linear
+    d = dropout(x, rate if use_mask else 0.0, key, use_mask)
+    return linear(d, weight, AC_MODE_NONE)
 
 
 @functools.partial(jax.jit, static_argnames=("rate", "use_mask"),
@@ -202,4 +206,8 @@ def _head_fwd_block(x, weight, rate, key, use_mask):
 def _head_wgrad_block(dW, x, dy, rate, key, use_mask):
     from ..ops.dense import dropout
     d = dropout(x, rate if use_mask else 0.0, key, use_mask)
-    return dW + d.T @ dy
+    prec = (jax.lax.Precision.HIGHEST if d.dtype == jnp.float32
+            else None)
+    return dW + jax.lax.dot_general(
+        d, dy, (((0,), (0,)), ((), ())), precision=prec,
+        preferred_element_type=jnp.float32).astype(dW.dtype)

@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops import dense
-from ..ops.aggregate import aggregate, aggregate_ell, aggregate_mean
+from ..ops.aggregate import (aggregate, aggregate_ell, aggregate_ell_max,
+                             aggregate_mean)
 from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU, AC_MODE_SIGMOID
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics
 from ..ops.norm import indegree_norm
@@ -166,15 +167,11 @@ class GraphContext:
         neg = jnp.asarray(-jnp.inf, dtype=full.dtype)
         if self.aggr_impl in ("ell", "pallas"):
             # "pallas" carries the same ELL tables; MAX is a cold path,
-            # so the XLA ELL reduction serves both
-            outs = []
-            for idx in self.ell_idx:
-                g = full[idx]                              # [R, W, F]
-                m = (idx != dummy)[:, :, None]
-                outs.append(jnp.max(jnp.where(m, g, neg), axis=1))
-            tail = jnp.full((1, full.shape[1]), neg, dtype=full.dtype)
-            cat = jnp.concatenate(outs + [tail], axis=0)
-            out = cat[self.ell_row_pos]
+            # so the XLA ELL reduction serves both.  aggregate_ell_max
+            # row-segments large buckets under the same 64 MiB budget
+            # as the sum path.
+            out = aggregate_ell_max(full, self.ell_idx,
+                                    self.ell_row_pos, self.num_rows)
         else:
             if self.aggr_impl in ("blocked", "scan", "pallas_csr"):
                 # guard every chunked-sum impl, not just 'blocked':
@@ -324,6 +321,11 @@ class Model:
         if not (ops[1].kind == "dropout" and ops[1].inputs == (0,)):
             return None
         if not (ops[2].kind == "linear" and ops[2].inputs == (1,)):
+            return None
+        if ops[2].attrs.get("activation", AC_MODE_NONE) != AC_MODE_NONE:
+            # StreamedHead computes a plain projection; a fused
+            # activation would be silently dropped (and its gradient
+            # mask missing from the streamed wgrad)
             return None
         for op in ops[3:]:
             if any(i < 2 for i in op.inputs):

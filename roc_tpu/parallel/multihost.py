@@ -106,6 +106,30 @@ def make_sharded_array(mesh: Mesh, local_parts: List[int],
         global_shape, sharding, singles)
 
 
+def _allreduce_part_stats(mesh: Mesh, local: List[int],
+                          stats: dict) -> Tuple[int, int]:
+    """(global max of stat[0], global sum of stat[1]) over all
+    partitions, where each host knows only its own parts' values.
+    Single-process short-circuits; multi-host runs one tiny [P, 2]
+    collective — the O(P) agreement that replaces a whole-graph pass.
+    """
+    if jax.process_count() == 1:
+        return (max(v[0] for v in stats.values()),
+                sum(v[1] for v in stats.values()))
+    import jax.numpy as jnp
+    num_parts = int(mesh.devices.size)
+    arr = make_sharded_array(
+        mesh, local,
+        [np.asarray([[stats[p][0], stats[p][1]]], dtype=np.int64)
+         for p in local],
+        (num_parts, 2))
+    reduce = jax.jit(
+        lambda a: jnp.stack([jnp.max(a[:, 0]), jnp.sum(a[:, 1])]),
+        out_shardings=NamedSharding(mesh, P()))
+    out = np.asarray(reduce(arr))
+    return int(out[0]), int(out[1])
+
+
 def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                         aggr_impl: str = "segment",
                         halo: str = "gather"):
@@ -122,39 +146,22 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     ``dataset`` may be a Dataset (in-memory; slices are views) or any
     DataSource (e.g. ``FileSource`` for the on-disk reference layout).
     ``pg`` may be a PartitionPlan — column data is only read for local
-    parts.  Exception: ``halo='ring'`` needs every partition's columns
-    to size its uniform per-pair tables, so ring prep falls back to the
-    global path (documented trade; the gather/ELL default is fully
-    local).
+    parts.  ``halo='ring'`` is partition-local too: per-part pair
+    lists from local column reads, with the uniform pair width agreed
+    via an O(P) collective (never a whole-graph pass).
     """
     import jax.numpy as jnp
     from ..core.ell import build_ell, ell_shape_plan, place_ell_part
     from ..core.graph import MASK_NONE
     from ..core.partition import partition_col
     from ..core.source import as_source
-    from .distributed import (ShardedData, remap_col_to_padded,
-                              shard_dataset)
+    from .distributed import ShardedData, remap_col_to_padded
 
     if dtype is None:
         dtype = jnp.float32
     src = as_source(dataset)
     local = process_local_parts(mesh)
     P, pn, pe = pg.num_parts, pg.part_nodes, pg.part_edges
-
-    if halo == "ring":
-        # per-(partition, source-shard) table shapes depend on where
-        # every edge's source lands — not derivable from degrees alone.
-        from ..core.graph import Dataset as _DS
-        if not isinstance(dataset, _DS):
-            raise NotImplementedError(
-                "halo='ring' multi-host prep needs the in-memory "
-                "Dataset (global column pass); use halo='gather' for "
-                "fully partition-local loading")
-        def put(arr):
-            return make_sharded_array(
-                mesh, local, [arr[p:p + 1] for p in local], arr.shape)
-        return shard_dataset(dataset, pg, mesh, dtype=dtype,
-                             aggr_impl=aggr_impl, halo=halo, put=put)
 
     def put_parts(build, shape, np_dtype):
         """Assemble a P('parts')-sharded array from per-part builders
@@ -171,6 +178,47 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                 out[:r - l + 1] = get(l, r + 1)
             return out
         return build
+
+    if halo == "ring":
+        # Fully partition-local ring prep: pair lists from this host's
+        # own column reads; the uniform pair width (an SPMD shape, so
+        # every host must agree) comes from an O(P) max/sum collective
+        # over per-part stats — never a whole-graph pass.
+        from .ring import (build_ring_pairs, pack_ring_part,
+                           round_pair_edges)
+        pairs = {p: build_ring_pairs(
+            pg, p, partition_col(pg, src.col_slice, p)) for p in local}
+        stats = {p: (max((d.shape[0] for _, d in pairs[p].values()),
+                         default=1),
+                     sum(d.shape[0] for _, d in pairs[p].values()))
+                 for p in local}
+        max_pair, total_real = _allreduce_part_stats(mesh, local, stats)
+        pair_edges = round_pair_edges(max_pair)
+        # pack once per part — each pack allocates two [P, pair_edges]
+        # tables (hundreds of MB at Amazon-2M scale)
+        packed = {p: pack_ring_part(pairs[p], P, pair_edges, pn)
+                  for p in local}
+        ring_src = put_parts(lambda p: packed[p][0], (P, pair_edges),
+                             np.int32)
+        ring_dst = put_parts(lambda p: packed[p][1], (P, pair_edges),
+                             np.int32)
+        stub = lambda p: np.zeros(1, np.int32)
+        return ShardedData(
+            feats=put_parts(node_field(src.features, 0, np.float32,
+                                       (src.in_dim,)),
+                            (pn, src.in_dim), np.dtype(dtype)),
+            labels=put_parts(node_field(src.labels, 0, np.int32), (pn,),
+                             np.int32),
+            mask=put_parts(node_field(src.mask, MASK_NONE, np.int32),
+                           (pn,), np.int32),
+            edge_src=put_parts(stub, (1,), np.int32),
+            edge_dst=put_parts(stub, (1,), np.int32),
+            in_degree=put_parts(lambda p: pg.part_in_degree[p], (pn,),
+                                np.int32),
+            ell_row_pos=put_parts(stub, (1,), np.int32),
+            ring_idx=(ring_src, ring_dst),
+            ring_padding_ratio=(P * P * pair_edges) / max(total_real, 1),
+        )
 
     # local parts' padded columns, remapped once and reused by both the
     # edge_src field and the ELL table build

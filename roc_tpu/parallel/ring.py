@@ -27,7 +27,7 @@ into the last output row, so they are numeric no-ops.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -60,37 +60,72 @@ class RingTables:
         return int(self.src.shape[2])
 
 
-def build_ring_tables(pg: PartitionedGraph) -> RingTables:
-    """Split each partition's local CSR by source shard into flat
-    dst-sorted edge lists padded to the max pair size."""
+def build_ring_pairs(pg: PartitionedGraph, p: int,
+                     col: Optional[np.ndarray] = None) -> dict:
+    """Partition ``p``'s per-source-shard edge lists, built from ``p``'s
+    OWN column data only: ``{s: (src_local_to_shard_s, dst_local)}``
+    with dst sorted ascending within each pair.  ``col`` overrides the
+    column array (multi-host partition-local loading passes the slice
+    it read; global ids, NOT padded-remapped); default reads
+    ``pg.part_col_idx``."""
     P = pg.num_parts
     offsets = np.asarray([l for l, _ in pg.bounds] + [pg.num_nodes],
                          dtype=np.int64)
     starts = np.minimum(offsets[:P], pg.num_nodes)
+    n = int(pg.real_nodes[p])
+    ptr = pg.part_row_ptr[p, :n + 1].astype(np.int64)
+    if col is None:
+        col = pg.part_col_idx[p]
+    col = np.asarray(col[:int(ptr[n])], dtype=np.int64)
+    dst = np.repeat(np.arange(n, dtype=np.int64), np.diff(ptr))
+    shard = np.searchsorted(offsets[1:P + 1], col, side="right")
     pairs = {}
-    max_pair = 1
-    total_real = 0
-    for p in range(P):
-        n = int(pg.real_nodes[p])
-        ptr = pg.part_row_ptr[p, :n + 1].astype(np.int64)
-        col = pg.part_col_idx[p][:int(ptr[n])].astype(np.int64)
-        dst = np.repeat(np.arange(n, dtype=np.int64), np.diff(ptr))
-        shard = np.searchsorted(offsets[1:P + 1], col, side="right")
-        for s in range(P):
-            sel = shard == s
-            # dst is globally sorted, so the stable mask keeps it sorted
-            d = dst[sel].astype(np.int32)
-            c = (col[sel] - starts[s]).astype(np.int32)
-            pairs[p, s] = (c, d)
-            max_pair = max(max_pair, d.shape[0])
-            total_real += d.shape[0]
-    # pad to an 8-multiple so downstream chunking divides evenly
-    pair_edges = -(-max_pair // 8) * 8
-    src = np.full((P, P, pair_edges), pg.part_nodes, dtype=np.int32)
-    dst = np.full((P, P, pair_edges), pg.part_nodes - 1, dtype=np.int32)
-    for (p, s), (c, d) in pairs.items():
-        src[p, s, :c.shape[0]] = c
-        dst[p, s, :d.shape[0]] = d
+    for s in range(P):
+        sel = shard == s
+        # dst is globally sorted, so the stable mask keeps it sorted
+        pairs[s] = ((col[sel] - starts[s]).astype(np.int32),
+                    dst[sel].astype(np.int32))
+    return pairs
+
+
+def pack_ring_part(pairs: dict, num_shards: int, pair_edges: int,
+                   part_nodes: int):
+    """One partition's ``[S, pair_edges]`` (src, dst) tables from its
+    pair lists: padding sources point at the dummy zero row
+    (``part_nodes``), padding destinations at the last row (keeps the
+    dst sort; the gathered zero adds nothing)."""
+    src = np.full((num_shards, pair_edges), part_nodes, dtype=np.int32)
+    dst = np.full((num_shards, pair_edges), part_nodes - 1,
+                  dtype=np.int32)
+    for s, (c, d) in pairs.items():
+        src[s, :c.shape[0]] = c
+        dst[s, :d.shape[0]] = d
+    return src, dst
+
+
+def round_pair_edges(max_pair: int) -> int:
+    """Pad the pair width to an 8-multiple so chunking divides evenly."""
+    return -(-max(max_pair, 1) // 8) * 8
+
+
+def build_ring_tables(pg: PartitionedGraph) -> RingTables:
+    """Split each partition's local CSR by source shard into flat
+    dst-sorted edge lists padded to the max pair size (single-host
+    form; the multi-host path builds per-partition pairs locally and
+    agrees on ``pair_edges`` with an O(P) collective —
+    parallel/multihost.py)."""
+    P = pg.num_parts
+    all_pairs = {p: build_ring_pairs(pg, p) for p in range(P)}
+    max_pair = max((d.shape[0] for pairs in all_pairs.values()
+                    for _, d in pairs.values()), default=1)
+    total_real = sum(d.shape[0] for pairs in all_pairs.values()
+                     for _, d in pairs.values())
+    pair_edges = round_pair_edges(max_pair)
+    src = np.empty((P, P, pair_edges), dtype=np.int32)
+    dst = np.empty((P, P, pair_edges), dtype=np.int32)
+    for p, pairs in all_pairs.items():
+        src[p], dst[p] = pack_ring_part(pairs, P, pair_edges,
+                                        pg.part_nodes)
     ratio = (P * P * pair_edges) / max(total_real, 1)
     return RingTables(src=src, dst=dst, padding_ratio=float(ratio))
 

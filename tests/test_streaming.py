@@ -108,6 +108,17 @@ def test_streamable_head_detection():
     assert build_gin([16, 8, 4]).streamable_head() is None
     # deep GCN residual consumes the first dropout output twice
     assert build_gcn([16, 8, 8, 8, 4]).streamable_head() is None
+    # a fused activation on the head linear would be silently dropped
+    # by the streamed projection -> must be rejected
+    from roc_tpu.models.builder import Model
+    from roc_tpu.ops.dense import AC_MODE_RELU
+    m = Model(in_dim=16)
+    t = m.input()
+    t = m.dropout(t, 0.5)
+    t = m.linear(t, 8, AC_MODE_RELU)
+    t = m.scatter_gather(t)
+    m.softmax_cross_entropy(t)
+    assert m.streamable_head() is None
 
 
 def test_streamable_head_tail_matches_full_apply():
