@@ -88,6 +88,19 @@ def main():
             chunk = int(chunk)
         else:
             impl, chunk = spec, 1024
+        if impl == "sectioned":
+            from roc_tpu.core.ell import sectioned_from_graph
+            from roc_tpu.ops.aggregate import aggregate_ell_sect
+            t0 = time.time()
+            sect = sectioned_from_graph(g.row_ptr, g.col_idx, V)
+            prep = time.time() - t0
+            sidx, sdst, meta = sect.as_jax()
+            f = jax.jit(lambda x, i=sidx, d=sdst:
+                        aggregate_ell_sect(x, i, d, meta, V))
+            ms = bench(lambda: f(feats), args.iters)
+            print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
+                  f"(prep {prep:.1f}s)")
+            continue
         if impl == "ell":
             (idx, pos), prep = get_ell()
             f = jax.jit(lambda x: aggregate_ell(x, idx, pos, V))

@@ -30,7 +30,7 @@ import numpy as np
 
 from ..ops import dense
 from ..ops.aggregate import (aggregate, aggregate_ell, aggregate_ell_max,
-                             aggregate_mean)
+                             aggregate_ell_sect, aggregate_mean)
 from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU, AC_MODE_SIGMOID
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics
 from ..ops.norm import indegree_norm
@@ -83,6 +83,13 @@ class GraphContext:
     # arrays + [num_rows] output permutation (core/ell.py)
     ell_idx: Tuple[jax.Array, ...] = ()
     ell_row_pos: Optional[jax.Array] = None
+    # Sectioned layout (aggr_impl == "sectioned"): per-section
+    # [n_chunks, seg_rows, 8] sub-row tables + [n_chunks, seg_rows]
+    # output rows, with static (start, size) metadata (core/ell.py
+    # SectionedEll — measured 2.3x over "ell" at Reddit scale)
+    sect_idx: Tuple[jax.Array, ...] = ()
+    sect_sub_dst: Tuple[jax.Array, ...] = ()
+    sect_meta: Tuple[Tuple[int, int], ...] = ()
     # halo exchange mode: "gather" = one-shot all_gather of the full
     # feature matrix (the reference's whole-region requirement);
     # "ring" = ppermute rotation overlapping per-shard aggregation
@@ -106,6 +113,10 @@ class GraphContext:
         if self.aggr_impl == "ell":
             return aggregate_ell(full, self.ell_idx, self.ell_row_pos,
                                  self.num_rows)
+        if self.aggr_impl == "sectioned":
+            return aggregate_ell_sect(full, self.sect_idx,
+                                      self.sect_sub_dst, self.sect_meta,
+                                      self.num_rows)
         if self.aggr_impl == "pallas":
             from ..kernels.ell_spmm import ell_aggregate_pallas
             return ell_aggregate_pallas(full, self.ell_idx,
@@ -173,16 +184,18 @@ class GraphContext:
             out = aggregate_ell_max(full, self.ell_idx,
                                     self.ell_row_pos, self.num_rows)
         else:
-            if self.aggr_impl in ("blocked", "scan", "pallas_csr"):
+            if self.aggr_impl in ("blocked", "scan", "pallas_csr",
+                                  "sectioned"):
                 # guard every chunked-sum impl, not just 'blocked':
                 # falling through to the segment path would materialize
                 # the full [E, F] per-edge matrix — an OOM on exactly
                 # the large graphs those impls target
                 raise NotImplementedError(
                     f"AGGR_MAX has no {self.aggr_impl!r} implementation; "
-                    "use aggr_impl='ell' (big graphs) or 'segment' — the "
-                    "segment path materializes the full [E, F] per-edge "
-                    "matrix")
+                    "use aggr_impl='ell' (big graphs; sectioned carries "
+                    "no ELL tables and its additive carry can't max) or "
+                    "'segment' — the segment path materializes the full "
+                    "[E, F] per-edge matrix")
             g = full[self.edge_src]
             g = jnp.where((self.edge_src != dummy)[:, None], g, neg)
             out = jax.ops.segment_max(g, self.edge_dst,
@@ -192,24 +205,26 @@ class GraphContext:
 
 def _gctx_flatten(g: GraphContext):
     children = (g.edge_src, g.edge_dst, g.in_degree, g.ell_idx,
-                g.ell_row_pos, g.ring_idx)
+                g.ell_row_pos, g.ring_idx, g.sect_idx, g.sect_sub_dst)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
-           g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name)
+           g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
+           g.sect_meta)
     return children, aux
 
 
 def _gctx_unflatten(aux, children):
     (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
-     symmetric, halo, axis_name) = aux
-    edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx = \
-        children
+     symmetric, halo, axis_name, sect_meta) = aux
+    (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
+     sect_idx, sect_sub_dst) = children
     return GraphContext(
         edge_src=edge_src, edge_dst=edge_dst, in_degree=in_degree,
         num_rows=num_rows, gathered_rows=gathered_rows,
         gather_features=gather_features, psum=psum,
         aggr_impl=aggr_impl, chunk=chunk, symmetric=symmetric,
         ell_idx=ell_idx, ell_row_pos=ell_row_pos, halo=halo,
-        ring_idx=ring_idx, axis_name=axis_name)
+        ring_idx=ring_idx, axis_name=axis_name, sect_idx=sect_idx,
+        sect_sub_dst=sect_sub_dst, sect_meta=sect_meta)
 
 
 # GraphContext is a pytree so the graph tables travel as jit ARGUMENTS.

@@ -50,9 +50,13 @@ reference's whole-region input requirement, ``scattergather.cc:70-72``).
 
 **Measured (TPU v5 lite, 2026-07-29, V=50k E=10M F=256 fp32, median of
 10; benchmarks/measured_baselines.json has the full rows):** ``ell``
-119.1 ms / 86.0 GB/s, ``scan:4096`` 260.0 ms, ``blocked:1024`` 294.6 ms,
-Pallas ELL kernel 1006.2 ms — each including ~66 ms constant
-fetch-barrier overhead.  ``ell`` is the framework default by that data.
+119.1 ms / 86.0 GB/s, ``sectioned`` 131.1 ms, ``scan:4096`` 260.0 ms,
+``blocked:1024`` 294.6 ms, Pallas ELL kernel 1006.2 ms — each including
+~66 ms constant fetch-barrier overhead.  At REDDIT scale (V=233k,
+E=115M — gather table past VMEM) the ranking flips: ``sectioned``
+865 ms vs ``ell`` 2006 ms per aggregation, 2708 vs 7920.8 ms per train
+epoch (core/ell.py SectionedEll explains the mechanism).  The ``auto``
+default picks by table size.
 """
 
 from __future__ import annotations
@@ -210,6 +214,36 @@ def aggregate_ell(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,
     zero = jnp.zeros((1, F), dtype=feats.dtype)
     cat = jnp.concatenate(outs + [zero], axis=0)
     return cat[ell_row_pos]
+
+
+def aggregate_ell_sect(feats: jax.Array, sect_idx, sect_sub_dst,
+                       sect_meta, num_rows: int) -> jax.Array:
+    """Source-sectioned width-8 aggregation (core/ell.py SectionedEll —
+    the measured numbers and the why live on that dataclass).  Per
+    section: slice the <= 64 MiB source block out of ``feats`` (XLA
+    keeps it VMEM-resident), ``lax.scan`` over sub-row chunks carrying
+    the output — gather-sum ``xsec[idx].sum(1)`` hits the fast gather
+    path, then a sorted scatter-add of the ``[seg_rows, F]`` partials.
+
+    feats: [src_rows(+ optional trailing rows), F]; sections read
+      ``[start, start+size)`` so an appended global dummy row is fine.
+    sect_idx / sect_sub_dst: SectionedEll.idx / .sub_dst as jax arrays.
+    sect_meta: static tuple of (start, size) per section.
+    """
+    F = feats.shape[1]
+    out = jnp.zeros((num_rows + 1, F), dtype=feats.dtype)
+    zero = jnp.zeros((1, F), dtype=feats.dtype)
+    for (st, sz), tbl, sdst in zip(sect_meta, sect_idx, sect_sub_dst):
+        xsec = jnp.concatenate(
+            [lax.slice(feats, (st, 0), (st + sz, F)), zero], axis=0)
+
+        def body(o, ch, xsec=xsec):
+            idx_ch, dst_ch = ch
+            part = xsec[idx_ch].sum(axis=1)
+            return o.at[dst_ch].add(part, indices_are_sorted=True), None
+
+        out, _ = lax.scan(body, out, (tbl, sdst))
+    return out[:num_rows]
 
 
 def aggregate_ell_max(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,

@@ -200,6 +200,15 @@ class DistributedTrainer:
                 "features='host' streaming is single-device only; the "
                 "distributed >HBM mechanism is halo='ring' (the "
                 "autopilot picks it automatically for parts > 1)")
+        if config.aggr_impl == "sectioned":
+            raise NotImplementedError(
+                "aggr_impl='sectioned' is single-device for now (its "
+                "per-part chunk counts are not yet uniformized for "
+                "SPMD); use 'ell' with --parts > 1")
+        if config.aggr_impl == "auto":
+            # distributed auto = ell (see make_graph_context for the
+            # single-device size-based split)
+            config = dc_replace(config, aggr_impl="ell")
         self.config = config
         self.epoch = 0
         self.symmetric = resolve_symmetric(dataset, config.symmetric)

@@ -39,7 +39,10 @@ class TrainConfig:
     seed: int = 1
     eval_every: int = 5
     verbose: bool = True
-    aggr_impl: str = "segment"   # segment|blocked|scan|ell|pallas
+    # segment|blocked|scan|ell|sectioned|pallas|auto ("auto" = size-
+    # based: sectioned past VMEM table size, else ell; see
+    # make_graph_context)
+    aggr_impl: str = "segment"
     chunk: int = 512
     dtype: Any = jnp.float32
     # Halo exchange for the distributed step: "gather" (one-shot
@@ -118,9 +121,25 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     """Single-device GraphContext: edges padded to the chunk multiple,
     dummy source id == num_nodes (the appended zero row)."""
     g = dataset.graph
-    edge_src, edge_dst = padded_edge_list(g, multiple=chunk)
+    if aggr_impl == "auto":
+        # data-driven split (benchmarks/measured_baselines.json): the
+        # sectioned fast-gather layout wins once the gather table
+        # exceeds VMEM (~64 MiB); plain ELL wins below it
+        from ..core.ell import SECTION_ROWS_DEFAULT
+        aggr_impl = ("sectioned" if g.num_nodes > SECTION_ROWS_DEFAULT
+                     else "ell")
     ell_idx: tuple = ()
     ell_row_pos = None
+    sect_idx: tuple = ()
+    sect_sub_dst: tuple = ()
+    sect_meta: tuple = ()
+    if aggr_impl in ("ell", "pallas", "sectioned"):
+        # these paths never read the flat edge arrays — don't upload
+        # two [E] int32 tensors (~920 MB at Reddit scale) they'd ignore
+        edge_src = np.zeros(1, dtype=np.int32)
+        edge_dst = np.zeros(1, dtype=np.int32)
+    else:
+        edge_src, edge_dst = padded_edge_list(g, multiple=chunk)
     if aggr_impl in ("ell", "pallas"):
         # both consume the degree-bucketed ELL layout; "pallas" runs it
         # through the one-launch DMA kernel (kernels/ell_spmm.py)
@@ -128,6 +147,10 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         table = ell_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
         ell_idx = tuple(jnp.asarray(a[0]) for a in table.idx)
         ell_row_pos = jnp.asarray(table.row_pos[0])
+    elif aggr_impl == "sectioned":
+        from ..core.ell import sectioned_from_graph
+        sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
+        sect_idx, sect_sub_dst, sect_meta = sect.as_jax()
     return GraphContext(
         edge_src=jnp.asarray(edge_src),
         edge_dst=jnp.asarray(edge_dst),
@@ -139,6 +162,9 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         symmetric=resolve_symmetric(dataset, symmetric),
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
+        sect_idx=sect_idx,
+        sect_sub_dst=sect_sub_dst,
+        sect_meta=sect_meta,
     )
 
 
