@@ -279,18 +279,42 @@ class SectionedEll:
 SECTION_ROWS_DEFAULT = 65_536   # 64 MiB of fp32 rows at F=256
 
 
+def section_sub_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
+                       num_rows: int, src_rows: int,
+                       section_rows: int = SECTION_ROWS_DEFAULT
+                       ) -> np.ndarray:
+    """Per-section sub-row totals (the cheap metadata pass used to
+    agree on uniform chunk counts across SPMD partitions/hosts —
+    bincounts only, no table fill)."""
+    row_ptr = np.asarray(row_ptr)
+    col_idx = np.asarray(col_idx)
+    n_sec = max(1, -(-src_rows // section_rows))
+    dst_all = np.repeat(np.arange(num_rows, dtype=np.int64),
+                        np.diff(row_ptr))
+    sec_of = col_idx.astype(np.int64) // section_rows
+    out = np.zeros(n_sec, dtype=np.int64)
+    for s in range(n_sec):
+        cnt = np.bincount(dst_all[sec_of == s], minlength=num_rows)
+        out[s] = int((-(-cnt // 8)).sum())
+    return out
+
+
 def sectioned_from_graph(row_ptr: np.ndarray, col_idx: np.ndarray,
                          num_rows: int, src_rows: int = None,
                          section_rows: int = SECTION_ROWS_DEFAULT,
-                         seg_rows: int = 131_072) -> SectionedEll:
+                         seg_rows: int = 131_072,
+                         chunks_plan=None) -> SectionedEll:
     """Build the sectioned layout from a dst-major CSR.
 
     ``src_rows`` is the source-id space (defaults to ``num_rows``;
     the distributed gathered space when they differ).  ``section_rows``
     defaults to 64 MiB worth of fp32 rows at F=256 — pass less for
-    wider feature matrices.  Host-side prep is O(E) numpy (one pass
-    per section); ~50 s at Reddit scale — a native-extension candidate
-    if it ever gates a workflow (graph loads themselves are comparable).
+    wider feature matrices.  ``chunks_plan`` (per-section chunk counts,
+    from :func:`section_sub_counts` maxed across partitions) forces
+    uniform shapes for SPMD stacking; a section needing more chunks
+    than its plan raises.  Host-side prep is O(E) numpy (one pass per
+    section); ~30 s at Reddit scale — a native-extension candidate if
+    it ever gates a workflow (graph loads themselves are comparable).
     """
     row_ptr = np.asarray(row_ptr)
     col_idx = np.asarray(col_idx)
@@ -314,6 +338,13 @@ def sectioned_from_graph(row_ptr: np.ndarray, col_idx: np.ndarray,
         total_sub = int(sub_rows.sum())
         sec_size = min(section_rows, src_rows - s * section_rows)
         n_chunks = max(1, -(-total_sub // seg_rows))
+        if chunks_plan is not None:
+            if n_chunks > chunks_plan[s]:
+                raise ValueError(
+                    f"section {s}: needs {n_chunks} chunks > planned "
+                    f"{chunks_plan[s]} — the plan must come from "
+                    f"section_sub_counts over the same edges")
+            n_chunks = int(chunks_plan[s])
         pad = n_chunks * seg_rows - total_sub
         tbl = np.full((n_chunks * seg_rows, 8), sec_size,
                       dtype=np.int32)
@@ -337,3 +368,69 @@ def sectioned_from_graph(row_ptr: np.ndarray, col_idx: np.ndarray,
         section_rows=section_rows, seg_rows=seg_rows,
         sec_starts=tuple(starts), sec_sizes=tuple(sizes),
         idx=tuple(idxs), sub_dst=tuple(dsts))
+
+
+def sectioned_plan(counts_max: np.ndarray,
+                   seg_rows: int = 131_072) -> Tuple[int, list]:
+    """(seg_rows, per-section chunk counts) from elementwise-maxed
+    per-partition sub-row counts — THE single place the uniform-shape
+    agreement math lives (used by the all-parts builder and the
+    multi-host partition-local path; a divergence between the two
+    would only surface as a chunks_plan error at scale)."""
+    max_sub = int(np.max(counts_max)) if np.size(counts_max) else 1
+    seg = max(8, min(seg_rows, -(-max_sub // 8) * 8))
+    plan = [max(1, -(-int(c) // seg)) for c in np.asarray(counts_max)]
+    return seg, plan
+
+
+def clean_part_ptr(part_row_ptr: np.ndarray, real_nodes: int,
+                   part_nodes: int) -> np.ndarray:
+    """One partition's row pointers with padding edges dropped: rows
+    past ``real_nodes`` become empty instead of carrying the padded
+    edge tail."""
+    n = int(real_nodes)
+    ptr = part_row_ptr[:n + 1].astype(np.int64)
+    return np.concatenate(
+        [ptr, np.full(part_nodes - n, ptr[n], dtype=np.int64)])
+
+
+def sectioned_from_padded_parts(part_row_ptr: np.ndarray,
+                                part_col: np.ndarray,
+                                real_nodes: np.ndarray,
+                                part_nodes: int, src_rows: int,
+                                section_rows: int = SECTION_ROWS_DEFAULT,
+                                seg_rows: int = 131_072) -> SectionedEll:
+    """Uniform stacked per-part sectioned tables for the SPMD step:
+    ``idx[s]`` is ``[P, n_chunks_s, seg_rows, 8]`` and ``sub_dst[s]``
+    ``[P, n_chunks_s, seg_rows]`` — same static shapes on every device.
+    ``seg_rows`` shrinks to fit small graphs; per-section chunk counts
+    are the max over partitions (metadata pass + plan), so partitions
+    with fewer edges carry padding chunks that gather the section's
+    zero row into the dummy output row.
+
+    ``part_col`` is ``[P, part_edges]`` in gathered-row coordinates;
+    padding edges are excluded via the real row extents."""
+    P = part_row_ptr.shape[0]
+    ptrs = [clean_part_ptr(part_row_ptr[p], real_nodes[p], part_nodes)
+            for p in range(P)]
+    cols = [np.asarray(part_col[p][:int(ptrs[p][-1])])
+            for p in range(P)]
+    counts = np.stack([
+        section_sub_counts(ptrs[p], cols[p], part_nodes, src_rows,
+                           section_rows) for p in range(P)])
+    seg_rows, plan = sectioned_plan(counts.max(axis=0), seg_rows)
+    per_part = [
+        sectioned_from_graph(ptrs[p], cols[p], part_nodes,
+                             src_rows=src_rows,
+                             section_rows=section_rows,
+                             seg_rows=seg_rows, chunks_plan=plan)
+        for p in range(P)]
+    first = per_part[0]
+    return SectionedEll(
+        num_rows=part_nodes, src_rows=src_rows,
+        section_rows=section_rows, seg_rows=seg_rows,
+        sec_starts=first.sec_starts, sec_sizes=first.sec_sizes,
+        idx=tuple(np.stack([pp.idx[s] for pp in per_part])
+                  for s in range(len(first.idx))),
+        sub_dst=tuple(np.stack([pp.sub_dst[s] for pp in per_part])
+                      for s in range(len(first.sub_dst))))

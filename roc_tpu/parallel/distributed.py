@@ -128,6 +128,12 @@ class ShardedData:
     ell_idx: Tuple[jax.Array, ...] = ()   # per bucket [P, rows_b, width_b]
     ell_row_pos: jax.Array = None         # [P, part_nodes]
     ring_idx: Tuple[jax.Array, ...] = ()  # (src, dst) [P, S, pair_edges]
+    # sectioned layout (aggr_impl == "sectioned"): per section
+    # [P, n_chunks_s, seg_rows, 8] / [P, n_chunks_s, seg_rows], plus
+    # the static (start, size) metadata
+    sect_idx: Tuple[jax.Array, ...] = ()
+    sect_sub_dst: Tuple[jax.Array, ...] = ()
+    sect_meta: Tuple[Tuple[int, int], ...] = ()
     # padded slots / real edges of the ring tables (halo='ring' only);
     # surfaced so trainer setup can echo the SPMD-uniformity cost
     ring_padding_ratio: Optional[float] = None
@@ -137,7 +143,8 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   mesh: Mesh, dtype=jnp.float32,
                   aggr_impl: str = "segment",
                   halo: str = "gather",
-                  put=None) -> ShardedData:
+                  put=None, section_rows: Optional[int] = None
+                  ) -> ShardedData:
     """Build + upload the stacked per-part arrays.  ``put`` overrides
     the upload (default: replicated-process ``device_put`` with the
     parts sharding); parallel/multihost.py passes a local-shards-only
@@ -148,6 +155,9 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     ell_idx = ()
     ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
     ring_idx = ()
+    sect_idx = ()
+    sect_sub_dst = ()
+    sect_meta = ()
     ring_padding_ratio = None
     if halo == "ring":
         # ring tables fully describe the aggregation — skip the O(E)
@@ -160,16 +170,34 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
     else:
         col_padded = remap_to_padded(pg)
-        edge_dst = np.stack([
-            np.repeat(np.arange(pg.part_nodes, dtype=np.int32),
-                      np.diff(pg.part_row_ptr[p]))
-            for p in range(pg.num_parts)])
+        if aggr_impl in ("ell", "pallas", "sectioned"):
+            # table-driven paths never read the flat edge arrays —
+            # upload stubs instead of two [P, E_p] tensors
+            edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
+        else:
+            edge_dst = np.stack([
+                np.repeat(np.arange(pg.part_nodes, dtype=np.int32),
+                          np.diff(pg.part_row_ptr[p]))
+                for p in range(pg.num_parts)])
         if aggr_impl in ("ell", "pallas"):
             table = ell_from_padded_parts(
                 pg.part_row_ptr, col_padded, pg.real_nodes,
                 pg.part_nodes, dummy=pg.num_parts * pg.part_nodes)
             ell_idx = tuple(put(a) for a in table.idx)
             ell_row_pos = put(table.row_pos)
+        elif aggr_impl == "sectioned":
+            from ..core.ell import (SECTION_ROWS_DEFAULT,
+                                    sectioned_from_padded_parts)
+            sect = sectioned_from_padded_parts(
+                pg.part_row_ptr, col_padded, pg.real_nodes,
+                pg.part_nodes,
+                src_rows=pg.num_parts * pg.part_nodes,
+                section_rows=section_rows or SECTION_ROWS_DEFAULT)
+            sect_idx = tuple(put(a) for a in sect.idx)
+            sect_sub_dst = tuple(put(a) for a in sect.sub_dst)
+            sect_meta = tuple(zip(sect.sec_starts, sect.sec_sizes))
+        if aggr_impl in ("ell", "pallas", "sectioned"):
+            col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
         labels=put(pad_nodes(dataset.labels, pg)),
@@ -180,6 +208,9 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
         ring_idx=ring_idx,
+        sect_idx=sect_idx,
+        sect_sub_dst=sect_sub_dst,
+        sect_meta=sect_meta,
         ring_padding_ratio=ring_padding_ratio,
     )
 
@@ -200,15 +231,15 @@ class DistributedTrainer:
                 "features='host' streaming is single-device only; the "
                 "distributed >HBM mechanism is halo='ring' (the "
                 "autopilot picks it automatically for parts > 1)")
-        if config.aggr_impl == "sectioned":
-            raise NotImplementedError(
-                "aggr_impl='sectioned' is single-device for now (its "
-                "per-part chunk counts are not yet uniformized for "
-                "SPMD); use 'ell' with --parts > 1")
         if config.aggr_impl == "auto":
-            # distributed auto = ell (see make_graph_context for the
-            # single-device size-based split)
-            config = dc_replace(config, aggr_impl="ell")
+            # same size-based split as make_graph_context: sectioned's
+            # win comes from VMEM-sized gather tables, and the gathered
+            # matrix a partition aggregates from spans ALL nodes
+            from ..core.ell import SECTION_ROWS_DEFAULT
+            config = dc_replace(
+                config,
+                aggr_impl=("sectioned" if dataset.graph.num_nodes >
+                           SECTION_ROWS_DEFAULT else "ell"))
         self.config = config
         self.epoch = 0
         self.symmetric = resolve_symmetric(dataset, config.symmetric)
@@ -259,6 +290,7 @@ class DistributedTrainer:
             chunk=self.config.chunk,
             symmetric=self.symmetric,
             halo=self.config.halo,
+            sect_meta=self.data.sect_meta,
         )
 
     def _build_train_step(self):
@@ -268,7 +300,7 @@ class DistributedTrainer:
 
         def step(params, opt_state, feats, labels, mask, edge_src,
                  edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
-                 key, lr):
+                 sect_idx, sect_sub_dst, key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
@@ -278,7 +310,9 @@ class DistributedTrainer:
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
                 ell_row_pos=ell_row_pos[0],
-                ring_idx=tuple(a[0] for a in ring_idx))
+                ring_idx=tuple(a[0] for a in ring_idx),
+                sect_idx=tuple(a[0] for a in sect_idx),
+                sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
@@ -300,8 +334,8 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_r, spec_r),
+                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
+                      spec_p, spec_r, spec_r),
             out_specs=(spec_r, spec_r, spec_r),
             check_vma=False)
         return jax.jit(sm, donate_argnums=(0, 1))
@@ -312,7 +346,8 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, feats, labels, mask, edge_src, edge_dst,
-                 in_degree, ell_idx, ell_row_pos, ring_idx):
+                 in_degree, ell_idx, ell_row_pos, ring_idx, sect_idx,
+                 sect_sub_dst):
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
                                              in_degree[0])
@@ -321,7 +356,9 @@ class DistributedTrainer:
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
                 ell_row_pos=ell_row_pos[0],
-                ring_idx=tuple(a[0] for a in ring_idx))
+                ring_idx=tuple(a[0] for a in ring_idx),
+                sect_idx=tuple(a[0] for a in sect_idx),
+                sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
             logits = self.model.apply(params, feats, gctx, key=None,
                                       train=False)
             m = perf_metrics(logits, labels, mask)
@@ -331,7 +368,7 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p),
+                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p),
             out_specs=spec_r, check_vma=False)
         return jax.jit(sm)
 
@@ -345,7 +382,8 @@ class DistributedTrainer:
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, d.feats, d.labels,
                 d.mask, d.edge_src, d.edge_dst, d.in_degree,
-                d.ell_idx, d.ell_row_pos, d.ring_idx, step_key, lr)
+                d.ell_idx, d.ell_row_pos, d.ring_idx, d.sect_idx,
+                d.sect_sub_dst, step_key, lr)
 
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
@@ -361,7 +399,7 @@ class DistributedTrainer:
         m = summarize_metrics(jax.device_get(self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
             d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
-            d.ring_idx)))
+            d.ring_idx, d.sect_idx, d.sect_sub_dst)))
         m["epoch"] = epoch
         return m
 

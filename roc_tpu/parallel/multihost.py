@@ -106,6 +106,25 @@ def make_sharded_array(mesh: Mesh, local_parts: List[int],
         global_shape, sharding, singles)
 
 
+def _allreduce_part_vec_max(mesh: Mesh, local: List[int],
+                            vecs: dict) -> np.ndarray:
+    """Elementwise max over per-partition int vectors across all hosts
+    (each host knows only its own parts' vectors) — O(P * len) tiny
+    collective.  Single-process short-circuits."""
+    if jax.process_count() == 1:
+        return np.max(np.stack([vecs[p] for p in local]), axis=0)
+    import jax.numpy as jnp
+    num_parts = int(mesh.devices.size)
+    width = len(next(iter(vecs.values())))
+    arr = make_sharded_array(
+        mesh, local,
+        [np.asarray(vecs[p], dtype=np.int64)[None] for p in local],
+        (num_parts, width))
+    reduce = jax.jit(lambda a: jnp.max(a, axis=0),
+                     out_shardings=NamedSharding(mesh, P()))
+    return np.asarray(reduce(arr))
+
+
 def _allreduce_part_stats(mesh: Mesh, local: List[int],
                           stats: dict) -> Tuple[int, int]:
     """(global max of stat[0], global sum of stat[1]) over all
@@ -132,7 +151,8 @@ def _allreduce_part_stats(mesh: Mesh, local: List[int],
 
 def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                         aggr_impl: str = "segment",
-                        halo: str = "gather"):
+                        halo: str = "gather",
+                        section_rows: Optional[int] = None):
     """Multi-host version of ``distributed.shard_dataset``: each process
     BUILDS and uploads only its own partitions' shards — row-sliced
     loads via :class:`roc_tpu.core.source.DataSource`, per-partition
@@ -224,6 +244,7 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     # edge_src field and the ELL table build
     cols = {p: remap_col_to_padded(pg, partition_col(pg, src.col_slice, p))
             for p in local}
+    use_stub = aggr_impl in ("ell", "pallas", "sectioned")
 
     def edge_src_build(p):
         return cols[p]
@@ -258,6 +279,41 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
             for wi, w in enumerate(widths))
         ell_row_pos = put_parts(lambda p: tables[p][1], (pn,), np.int32)
 
+    sect_idx = ()
+    sect_sub_dst = ()
+    sect_meta = ()
+    if aggr_impl == "sectioned":
+        # uniform chunk plan from an O(P * n_sec) elementwise-max
+        # collective over per-part sub-row counts — same agreement
+        # pattern as the ring's pair width, never a whole-graph pass
+        from ..core.ell import (SECTION_ROWS_DEFAULT, clean_part_ptr,
+                                section_sub_counts, sectioned_from_graph,
+                                sectioned_plan)
+        sec_rows = section_rows or SECTION_ROWS_DEFAULT
+        src_rows = P * pn
+        ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
+                                  pn) for p in local}
+        cnts = {p: section_sub_counts(
+            ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows,
+            sec_rows) for p in local}
+        counts_max = _allreduce_part_vec_max(mesh, local, cnts)
+        seg, plan = sectioned_plan(counts_max)
+        sects = {p: sectioned_from_graph(
+            ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows=src_rows,
+            section_rows=sec_rows, seg_rows=seg, chunks_plan=plan)
+            for p in local}
+        first = sects[local[0]]
+        sect_idx = tuple(
+            put_parts(lambda p, s=s: sects[p].idx[s],
+                      (plan[s], seg, 8), np.int32)
+            for s in range(len(first.idx)))
+        sect_sub_dst = tuple(
+            put_parts(lambda p, s=s: sects[p].sub_dst[s],
+                      (plan[s], seg), np.int32)
+            for s in range(len(first.sub_dst)))
+        sect_meta = tuple(zip(first.sec_starts, first.sec_sizes))
+
+    stub_build = lambda p: np.zeros(1, np.int32)
     return ShardedData(
         feats=put_parts(node_field(src.features, 0, np.float32,
                                    (src.in_dim,)),
@@ -266,11 +322,16 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                          np.int32),
         mask=put_parts(node_field(src.mask, MASK_NONE, np.int32), (pn,),
                        np.int32),
-        edge_src=put_parts(edge_src_build, (pe,), np.int32),
-        edge_dst=put_parts(edge_dst_build, (pe,), np.int32),
+        edge_src=put_parts(stub_build if use_stub else edge_src_build,
+                           (1,) if use_stub else (pe,), np.int32),
+        edge_dst=put_parts(stub_build if use_stub else edge_dst_build,
+                           (1,) if use_stub else (pe,), np.int32),
         in_degree=put_parts(lambda p: pg.part_in_degree[p], (pn,),
                             np.int32),
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
         ring_idx=ring_idx,
+        sect_idx=sect_idx,
+        sect_sub_dst=sect_sub_dst,
+        sect_meta=sect_meta,
     )

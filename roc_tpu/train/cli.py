@@ -59,8 +59,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="aggregation backend; auto = 'sectioned' (the "
                          "source-sectioned fast-gather layout, measured "
                          "2.3x over 'ell' at Reddit scale) for graphs "
-                         "past VMEM table size, else 'ell'; "
-                         "multi-part runs use 'ell'")
+                         "past VMEM table size, else 'ell'")
     ap.add_argument("--halo", default="gather",
                     choices=["gather", "ring"],
                     help="distributed halo exchange: one-shot "

@@ -234,3 +234,23 @@ def test_trainer_ring_on_file_source_local_shards(disk_ds):
     tr.data = mh.shard_dataset_local(fs, tr.pg, mesh, halo="ring")
     tr.train(epochs=2)
     assert np.isfinite(tr.evaluate()["train_loss"])
+
+
+def test_shard_dataset_local_sectioned_matches_global():
+    """Partition-local sectioned prep (per-part counts + O(P*n_sec)
+    max collective for the uniform chunk plan) must produce the same
+    tables as the global build."""
+    from roc_tpu.parallel import multihost as mh
+    from roc_tpu.parallel.distributed import shard_dataset
+
+    ds = synthetic_dataset(96, 7, in_dim=8, num_classes=3, seed=6)
+    mesh = mh.make_parts_mesh(4)
+    pg = partition_graph(ds.graph, 4, edge_multiple=64)
+    want = shard_dataset(ds, pg, mesh, aggr_impl="sectioned")
+    got = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="sectioned")
+    assert got.sect_meta == want.sect_meta
+    assert len(got.sect_idx) == len(want.sect_idx)
+    for a, b in zip(got.sect_idx, want.sect_idx):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(got.sect_sub_dst, want.sect_sub_dst):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
