@@ -20,6 +20,9 @@
 #include <cstdlib>
 #include <cstring>
 
+#include <algorithm>
+#include <vector>
+
 namespace {
 
 constexpr int kOk = 0;
@@ -452,6 +455,97 @@ int roc_ell_widths(const int64_t* row_ptr, int64_t num_rows,
     int32_t w = min_width;
     while (w < d) w *= 2;
     widths[v] = d == 0 ? 0 : w;
+  }
+  return kOk;
+}
+
+// ---------------------------------------------------------------------------
+// Sectioned fast-gather layout prep (core/ell.py SectionedEll): the
+// O(E) host pass that splits each dst row's neighbor list by source
+// section and emits width-8 sub-rows.  Two passes behind a C ABI with
+// caller-allocated buffers, like everything else in this file:
+// counts (so Python can compute the uniform chunk plan and allocate)
+// then fill.  Both walk the dst-major CSR once — O(E + V * n_sec).
+// ---------------------------------------------------------------------------
+
+int roc_sectioned_counts(const int64_t* row_ptr, const int32_t* col,
+                         int64_t num_rows, int64_t section_rows,
+                         int64_t n_sec, int64_t* counts) {
+  std::vector<int64_t> local(static_cast<size_t>(n_sec));
+  for (int64_t s = 0; s < n_sec; ++s) counts[s] = 0;
+  for (int64_t v = 0; v < num_rows; ++v) {
+    std::fill(local.begin(), local.end(), 0);
+    for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
+      int64_t s = col[e] / section_rows;
+      if (col[e] < 0 || s >= n_sec) return kErrValue;  // out of range
+      local[static_cast<size_t>(s)] += 1;
+    }
+    for (int64_t s = 0; s < n_sec; ++s) {
+      counts[s] += (local[static_cast<size_t>(s)] + 7) / 8;
+    }
+  }
+  return kOk;
+}
+
+// sec_sizes[s]: the section's row count == its local dummy id.
+// slots[s]: allocated sub-rows per section (chunk plan * seg_rows);
+// must be >= the counts pass's result or kErrValue is returned.
+// idx_flat: [sum(slots) * 8] int32; sub_dst_flat: [sum(slots)] int32.
+// Sub-rows are emitted in ascending dst order per section (matching
+// the numpy builder exactly); leftover slots become padding sub-rows
+// (idx = section dummy, sub_dst = num_rows).
+int roc_sectioned_fill(const int64_t* row_ptr, const int32_t* col,
+                       int64_t num_rows, int64_t section_rows,
+                       int64_t n_sec, const int64_t* sec_sizes,
+                       const int64_t* slots, int32_t* idx_flat,
+                       int32_t* sub_dst_flat) {
+  std::vector<int64_t> cursor(static_cast<size_t>(n_sec));
+  std::vector<int64_t> limit(static_cast<size_t>(n_sec));
+  int64_t off = 0;
+  for (int64_t s = 0; s < n_sec; ++s) {
+    cursor[static_cast<size_t>(s)] = off;
+    off += slots[s];
+    limit[static_cast<size_t>(s)] = off;
+  }
+  std::vector<std::vector<int32_t>> buf(static_cast<size_t>(n_sec));
+  for (int64_t v = 0; v < num_rows; ++v) {
+    for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
+      int64_t s = col[e] / section_rows;
+      if (col[e] < 0 || s >= n_sec) return kErrValue;  // out of range
+      buf[static_cast<size_t>(s)].push_back(
+          static_cast<int32_t>(col[e] - s * section_rows));
+    }
+    for (int64_t s = 0; s < n_sec; ++s) {
+      std::vector<int32_t>& b = buf[static_cast<size_t>(s)];
+      if (b.empty()) continue;
+      int64_t nsub = (static_cast<int64_t>(b.size()) + 7) / 8;
+      if (cursor[static_cast<size_t>(s)] + nsub >
+          limit[static_cast<size_t>(s)]) {
+        return kErrValue;  // plan smaller than the counts pass said
+      }
+      int64_t base = cursor[static_cast<size_t>(s)] * 8;
+      for (int64_t k = 0; k < nsub * 8; ++k) {
+        idx_flat[base + k] =
+            k < static_cast<int64_t>(b.size())
+                ? b[static_cast<size_t>(k)]
+                : static_cast<int32_t>(sec_sizes[s]);
+      }
+      for (int64_t j = 0; j < nsub; ++j) {
+        sub_dst_flat[cursor[static_cast<size_t>(s)] + j] =
+            static_cast<int32_t>(v);
+      }
+      cursor[static_cast<size_t>(s)] += nsub;
+      b.clear();
+    }
+  }
+  for (int64_t s = 0; s < n_sec; ++s) {
+    for (int64_t slot = cursor[static_cast<size_t>(s)];
+         slot < limit[static_cast<size_t>(s)]; ++slot) {
+      for (int64_t k = 0; k < 8; ++k) {
+        idx_flat[slot * 8 + k] = static_cast<int32_t>(sec_sizes[s]);
+      }
+      sub_dst_flat[slot] = static_cast<int32_t>(num_rows);
+    }
   }
   return kOk;
 }

@@ -100,6 +100,11 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.roc_add_self_edges.argtypes = [i64p, i32p, i64, i64p, i32p, i64]
     lib.roc_ell_widths.restype = c.c_int
     lib.roc_ell_widths.argtypes = [i64p, i64, c.c_int32, i32p]
+    lib.roc_sectioned_counts.restype = c.c_int
+    lib.roc_sectioned_counts.argtypes = [i64p, i32p, i64, i64, i64, i64p]
+    lib.roc_sectioned_fill.restype = c.c_int
+    lib.roc_sectioned_fill.argtypes = [i64p, i32p, i64, i64, i64, i64p,
+                                       i64p, i32p, i32p]
     _lib = lib
     return _lib
 
@@ -217,3 +222,45 @@ def ell_widths(row_ptr: np.ndarray, min_width: int = 8) -> np.ndarray:
     if rc != 0:
         raise ValueError(f"roc_ell_widths failed: {rc}")
     return out
+
+
+def sectioned_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
+                     num_rows: int, section_rows: int,
+                     n_sec: int) -> np.ndarray:
+    """Per-section width-8 sub-row totals (core/ell.py sectioned prep,
+    counts pass)."""
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
+    out = np.empty(n_sec, dtype=np.int64)
+    rc = lib.roc_sectioned_counts(_i64p(row_ptr), _i32p(col_idx),
+                                  num_rows, section_rows, n_sec,
+                                  _i64p(out))
+    if rc != 0:
+        raise ValueError(f"roc_sectioned_counts failed: {rc}")
+    return out
+
+
+def sectioned_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
+                   num_rows: int, section_rows: int,
+                   sec_sizes: np.ndarray,
+                   slots: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Fill pass: (idx_flat [sum(slots), 8], sub_dst_flat [sum(slots)])
+    with per-section regions laid out consecutively in section order."""
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
+    sec_sizes = np.ascontiguousarray(sec_sizes, dtype=np.int64)
+    slots = np.ascontiguousarray(slots, dtype=np.int64)
+    total = int(slots.sum())
+    idx_flat = np.empty((total, 8), dtype=np.int32)
+    sub_dst = np.empty(total, dtype=np.int32)
+    rc = lib.roc_sectioned_fill(
+        _i64p(row_ptr), _i32p(col_idx), num_rows, section_rows,
+        slots.shape[0], _i64p(sec_sizes), _i64p(slots), _i32p(idx_flat),
+        _i32p(sub_dst))
+    if rc != 0:
+        raise ValueError(f"roc_sectioned_fill failed: {rc}")
+    return idx_flat, sub_dst

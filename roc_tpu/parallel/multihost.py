@@ -300,8 +300,8 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
         seg, plan = sectioned_plan(counts_max)
         sects = {p: sectioned_from_graph(
             ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows=src_rows,
-            section_rows=sec_rows, seg_rows=seg, chunks_plan=plan)
-            for p in local}
+            section_rows=sec_rows, seg_rows=seg, chunks_plan=plan,
+            counts=cnts[p]) for p in local}
         first = sects[local[0]]
         sect_idx = tuple(
             put_parts(lambda p, s=s: sects[p].idx[s],
