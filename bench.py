@@ -249,11 +249,9 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
     layers = [int(x) for x in args.layers.split("-")]
     if args.impl == "auto":
         # resolve here so the recorded baseline names the kernel that
-        # actually ran, not the CLI alias (same rule as
-        # make_graph_context)
-        from roc_tpu.core.ell import SECTION_ROWS_DEFAULT
-        args.impl = ("sectioned" if nodes > SECTION_ROWS_DEFAULT
-                     else "ell")
+        # actually ran, not the CLI alias
+        from roc_tpu.core.ell import resolve_auto_impl
+        args.impl = resolve_auto_impl(nodes)
     t0 = time.time()
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} {dev.device_kind} "

@@ -95,16 +95,19 @@ def main():
             sect = sectioned_from_graph(g.row_ptr, g.col_idx, V)
             prep = time.time() - t0
             sidx, sdst, meta = sect.as_jax()
-            f = jax.jit(lambda x, i=sidx, d=sdst:
+            # tables as ARGUMENTS: closure/default-arg capture embeds
+            # them as HLO constants and overflows the remote-compile
+            # request past ~100 MB of tables
+            f = jax.jit(lambda x, i, d:
                         aggregate_ell_sect(x, i, d, meta, V))
-            ms = bench(lambda: f(feats), args.iters)
+            ms = bench(lambda: f(feats, sidx, sdst), args.iters)
             print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
                   f"(prep {prep:.1f}s)")
             continue
         if impl == "ell":
             (idx, pos), prep = get_ell()
-            f = jax.jit(lambda x: aggregate_ell(x, idx, pos, V))
-            ms = bench(lambda: f(feats), args.iters)
+            f = jax.jit(lambda x, i, p: aggregate_ell(x, i, p, V))
+            ms = bench(lambda: f(feats, idx, pos), args.iters)
             print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
                   f"(prep {prep:.1f}s)")
             continue
@@ -114,9 +117,10 @@ def main():
             # for: same ELL tables as the XLA 'ell' row above
             from roc_tpu.kernels.ell_spmm import ell_aggregate_pallas
             (idx, pos), prep = get_ell()
-            f = jax.jit(lambda x: ell_aggregate_pallas(x, idx, pos, V))
+            f = jax.jit(lambda x, i, p:
+                        ell_aggregate_pallas(x, i, p, V))
             try:
-                ms = bench(lambda: f(feats), args.iters)
+                ms = bench(lambda: f(feats, idx, pos), args.iters)
                 print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
                       f"(prep {prep:.1f}s)")
             except Exception as e:  # noqa: BLE001 - report and continue
@@ -124,10 +128,10 @@ def main():
             continue
         src, dst = padded_edge_list(g, multiple=chunk)
         srcj, dstj = jnp.asarray(src), jnp.asarray(dst)
-        f = jax.jit(lambda x, s=srcj, d=dstj, i=impl, c=chunk:
+        f = jax.jit(lambda x, s, d, i=impl, c=chunk:
                     aggregate(x, s, d, V, impl=i, chunk=c))
         try:
-            ms = bench(lambda: f(feats), args.iters)
+            ms = bench(lambda: f(feats, srcj, dstj), args.iters)
             print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s")
         except Exception as e:  # noqa: BLE001 - report and continue
             print(f"{spec:16s} FAILED: {type(e).__name__}: {e}")

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""One-chip epoch-time measurements for BASELINE.md configs 3-5 shapes.
+
+The bench.py headline covers configs 1-2 (Cora accuracy gate + Reddit
+GCN).  This script times the remaining model-family configs on
+synthetic graphs with the real datasets' V/E/F shapes (epoch time is
+independent of edge identity):
+
+  3  GraphSAGE-mean, ogbn-arxiv shape   (169k nodes, 2.3M directed
+     edges -> ~4.6M symmetric+self, 128 feats, 40 classes)
+  4  GCN, ogbn-products shape           (2.45M nodes, ~126M
+     symmetric+self edges, 100 feats, 47 classes) — the reference
+     runs this 4-way; one chip is the per-device slice x4 workload
+  5  GIN sum-aggregation + MLP, Amazon-2M shape (same graph family as
+     products; 2-layer GIN MLP)
+
+Usage: python benchmarks/model_zoo.py [--config 3|4|5] [--epochs N]
+Appends results to benchmarks/model_zoo.jsonl.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CONFIGS = {
+    "3": dict(model="sage", nodes=169_343, edges=4_600_000,
+              layers=(128, 256, 40)),
+    "4": dict(model="gcn", nodes=2_449_029, edges=126_000_000,
+              layers=(100, 256, 47)),
+    "5": dict(model="gin", nodes=2_449_029, edges=126_000_000,
+              layers=(100, 256, 47)),
+}
+_OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    "model_zoo.jsonl")
+
+
+def run(cfg_key: str, epochs: int, impl: str) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from roc_tpu.core.graph import Dataset, random_csr
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.models.gin import build_gin
+    from roc_tpu.models.sage import build_sage
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+
+    c = CONFIGS[cfg_key]
+    layers = list(c["layers"])
+    if impl == "auto":
+        # record the kernel that actually runs, not the CLI alias
+        from roc_tpu.core.ell import resolve_auto_impl
+        impl = resolve_auto_impl(c["nodes"])
+    dev = jax.devices()[0]
+    print(f"# config {cfg_key}: {c['model']} V={c['nodes']} "
+          f"E={c['edges']} on {dev.device_kind}", file=sys.stderr)
+    t0 = time.time()
+    graph = random_csr(c["nodes"], c["edges"], seed=0)
+    rng = np.random.RandomState(1)
+    ds = Dataset(
+        graph=graph,
+        features=rng.rand(c["nodes"], layers[0]).astype(np.float32),
+        labels=rng.randint(0, layers[-1],
+                           size=c["nodes"]).astype(np.int32),
+        mask=rng.choice([1, 2, 3], size=c["nodes"],
+                        p=[0.66, 0.10, 0.24]).astype(np.int32),
+        num_classes=layers[-1], name=f"config{cfg_key}-synth")
+    print(f"# data gen {time.time()-t0:.0f}s", file=sys.stderr)
+
+    build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin}
+    model = build[c["model"]](layers, dropout_rate=0.5)
+    # GIN aggregates raw F-wide features (dropout output feeds
+    # scatter_gather directly), which the ELL-family impls handle;
+    # 'auto' resolves per the measured window (ell at products scale,
+    # sectioned at arxiv scale — core/ell.py resolve_auto_impl)
+    # memory="auto": the products/Amazon shapes exceed HBM without
+    # remat — the autopilot estimates and picks (echoed on stderr)
+    tc = TrainConfig(learning_rate=0.01, weight_decay=1e-4,
+                     aggr_impl=impl, dtype=jnp.float32, verbose=True,
+                     eval_every=1 << 30, symmetric=True, memory="auto")
+    t0 = time.time()
+    tr = Trainer(model, ds, tc)
+    tr.train(epochs=2)
+    tr.sync()
+    compile_s = time.time() - t0
+    print(f"# prep+compile+warmup {compile_s:.0f}s", file=sys.stderr)
+    times = []
+    for _ in range(epochs):
+        t0 = time.time()
+        tr.train(epochs=1)
+        tr.sync()
+        times.append((time.time() - t0) * 1e3)
+    rec = {"config": cfg_key, "model": c["model"], "V": c["nodes"],
+           "E": int(graph.num_edges), "layers": layers, "impl": impl,
+           "platform": dev.platform, "device_kind": dev.device_kind,
+           "epoch_ms": round(float(np.median(times)), 1),
+           "epoch_ms_all": [round(t) for t in times],
+           "compile_s": round(compile_s, 1),
+           "recorded": time.strftime("%Y-%m-%dT%H:%M:%S%z")}
+    print(f"# epochs (ms): {rec['epoch_ms_all']}", file=sys.stderr)
+    with open(_OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="3", choices=list(CONFIGS))
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--impl", default="auto")
+    args = ap.parse_args()
+    run(args.config, args.epochs, args.impl)
+
+
+if __name__ == "__main__":
+    main()

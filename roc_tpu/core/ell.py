@@ -30,7 +30,7 @@ shard over the 1-D parts mesh with identical static shapes per device.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -277,6 +277,37 @@ class SectionedEll:
 
 
 SECTION_ROWS_DEFAULT = 65_536   # 64 MiB of fp32 rows at F=256
+
+# Upper bound of the sectioned layout's winning range (v5e, F=256,
+# median of 5, benchmarks/micro_agg.py 2026-07-30):
+#   V=233k: sectioned 865 ms vs ell 2006 ms  (2.3x win)
+#   V=500k: sectioned 440 ms vs ell 477 ms   (marginal win)
+#   V=1M:   sectioned 964 ms vs ell 440 ms   (2.2x LOSS)
+#   V=2.45M: sectioned 3784 ms vs ell 1010 ms (3.7x loss)
+# Past ~0.6M output rows the carry-scan's scatter-add dominates (the
+# [V, F] carry is rewritten every chunk step), so 'auto' hands back to
+# the whole-table ELL gather.
+SECTIONED_MAX_ROWS = 600_000
+
+
+def resolve_auto_impl(num_nodes: int,
+                      out_rows: Optional[int] = None) -> str:
+    """The data-driven ``aggr_impl='auto'`` split — ONE place for the
+    rule (trainer, distributed, bench, model zoo all call this):
+    ``sectioned`` in its measured winning window, ``ell`` outside.
+
+    The two bounds scale with different sizes: the LOWER bound is the
+    gathered source-table size (global ``num_nodes`` — sectioned's win
+    is VMEM-resident section gathers, and a partition gathers from ALL
+    nodes), while the UPPER bound is the scatter-add carry ``[out_rows,
+    F]`` rewritten every chunk step — per-partition ``out_rows`` in
+    distributed runs (defaults to ``num_nodes`` single-device)."""
+    if out_rows is None:
+        out_rows = num_nodes
+    if num_nodes > SECTION_ROWS_DEFAULT and \
+            out_rows <= SECTIONED_MAX_ROWS:
+        return "sectioned"
+    return "ell"
 
 
 def section_sub_counts(row_ptr: np.ndarray, col_idx: np.ndarray,

@@ -232,14 +232,16 @@ class DistributedTrainer:
                 "distributed >HBM mechanism is halo='ring' (the "
                 "autopilot picks it automatically for parts > 1)")
         if config.aggr_impl == "auto":
-            # same size-based split as make_graph_context: sectioned's
-            # win comes from VMEM-sized gather tables, and the gathered
-            # matrix a partition aggregates from spans ALL nodes
-            from ..core.ell import SECTION_ROWS_DEFAULT
+            # data-driven split: the gather-table bound uses the
+            # GLOBAL node count (a partition gathers from all nodes);
+            # the scatter-carry bound uses the per-partition output
+            # rows (resolve_auto_impl docstring)
+            from ..core.ell import resolve_auto_impl
+            v = dataset.graph.num_nodes
             config = dc_replace(
                 config,
-                aggr_impl=("sectioned" if dataset.graph.num_nodes >
-                           SECTION_ROWS_DEFAULT else "ell"))
+                aggr_impl=resolve_auto_impl(
+                    v, out_rows=-(-v // num_parts)))
         self.config = config
         self.epoch = 0
         self.symmetric = resolve_symmetric(dataset, config.symmetric)

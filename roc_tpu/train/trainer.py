@@ -39,9 +39,9 @@ class TrainConfig:
     seed: int = 1
     eval_every: int = 5
     verbose: bool = True
-    # segment|blocked|scan|ell|sectioned|pallas|auto ("auto" = size-
-    # based: sectioned past VMEM table size, else ell; see
-    # make_graph_context)
+    # segment|blocked|scan|ell|sectioned|pallas|auto ("auto" picks
+    # sectioned in its measured node-count window, ell outside —
+    # core/ell.py resolve_auto_impl)
     aggr_impl: str = "segment"
     chunk: int = 512
     dtype: Any = jnp.float32
@@ -122,12 +122,11 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     dummy source id == num_nodes (the appended zero row)."""
     g = dataset.graph
     if aggr_impl == "auto":
-        # data-driven split (benchmarks/measured_baselines.json): the
-        # sectioned fast-gather layout wins once the gather table
-        # exceeds VMEM (~64 MiB); plain ELL wins below it
-        from ..core.ell import SECTION_ROWS_DEFAULT
-        aggr_impl = ("sectioned" if g.num_nodes > SECTION_ROWS_DEFAULT
-                     else "ell")
+        # data-driven split: sectioned wins in its measured node-count
+        # window, ell outside it (core/ell.py resolve_auto_impl has
+        # the numbers)
+        from ..core.ell import resolve_auto_impl
+        aggr_impl = resolve_auto_impl(g.num_nodes)
     ell_idx: tuple = ()
     ell_row_pos = None
     sect_idx: tuple = ()
