@@ -34,9 +34,13 @@ from typing import Dict, List, Optional, Sequence
 # Activation-liveness factors: a GCN-family layer keeps roughly this
 # many [V_p, H] intermediates alive for backward (dropout out, linear
 # out, two norms, aggregation out, relu out) without remat; with
-# jax.checkpoint only the layer boundaries survive.
+# jax.checkpoint the layer boundaries survive plus the saved
+# aggregation outputs (the default save_aggregates policy,
+# train/trainer.py remat_policy — recomputing the halo gather + CSR
+# sum would dominate the remat overhead).
 _ACT_FACTOR_SAVED = 6
-_ACT_FACTOR_REMAT = 2
+_ACT_FACTOR_REMAT_SAVE_AGG = 3   # layer boundaries + saved aggregates
+_ACT_FACTOR_REMAT_FULL = 2       # layer boundaries only
 # Default usable fraction of physical HBM (XLA reserves workspace,
 # and the estimate is deliberately coarse).
 _USABLE = 0.85
@@ -86,7 +90,8 @@ def estimate_plan_bytes(num_nodes: int, num_edges: int,
                         layer_dims: Sequence[int], num_parts: int = 1,
                         dtype_bytes: int = 4, halo: str = "gather",
                         features: str = "hbm", remat: bool = False,
-                        ring_padding: float = 1.7) -> int:
+                        ring_padding: float = 1.7,
+                        remat_policy: str = "save_aggregates") -> int:
     """Coarse per-device peak-HBM estimate for one train step.
 
     ``layer_dims`` is the CLI layer spec (in-dim, hidden..., classes).
@@ -116,7 +121,11 @@ def estimate_plan_bytes(num_nodes: int, num_edges: int,
         total += int(2 * E_p * 4 * ring_padding)  # src+dst flat tables
 
     # live activations
-    act = _ACT_FACTOR_REMAT if remat else _ACT_FACTOR_SAVED
+    if remat:
+        act = (_ACT_FACTOR_REMAT_FULL if remat_policy == "full"
+               else _ACT_FACTOR_REMAT_SAVE_AGG)
+    else:
+        act = _ACT_FACTOR_SAVED
     act_bytes = sum(V_p * h * b * act for h in hiddens)
     if features == "hbm":
         # first dropout output is [V_p, F]
@@ -135,7 +144,9 @@ def choose_memory_plan(num_nodes: int, num_edges: int,
                        layer_dims: Sequence[int], num_parts: int = 1,
                        dtype_bytes: int = 4,
                        hbm_bytes: Optional[int] = None,
-                       head_streamable: bool = True) -> MemoryPlan:
+                       head_streamable: bool = True,
+                       remat_policy: str = "save_aggregates"
+                       ) -> MemoryPlan:
     """First-fit over plans ordered cheapest-compute-first.
 
     Order: gather/hbm -> gather/hbm+remat -> ring (P>1, +-remat) ->
@@ -158,7 +169,8 @@ def choose_memory_plan(num_nodes: int, num_edges: int,
     for name, halo, feats, remat in cands:
         est[name] = estimate_plan_bytes(
             num_nodes, num_edges, layer_dims, num_parts, dtype_bytes,
-            halo=halo, features=feats, remat=remat)
+            halo=halo, features=feats, remat=remat,
+            remat_policy=remat_policy)
     for name, halo, feats, remat in cands:
         if est[name] <= budget:
             return MemoryPlan(

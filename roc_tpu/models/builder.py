@@ -26,6 +26,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 import numpy as np
 
 from ..ops import dense
@@ -403,7 +404,12 @@ class Model:
             elif op.kind == "indegree_norm":
                 vals[i] = indegree_norm(x, gctx.in_degree)
             elif op.kind == "scatter_gather":
-                vals[i] = gctx.aggregate(x, op.attrs["aggr"])
+                # named so the remat policy can SAVE aggregation
+                # outputs: recomputing the halo gather + CSR sum in
+                # backward is the one thing worth activation memory
+                # (train/trainer.py remat_policy="save_aggregates")
+                vals[i] = checkpoint_name(
+                    gctx.aggregate(x, op.attrs["aggr"]), "aggregate")
             elif op.kind == "activation":
                 vals[i] = dense.activation(x, op.attrs["mode"])
             elif op.kind == "add":

@@ -47,7 +47,8 @@ from ..models.builder import GraphContext, Model
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics, summarize_metrics
 from ..train.optimizer import (AdamConfig, AdamState, adam_init,
                                adam_update)
-from ..train.trainer import TrainConfig, resolve_symmetric
+from ..train.trainer import (TrainConfig, remat_policy,
+                             resolve_symmetric)
 
 
 def make_mesh(num_parts: Optional[int] = None,
@@ -323,7 +324,8 @@ class DistributedTrainer:
                 return masked_softmax_cross_entropy(logits, labels, mask)
 
             if self.config.remat:
-                local_loss = jax.checkpoint(local_loss)
+                local_loss = jax.checkpoint(
+                    local_loss, policy=remat_policy(self.config))
             local_l, grads = jax.value_and_grad(local_loss)(params)
             # the reference's replica-sum gradient allreduce
             # (optimizer_kernel.cu:88-94) as an ICI psum

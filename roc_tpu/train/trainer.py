@@ -73,10 +73,31 @@ class TrainConfig:
     #   core/memory.choose_memory_plan over the dataset/model shapes
     #   and overrides them with the first plan that fits hbm_bytes
     #   (None = detect), echoing the decision at setup.
+    # - remat_policy: "full" recomputes everything; "save_aggregates"
+    #   saves the scatter_gather outputs (the halo gather + CSR sum is
+    #   by far the most expensive recompute: at products scale a full
+    #   remat spends ~2/3 of its overhead re-aggregating) and
+    #   recomputes only the cheap dense/elementwise ops.
     remat: bool = False
+    remat_policy: str = "save_aggregates"
     features: str = "hbm"
     memory: str = "manual"
     hbm_bytes: Optional[int] = None
+
+
+def remat_policy(config: TrainConfig):
+    """jax.checkpoint policy for ``config.remat_policy``: None (full
+    recompute) or save-named-aggregates (models/builder.py tags every
+    scatter_gather output with checkpoint_name 'aggregate').  An
+    unknown name raises — a typo must not silently change the memory
+    footprint."""
+    if config.remat_policy == "full":
+        return None
+    if config.remat_policy != "save_aggregates":
+        raise ValueError(
+            f"unknown remat_policy {config.remat_policy!r}; expected "
+            "'save_aggregates' or 'full'")
+    return jax.checkpoint_policies.save_only_these_names("aggregate")
 
 
 def resolve_symmetric(dataset: Dataset,
@@ -106,7 +127,8 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
         num_parts=num_parts,
         dtype_bytes=jnp.dtype(config.dtype).itemsize,
         hbm_bytes=config.hbm_bytes,
-        head_streamable=model.streamable_head() is not None)
+        head_streamable=model.streamable_head() is not None,
+        remat_policy=config.remat_policy)
     if config.verbose:
         print(plan.echo(), file=sys.stderr)
     return dataclasses.replace(
@@ -233,7 +255,8 @@ class Trainer:
                                          gctx, key=key, train=True)
             return loss
         if self.config.remat:
-            objective = jax.checkpoint(objective)
+            objective = jax.checkpoint(
+                objective, policy=remat_policy(self.config))
         loss, grads = jax.value_and_grad(objective)(params)
         params, opt_state = adam_update(params, grads, opt_state, lr,
                                         self.adam_cfg)
@@ -255,7 +278,8 @@ class Trainer:
                                                train=True)
             return loss
         if self.config.remat:
-            objective = jax.checkpoint(objective)
+            objective = jax.checkpoint(
+                objective, policy=remat_policy(self.config))
         loss, (gp, gy) = jax.value_and_grad(objective, argnums=(0, 1))(
             params, y)
         return loss, gp, gy

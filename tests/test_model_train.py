@@ -139,3 +139,31 @@ def test_adam_matches_reference_formula():
     alpha_t = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
     want = 2.0 - alpha_t * mt / (np.sqrt(vt) + 1e-8)
     np.testing.assert_allclose(np.asarray(new_p["w"])[0], want, rtol=1e-6)
+
+
+def test_remat_policies_train_identically():
+    """remat with either policy must produce the same parameters as
+    no-remat (checkpointing changes memory, not math)."""
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+    ds = synthetic_dataset(200, 6, in_dim=12, num_classes=3, seed=9)
+    results = {}
+    for name, kw in [("none", dict(remat=False)),
+                     ("full", dict(remat=True, remat_policy="full")),
+                     ("save_agg", dict(remat=True,
+                                       remat_policy="save_aggregates"))]:
+        model = build_gcn([12, 8, 3], dropout_rate=0.0)
+        cfg = TrainConfig(learning_rate=0.05, epochs=3,
+                          eval_every=1 << 30, verbose=False,
+                          symmetric=True, **kw)
+        tr = Trainer(model, ds, cfg)
+        tr.train()
+        results[name] = tr.params
+    for k in results["none"]:
+        np.testing.assert_allclose(np.asarray(results["none"][k]),
+                                   np.asarray(results["full"][k]),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(results["none"][k]),
+                                   np.asarray(results["save_agg"][k]),
+                                   rtol=1e-5, atol=1e-5)
