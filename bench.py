@@ -177,8 +177,14 @@ def child_micro(args) -> dict:
     g = random_csr(V, E, seed=0)
     feats_np = np.random.RandomState(0).rand(V + 1, F).astype(np.float32)
     feats_np[-1] = 0
-    feats = jnp.asarray(feats_np)
-    gb = E * F * 4 / 1e9
+    # honor --dtype: the micro race must measure the same feature
+    # dtype the training step aggregates (mixed/bfloat16 -> bf16), and
+    # the GB/s math must use that dtype's width
+    from roc_tpu.train.trainer import resolve_dtypes
+    dt, cdt = resolve_dtypes(args.dtype)
+    feat_dtype = cdt if cdt is not None else dt
+    feats = jnp.asarray(feats_np, dtype=feat_dtype)
+    gb = E * F * jnp.dtype(feat_dtype).itemsize / 1e9
 
     def bench(fn):
         _sync_fetch(fn())
@@ -270,7 +276,11 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
     print(f"# data gen: {time.time()-t0:.1f}s V={nodes} "
           f"E={graph.num_edges}", file=sys.stderr)
 
-    dtype = jnp.float32 if args.dtype == "float32" else jnp.bfloat16
+    # "mixed" = fp32 master params + bf16 compute (halves aggregation
+    # HBM traffic); "bfloat16" = everything bf16; resolve_dtypes is the
+    # shared CLI/bench mapping
+    from roc_tpu.train.trainer import resolve_dtypes
+    dtype, compute_dtype = resolve_dtypes(args.dtype)
     model = build_gcn(layers, dropout_rate=0.5)
     # eval_every larger than any epoch count: timed epochs are pure
     # train steps, matching the reference's epoch cost (inference runs
@@ -278,7 +288,8 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
     cfg = TrainConfig(learning_rate=0.01, weight_decay=1e-4,
                       decay_rate=0.97, decay_steps=100,
                       aggr_impl=args.impl, chunk=args.chunk,
-                      dtype=dtype, verbose=False, eval_every=1 << 30,
+                      dtype=dtype, compute_dtype=compute_dtype,
+                      verbose=False, eval_every=1 << 30,
                       symmetric=True)
     t0 = time.time()
     trainer = Trainer(model, ds, cfg)
@@ -389,6 +400,13 @@ def _baseline_entry(result: dict, extra_keys=("V", "E", "layers", "impl",
 def parent(args, argv) -> int:
     t_start = time.time()
     remaining = lambda: args.deadline - (time.time() - t_start)
+    # non-default dtypes record under their own metric names: a mixed
+    # run must not overwrite (or claim a vs_baseline against) the fp32
+    # reference numbers — the driver's default run stays fp32
+    suffix = "" if args.dtype == "float32" else f"_{args.dtype}"
+    metric_full = METRIC_FULL + suffix
+    metric_small = METRIC_SMALL + suffix
+    metric_micro = METRIC_MICRO + suffix
     wanted = [s.strip() for s in args.stages.split(",") if s.strip()]
     if args.small:
         wanted = ["probe", "small"]
@@ -463,9 +481,9 @@ def parent(args, argv) -> int:
             if name == "micro":
                 entry = _baseline_entry(r, extra_keys=("V", "E", "F"))
                 entry["impls"] = r["impls"]
-                _record_baseline(METRIC_MICRO, entry)
+                _record_baseline(metric_micro, entry)
             elif name in ("small", "full"):
-                metric = METRIC_SMALL if name == "small" else METRIC_FULL
+                metric = metric_small if name == "small" else metric_full
                 entry = _baseline_entry(r)
                 entry["epoch_ms"] = r["epoch_ms"]
                 entry["compile_s"] = r.get("compile_s")
@@ -476,7 +494,7 @@ def parent(args, argv) -> int:
                          if results[n].get("ok")
                          else {"error": results[n].get("error")})
                      for n in results}
-    for name, metric in (("full", METRIC_FULL), ("small", METRIC_SMALL)):
+    for name, metric in (("full", metric_full), ("small", metric_small)):
         rec = results.get(name)
         if rec and rec.get("ok"):
             r = rec["result"]

@@ -41,7 +41,8 @@ _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "model_zoo.jsonl")
 
 
-def run(cfg_key: str, epochs: int, impl: str) -> dict:
+def run(cfg_key: str, epochs: int, impl: str,
+        dtype: str = "float32") -> dict:
     import jax
     import jax.numpy as jnp
     from roc_tpu.core.graph import Dataset, random_csr
@@ -80,8 +81,14 @@ def run(cfg_key: str, epochs: int, impl: str) -> dict:
     # sectioned at arxiv scale — core/ell.py resolve_auto_impl)
     # memory="auto": the products/Amazon shapes exceed HBM without
     # remat — the autopilot estimates and picks (echoed on stderr)
+    # dtype="mixed" = fp32 master params + bf16 compute: at products/
+    # Amazon scale this is what makes GIN fit (fp32 + remat still OOMs
+    # a 16G chip by ~0.4G) and halves aggregation HBM traffic
+    from roc_tpu.train.trainer import resolve_dtypes
+    dt, cdt = resolve_dtypes(dtype)
     tc = TrainConfig(learning_rate=0.01, weight_decay=1e-4,
-                     aggr_impl=impl, dtype=jnp.float32, verbose=True,
+                     aggr_impl=impl, verbose=True,
+                     dtype=dt, compute_dtype=cdt,
                      eval_every=1 << 30, symmetric=True, memory="auto")
     t0 = time.time()
     tr = Trainer(model, ds, tc)
@@ -97,6 +104,7 @@ def run(cfg_key: str, epochs: int, impl: str) -> dict:
         times.append((time.time() - t0) * 1e3)
     rec = {"config": cfg_key, "model": c["model"], "V": c["nodes"],
            "E": int(graph.num_edges), "layers": layers, "impl": impl,
+           "dtype": dtype,
            "platform": dev.platform, "device_kind": dev.device_kind,
            "epoch_ms": round(float(np.median(times)), 1),
            "epoch_ms_all": [round(t) for t in times],
@@ -114,8 +122,10 @@ def main():
     ap.add_argument("--config", default="3", choices=list(CONFIGS))
     ap.add_argument("--epochs", type=int, default=5)
     ap.add_argument("--impl", default="auto")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16", "mixed"])
     args = ap.parse_args()
-    run(args.config, args.epochs, args.impl)
+    run(args.config, args.epochs, args.impl, args.dtype)
 
 
 if __name__ == "__main__":

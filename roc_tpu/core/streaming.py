@@ -181,8 +181,11 @@ class StreamedHead:
         dropout with the same folded key as :meth:`forward`."""
         blocks = self._blocks(feats_host.shape[0])
         keys = self._keys(key, len(blocks))
+        # accumulate across blocks in fp32 regardless of the compute
+        # dtype (many-block bf16 accumulation would round away small
+        # contributions); the caller casts to the master param dtype
         dW = jnp.zeros((feats_host.shape[1], dY.shape[1]),
-                       dtype=dY.dtype)
+                       dtype=jnp.float32)
         for (lo, hi), k in zip(blocks, keys):
             x = jax.device_put(np.ascontiguousarray(feats_host[lo:hi]))
             x = x.astype(dY.dtype)

@@ -47,8 +47,8 @@ from ..models.builder import GraphContext, Model
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics, summarize_metrics
 from ..train.optimizer import (AdamConfig, AdamState, adam_init,
                                adam_update)
-from ..train.trainer import (TrainConfig, remat_policy,
-                             resolve_symmetric)
+from ..train.trainer import (TrainConfig, cast_floats, compute_dtype_of,
+                             remat_policy, resolve_symmetric)
 
 
 def make_mesh(num_parts: Optional[int] = None,
@@ -244,6 +244,7 @@ class DistributedTrainer:
                 aggr_impl=resolve_auto_impl(
                     v, out_rows=-(-v // num_parts)))
         self.config = config
+        self.compute = compute_dtype_of(config)
         self.epoch = 0
         self.symmetric = resolve_symmetric(dataset, config.symmetric)
         self.mesh = mesh if mesh is not None else make_mesh(num_parts)
@@ -251,7 +252,7 @@ class DistributedTrainer:
             dataset.graph, num_parts,
             node_multiple=8, edge_multiple=config.chunk)
         self.data = shard_dataset(dataset, self.pg, self.mesh,
-                                  dtype=config.dtype,
+                                  dtype=self.compute,
                                   aggr_impl=config.aggr_impl,
                                   halo=config.halo)
         if config.halo == "ring" and config.verbose:
@@ -319,7 +320,10 @@ class DistributedTrainer:
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
-                logits = self.model.apply(p, feats, gctx, key=part_key,
+                # mixed precision: fp32 master params cast per step;
+                # astype's vjp keeps grads (and the psum) in fp32
+                logits = self.model.apply(cast_floats(p, self.compute),
+                                          feats, gctx, key=part_key,
                                           train=True)
                 return masked_softmax_cross_entropy(logits, labels, mask)
 
@@ -363,7 +367,8 @@ class DistributedTrainer:
                 ring_idx=tuple(a[0] for a in ring_idx),
                 sect_idx=tuple(a[0] for a in sect_idx),
                 sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
-            logits = self.model.apply(params, feats, gctx, key=None,
+            logits = self.model.apply(cast_floats(params, self.compute),
+                                      feats, gctx, key=None,
                                       train=False)
             m = perf_metrics(logits, labels, mask)
             return jax.tree_util.tree_map(

@@ -65,7 +65,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="distributed halo exchange: one-shot "
                          "all_gather or ppermute ring (O(V/P) memory)")
     ap.add_argument("--dtype", default="float32",
-                    choices=["float32", "bfloat16"])
+                    choices=["float32", "bfloat16", "mixed"],
+                    help="float32 = the reference's pure-fp32 "
+                         "semantics; bfloat16 = everything (incl. "
+                         "params/Adam) in bf16; mixed = fp32 master "
+                         "params + bf16 features/activations (halves "
+                         "aggregation HBM traffic, MXU-native matmuls)")
     ap.add_argument("--memory", default="auto",
                     choices=["auto", "manual"],
                     help="auto (default): estimate per-device HBM and "
@@ -98,13 +103,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
-
     from ..core.graph import load_dataset, synthetic_dataset
     from ..models.gcn import build_gcn
     from ..models.sage import build_sage
     from ..models.gin import build_gin
-    from .trainer import TrainConfig, Trainer
+    from .trainer import TrainConfig, Trainer, resolve_dtypes
     from ..parallel.distributed import DistributedTrainer
     from ..utils.checkpoint import checkpoint_trainer, restore_trainer
 
@@ -129,6 +132,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin}
     model = build[args.model](layers, dropout_rate=args.dropout)
+    dt, cdt = resolve_dtypes(args.dtype)
     memory = args.memory
     if memory == "auto" and (args.halo != "gather"
                              or args.features != "hbm" or args.remat):
@@ -141,7 +145,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed, eval_every=args.eval_every, verbose=True,
         aggr_impl=args.impl, halo=args.halo, memory=memory,
         features=args.features, remat=args.remat,
-        dtype=jnp.float32 if args.dtype == "float32" else jnp.bfloat16)
+        dtype=dt, compute_dtype=cdt)
 
     if args.parts > 1:
         trainer = DistributedTrainer(model, ds, args.parts, cfg)

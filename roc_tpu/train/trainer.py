@@ -45,6 +45,17 @@ class TrainConfig:
     aggr_impl: str = "segment"
     chunk: int = 512
     dtype: Any = jnp.float32
+    # Mixed precision: when set (e.g. jnp.bfloat16), params + Adam
+    # state stay in ``dtype`` (fp32 master weights) while features,
+    # activations, and the aggregation run in ``compute_dtype`` —
+    # halving HBM traffic on the bandwidth-bound aggregation and using
+    # the MXU's native bf16 multiply path.  Params are cast inside the
+    # step; gradients flow back through the cast as fp32 (bf16 shares
+    # fp32's exponent range, so no loss scaling is needed; the loss
+    # itself is always reduced in fp32, ops/loss.py).  None = compute
+    # in ``dtype`` (the reference's pure-fp32 semantics,
+    # linear_kernel.cu:76-80).
+    compute_dtype: Optional[Any] = None
     # Halo exchange for the distributed step: "gather" (one-shot
     # all_gather, the reference's whole-region semantics) or "ring"
     # (ppermute rotation, O(V/P) peak memory; parallel/ring.py)
@@ -83,6 +94,37 @@ class TrainConfig:
     features: str = "hbm"
     memory: str = "manual"
     hbm_bytes: Optional[int] = None
+
+
+def resolve_dtypes(name: str):
+    """CLI/benchmark dtype-mode string -> ``(dtype, compute_dtype)`` —
+    the ONE place the mode names map to TrainConfig fields, so the CLI
+    and the benchmarks can never train with different semantics for
+    the same flag value."""
+    if name == "float32":
+        return jnp.float32, None
+    if name == "bfloat16":
+        return jnp.bfloat16, None
+    if name == "mixed":
+        return jnp.float32, jnp.bfloat16
+    raise ValueError(f"unknown dtype mode {name!r}; expected "
+                     "'float32', 'bfloat16', or 'mixed'")
+
+
+def compute_dtype_of(config: TrainConfig):
+    """The activation/feature dtype: ``compute_dtype`` when set (mixed
+    precision), else ``dtype``."""
+    return (config.compute_dtype if config.compute_dtype is not None
+            else config.dtype)
+
+
+def cast_floats(tree, dtype):
+    """Cast floating-point leaves to ``dtype``; integer leaves (masks,
+    labels, index tables) pass through.  A no-op cast is left to XLA
+    to elide."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
 
 
 def remat_policy(config: TrainConfig):
@@ -125,7 +167,7 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
     plan = choose_memory_plan(
         dataset.graph.num_nodes, dataset.graph.num_edges, dims,
         num_parts=num_parts,
-        dtype_bytes=jnp.dtype(config.dtype).itemsize,
+        dtype_bytes=jnp.dtype(compute_dtype_of(config)).itemsize,
         hbm_bytes=config.hbm_bytes,
         head_streamable=model.streamable_head() is not None,
         remat_policy=config.remat_policy)
@@ -197,6 +239,7 @@ class Trainer:
         self.model = model
         config = apply_memory_autopilot(model, dataset, config)
         self.config = config
+        self.compute = compute_dtype_of(config)
         self.epoch = 0
         self.gctx = make_graph_context(dataset, config.aggr_impl,
                                        config.chunk,
@@ -224,15 +267,22 @@ class Trainer:
             rate, self._head_param, self._tail_model = head
             from ..core.streaming import StreamedHead
             self._head = StreamedHead(rate)
+            # host copy in the COMPUTE dtype (ml_dtypes bf16 under
+            # mixed): device_put then ships 2-byte blocks — the
+            # host-link transfer is this tier's dominant per-epoch
+            # cost, so staging fp32 and casting on device would
+            # forfeit half the mode's bandwidth win
             self.feats_host = np.ascontiguousarray(
-                np.asarray(dataset.features, dtype=np.float32))
+                np.asarray(dataset.features).astype(
+                    jnp.dtype(self.compute), copy=False))
             self.feats = None
             self._tail_grad = jax.jit(self._tail_grad_impl)
             self._tail_eval = jax.jit(self._tail_eval_impl)
             self._apply_update = jax.jit(self._apply_update_impl,
                                          donate_argnums=(0, 1))
         else:
-            self.feats = jnp.asarray(dataset.features, dtype=config.dtype)
+            self.feats = jnp.asarray(dataset.features,
+                                     dtype=self.compute)
         # Dataset tensors are jitted *arguments*, not closure captures:
         # capturing them would embed a second copy of the feature matrix
         # as an executable constant and recompile per Trainer instance
@@ -251,7 +301,10 @@ class Trainer:
         # closure-capturing it would embed the edge/ELL tables as HLO
         # constants — see the register_pytree_node note in builder.py
         def objective(p):
-            loss, _ = self.model.loss_fn(p, feats, labels, mask,
+            # mixed precision: compute in self.compute; the astype vjp
+            # returns fp32 cotangents, so grads/Adam stay in dtype
+            loss, _ = self.model.loss_fn(cast_floats(p, self.compute),
+                                         feats, labels, mask,
                                          gctx, key=key, train=True)
             return loss
         if self.config.remat:
@@ -263,8 +316,8 @@ class Trainer:
         return params, opt_state, loss
 
     def _eval_step_impl(self, params, feats, labels, mask, gctx):
-        logits = self.model.apply(params, feats, gctx,
-                                  key=None, train=False)
+        logits = self.model.apply(cast_floats(params, self.compute),
+                                  feats, gctx, key=None, train=False)
         return perf_metrics(logits, labels, mask)
 
     # ---- host-feature streaming path (config.features == "host") ----
@@ -273,9 +326,9 @@ class Trainer:
         """Loss + grads of the device-resident tail w.r.t. (params, Y);
         dY feeds the streamed head weight gradient."""
         def objective(p, yy):
-            loss, _ = self._tail_model.loss_fn(p, yy, labels, mask,
-                                               gctx, key=key,
-                                               train=True)
+            loss, _ = self._tail_model.loss_fn(
+                cast_floats(p, self.compute), yy, labels, mask,
+                gctx, key=key, train=True)
             return loss
         if self.config.remat:
             objective = jax.checkpoint(
@@ -285,8 +338,8 @@ class Trainer:
         return loss, gp, gy
 
     def _tail_eval_impl(self, params, y, labels, mask, gctx):
-        logits = self._tail_model.apply(params, y, gctx,
-                                        key=None, train=False)
+        logits = self._tail_model.apply(cast_floats(params, self.compute),
+                                        y, gctx, key=None, train=False)
         return perf_metrics(logits, labels, mask)
 
     def _apply_update_impl(self, params, opt_state, grads, lr):
@@ -294,13 +347,17 @@ class Trainer:
 
     def _streamed_step(self, step_key, lr):
         head_key, tail_key = jax.random.split(step_key)
-        w0 = self.params[self._head_param]
+        # cast the master weight to the compute dtype so the streamed
+        # blocks (and Y, hence the whole tail) run in compute precision
+        # — the footprint the memory autopilot sized the plan with
+        w0 = self.params[self._head_param].astype(self.compute)
         y = self._head.forward(w0, self.feats_host, head_key, True)
         _, grads, gy = self._tail_grad(self.params, y, tail_key,
                                        self.labels, self.mask,
                                        self.gctx)
         grads[self._head_param] = self._head.wgrad(
-            self.feats_host, gy, head_key, True)
+            self.feats_host, gy, head_key, True
+        ).astype(self.params[self._head_param].dtype)
         self.params, self.opt_state = self._apply_update(
             self.params, self.opt_state, grads, lr)
 
@@ -328,8 +385,8 @@ class Trainer:
 
     def evaluate(self) -> Dict[str, float]:
         if self._head is not None:
-            y = self._head.forward(self.params[self._head_param],
-                                   self.feats_host, None, False)
+            w0 = self.params[self._head_param].astype(self.compute)
+            y = self._head.forward(w0, self.feats_host, None, False)
             return summarize_metrics(jax.device_get(
                 self._tail_eval(self.params, y, self.labels, self.mask,
                                 self.gctx)))

@@ -1,0 +1,150 @@
+"""Mixed precision (fp32 master params + bf16 compute).
+
+The reference is pure fp32 (``linear_kernel.cu:76-80``); the TPU
+rebuild adds ``TrainConfig.compute_dtype=bfloat16`` as the
+hardware-native mode: features/activations in bf16 (halving HBM
+traffic on the bandwidth-bound aggregation), params + Adam state in
+fp32 so the optimizer's small updates don't round away.  These tests
+pin the contract: master params stay fp32, grads arrive fp32,
+convergence matches the fp32 run, and the distributed step agrees.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu.core.graph import synthetic_dataset
+from roc_tpu.models.gcn import build_gcn
+from roc_tpu.train.trainer import (TrainConfig, Trainer, cast_floats,
+                                   compute_dtype_of)
+
+
+@pytest.fixture(scope="module")
+def dataset():
+    return synthetic_dataset(256, 8, in_dim=16, num_classes=4, seed=0)
+
+
+def _cfg(**kw):
+    kw.setdefault("verbose", False)
+    kw.setdefault("aggr_impl", "ell")
+    kw.setdefault("eval_every", 1 << 30)
+    return TrainConfig(**kw)
+
+
+def test_compute_dtype_resolution():
+    assert compute_dtype_of(_cfg()) == jnp.float32
+    assert compute_dtype_of(_cfg(dtype=jnp.bfloat16)) == jnp.bfloat16
+    assert compute_dtype_of(
+        _cfg(compute_dtype=jnp.bfloat16)) == jnp.bfloat16
+
+
+def test_cast_floats_leaves_ints_alone():
+    tree = {"w": jnp.ones((2, 2), jnp.float32),
+            "idx": jnp.zeros((3,), jnp.int32)}
+    out = cast_floats(tree, jnp.bfloat16)
+    assert out["w"].dtype == jnp.bfloat16
+    assert out["idx"].dtype == jnp.int32
+
+
+def test_mixed_master_params_stay_fp32(dataset):
+    model = build_gcn([dataset.in_dim, 32, dataset.num_classes],
+                      dropout_rate=0.5)
+    tr = Trainer(model, dataset, _cfg(compute_dtype=jnp.bfloat16))
+    tr.train(epochs=3)
+    for leaf in jax.tree_util.tree_leaves(tr.params):
+        assert leaf.dtype == jnp.float32
+    for leaf in jax.tree_util.tree_leaves(tr.opt_state.m):
+        assert leaf.dtype == jnp.float32
+    # features/activations really are bf16 on the compute path
+    assert tr.feats.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("impl", ["ell", "sectioned", "segment"])
+def test_mixed_converges_like_fp32(dataset, impl):
+    """The correctness-by-convergence gate (SURVEY §4) must hold in
+    mixed mode: same synthetic task, accuracy within a few points of
+    the fp32 run."""
+    model = build_gcn([dataset.in_dim, 32, dataset.num_classes],
+                      dropout_rate=0.0)
+    accs = {}
+    for name, cfg in (
+            ("fp32", _cfg(aggr_impl=impl)),
+            ("mixed", _cfg(aggr_impl=impl,
+                           compute_dtype=jnp.bfloat16))):
+        tr = Trainer(model, dataset, cfg)
+        tr.train(epochs=40)
+        accs[name] = tr.evaluate()["train_acc"]
+    assert accs["fp32"] > 0.9
+    assert accs["mixed"] > accs["fp32"] - 0.05, accs
+
+
+def test_mixed_first_loss_close_to_fp32(dataset):
+    """Before any updates the two modes see the same params; the bf16
+    forward may only differ by rounding, not by orders of magnitude."""
+    model = build_gcn([dataset.in_dim, 32, dataset.num_classes],
+                      dropout_rate=0.0)
+    losses = {}
+    for name, cfg in (("fp32", _cfg()),
+                      ("mixed", _cfg(compute_dtype=jnp.bfloat16))):
+        tr = Trainer(model, dataset, cfg)
+        losses[name] = tr.evaluate()["train_loss"]
+    assert losses["mixed"] == pytest.approx(losses["fp32"], rel=0.05)
+
+
+def test_distributed_mixed(dataset):
+    """SPMD mixed step: fp32 master params replicated, bf16 sharded
+    features, finite psum'd loss, accuracy comparable to the
+    single-device mixed run."""
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    model = build_gcn([dataset.in_dim, 32, dataset.num_classes],
+                      dropout_rate=0.0)
+    cfg = _cfg(compute_dtype=jnp.bfloat16, chunk=64)
+    tr = DistributedTrainer(model, dataset, 4, cfg)
+    tr.train(epochs=40)
+    for leaf in jax.tree_util.tree_leaves(tr.params):
+        assert leaf.dtype == jnp.float32
+    assert tr.data.feats.dtype == jnp.bfloat16
+    m = tr.evaluate()
+    assert np.isfinite(m["train_loss"])
+    assert m["train_acc"] > 0.85
+
+
+def test_resolve_dtypes_mapping():
+    from roc_tpu.train.trainer import resolve_dtypes
+    assert resolve_dtypes("float32") == (jnp.float32, None)
+    assert resolve_dtypes("bfloat16") == (jnp.bfloat16, None)
+    assert resolve_dtypes("mixed") == (jnp.float32, jnp.bfloat16)
+    with pytest.raises(ValueError):
+        resolve_dtypes("fp16")
+
+
+def test_mixed_streamed_head(dataset):
+    """features='host' under mixed precision: the streamed head must
+    run the blocks (and hence Y and the tail) in bf16 — the footprint
+    the autopilot sized — while dW comes back fp32 for the master
+    param."""
+    model = build_gcn([dataset.in_dim, 32, dataset.num_classes],
+                      dropout_rate=0.5)
+    tr = Trainer(model, dataset,
+                 _cfg(compute_dtype=jnp.bfloat16, features="host"))
+    # the host copy itself is bf16 so device_put ships 2-byte blocks
+    assert tr.feats_host.dtype == jnp.dtype(jnp.bfloat16)
+    w0 = tr.params[tr._head_param].astype(tr.compute)
+    y = tr._head.forward(w0, tr.feats_host, None, False)
+    assert y.dtype == jnp.bfloat16
+    tr.train(epochs=3)
+    for leaf in jax.tree_util.tree_leaves(tr.params):
+        assert leaf.dtype == jnp.float32
+    m = tr.evaluate()
+    assert np.isfinite(m["train_loss"])
+
+
+def test_pure_bf16_unchanged(dataset):
+    """dtype=bf16 without compute_dtype keeps the old all-bf16
+    semantics (params included) — the knob is additive."""
+    model = build_gcn([dataset.in_dim, 32, dataset.num_classes])
+    tr = Trainer(model, dataset, _cfg(dtype=jnp.bfloat16))
+    tr.train(epochs=2)
+    for leaf in jax.tree_util.tree_leaves(tr.params):
+        assert leaf.dtype == jnp.bfloat16
