@@ -78,7 +78,8 @@ def build_parser():
     # auto resolves to 'sectioned' at Reddit scale / 'ell' below VMEM
     # table size (the CLI default too, roc_tpu/train/cli.py) — the
     # data-chosen production path: sectioned measured 2708 ms/epoch vs
-    # ell's 7920.8 at full Reddit scale (vs_baseline 2.93)
+    # ell's 7920.8 at full Reddit scale (vs_baseline 2.93; 2359 ms
+    # with --dtype mixed -> 3.36 vs the recorded fp32 ell baseline)
     ap.add_argument("--impl", type=str, default="auto")
     ap.add_argument("--dtype", type=str, default="float32")
     ap.add_argument("--stages", type=str, default="probe,micro,small,full",
