@@ -46,6 +46,8 @@ def main():
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--impls", type=str,
                     default="ell,pallas,scan:2048,scan:4096,blocked:1024")
+    ap.add_argument("--seg-rows", type=int, default=131_072,
+                    help="sectioned carry-scan chunk size (sub-rows)")
     args = ap.parse_args()
 
     import jax
@@ -89,10 +91,19 @@ def main():
         else:
             impl, chunk = spec, 1024
         if impl == "sectioned":
-            from roc_tpu.core.ell import sectioned_from_graph
+            # sectioned:ROWS overrides the section size (in source
+            # rows) — the dtype-aware sweep: bf16 tables are half the
+            # bytes, so sections can be 2x the rows for the same VMEM
+            # footprint (fewer sections = fewer scatter passes + less
+            # sub-row padding)
+            from roc_tpu.core.ell import (SECTION_ROWS_DEFAULT,
+                                          sectioned_from_graph)
             from roc_tpu.ops.aggregate import aggregate_ell_sect
+            sec_rows = chunk if ":" in spec else SECTION_ROWS_DEFAULT
             t0 = time.time()
-            sect = sectioned_from_graph(g.row_ptr, g.col_idx, V)
+            sect = sectioned_from_graph(g.row_ptr, g.col_idx, V,
+                                        section_rows=sec_rows,
+                                        seg_rows=args.seg_rows)
             prep = time.time() - t0
             sidx, sdst, meta = sect.as_jax()
             # tables as ARGUMENTS: closure/default-arg capture embeds

@@ -277,6 +277,13 @@ class SectionedEll:
 
 
 SECTION_ROWS_DEFAULT = 65_536   # 64 MiB of fp32 rows at F=256
+# Swept on-chip at Reddit scale (v5e, F=256 bf16, 2026-07-30):
+# section_rows 32768/65536/131072/262144 -> 826/776/808/1747 ms and
+# seg_rows 65536/131072/262144/524288 -> 809/776/781/778 ms — the
+# defaults sit at the measured optimum for BOTH dtypes (the residency
+# window tracks row count, not table bytes: halving the bytes with
+# bf16 does NOT move the best section size), and bf16 gains only
+# ~11% on the aggregation itself (row-rate-bound gathers, ~7 ns/edge).
 
 # Upper bound of the sectioned layout's winning range (v5e, F=256,
 # median of 5, benchmarks/micro_agg.py 2026-07-30):

@@ -140,6 +140,30 @@ def test_mixed_streamed_head(dataset):
     assert np.isfinite(m["train_loss"])
 
 
+def test_mixed_checkpoint_roundtrip(tmp_path, dataset):
+    """Checkpoint/resume under mixed precision: the restored trainer
+    keeps fp32 master params (the template's dtype wins) and training
+    continues from the same state."""
+    from roc_tpu.utils.checkpoint import (checkpoint_trainer,
+                                          restore_trainer)
+    model = build_gcn([dataset.in_dim, 32, dataset.num_classes],
+                      dropout_rate=0.5)
+    cfg = _cfg(compute_dtype=jnp.bfloat16)
+    tr = Trainer(model, dataset, cfg)
+    tr.train(epochs=3)
+    path = str(tmp_path / "ckpt.npz")
+    checkpoint_trainer(tr, path)
+    tr2 = Trainer(model, dataset, cfg)
+    restore_trainer(tr2, path)
+    assert tr2.epoch == tr.epoch
+    for a, b in zip(jax.tree_util.tree_leaves(tr.params),
+                    jax.tree_util.tree_leaves(tr2.params)):
+        assert b.dtype == jnp.float32
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    tr2.train(epochs=1)
+    assert np.isfinite(tr2.evaluate()["train_loss"])
+
+
 def test_pure_bf16_unchanged(dataset):
     """dtype=bf16 without compute_dtype keeps the old all-bf16
     semantics (params included) — the knob is additive."""
