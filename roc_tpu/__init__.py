@@ -18,6 +18,7 @@ from .models.builder import (AGGR_AVG, AGGR_MAX, AGGR_SUM, GraphContext,
 from .models.gcn import build_gcn
 from .models.sage import build_sage
 from .models.gin import build_gin
+from .models.gat import build_gat
 from .train.optimizer import (AdamConfig, AdamState, adam_init,
                               adam_update, decayed_lr)
 from .utils.checkpoint import (checkpoint_trainer, load_checkpoint,

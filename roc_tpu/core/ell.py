@@ -46,11 +46,18 @@ class EllTable:
     row_pos: int32 ``[P, part_nodes]`` position of each local row in the
       concatenated bucket output; rows in no bucket (degree 0) point at
       the trailing zero slot (index == total bucket rows).
+    row_id: one array per bucket, int32 ``[P, rows_b]`` — the LOCAL
+      output row each bucket row aggregates into (the forward map;
+      row_pos is its inverse).  Padding bucket rows carry
+      ``part_nodes`` (a dummy slot).  Attention aggregation needs this
+      to gather per-destination scores bucket-side (ops/attention.py);
+      the plain sum path never reads it.
     """
 
     widths: Tuple[int, ...]
     idx: Tuple[np.ndarray, ...]
     row_pos: np.ndarray
+    row_id: Tuple[np.ndarray, ...] = ()
 
     @property
     def num_parts(self) -> int:
@@ -60,7 +67,8 @@ class EllTable:
         """Single-partition slice (keeps the leading axis)."""
         return EllTable(widths=self.widths,
                         idx=tuple(a[p:p + 1] for a in self.idx),
-                        row_pos=self.row_pos[p:p + 1])
+                        row_pos=self.row_pos[p:p + 1],
+                        row_id=tuple(a[p:p + 1] for a in self.row_id))
 
 
 def row_widths(deg: np.ndarray, min_width: int) -> np.ndarray:
@@ -139,14 +147,15 @@ def ell_shape_plan(part_row_ptr: np.ndarray, real_nodes: np.ndarray,
 
 def place_ell_part(buckets: dict, widths: Tuple[int, ...],
                    rows_per_width: dict, part_nodes: int,
-                   dummy: int) -> Tuple[list, np.ndarray]:
+                   dummy: int) -> Tuple[list, np.ndarray, list]:
     """Place one partition's buckets (from :func:`build_ell`) into the
-    globally planned uniform shapes.  Returns ``(idx_arrays, row_pos)``
-    with one int32 [rows_w, w] array per width and int32 [part_nodes]
-    output positions (zero slot == total planned rows).  Raises if the
-    built buckets contain a width the plan lacks — a plan/build
-    disagreement must fail loudly, not silently drop those rows'
-    edges."""
+    globally planned uniform shapes.  Returns ``(idx_arrays, row_pos,
+    rid_arrays)`` with one int32 [rows_w, w] array per width, int32
+    [part_nodes] output positions (zero slot == total planned rows),
+    and the forward row map per bucket (int32 [rows_w], padding =
+    ``part_nodes`` — see ``EllTable.row_id``).  Raises if the built
+    buckets contain a width the plan lacks — a plan/build disagreement
+    must fail loudly, not silently drop those rows' edges."""
     extra = set(buckets) - set(widths)
     if extra:
         raise ValueError(
@@ -154,12 +163,14 @@ def place_ell_part(buckets: dict, widths: Tuple[int, ...],
             f"absent from planned widths {list(widths)} — the shape plan "
             "was derived from different degrees than the bucket build")
     idx_arrays = []
+    rid_arrays = []
     total_rows = sum(rows_per_width[w] for w in widths)
     row_pos = np.full(part_nodes, total_rows, dtype=np.int32)
     offset = 0
     for w in widths:
         R = rows_per_width[w]
         arr = np.full((R, w), dummy, dtype=np.int32)
+        rid = np.full(R, part_nodes, dtype=np.int32)
         if w in buckets:
             rows, idx = buckets[w]
             n = rows.shape[0]
@@ -168,10 +179,12 @@ def place_ell_part(buckets: dict, widths: Tuple[int, ...],
                     f"ELL plan/build mismatch: bucket width {w} has {n} "
                     f"rows but the plan allows {R}")
             arr[:n] = np.where(idx >= 0, idx, dummy)
+            rid[:n] = rows
             row_pos[rows] = offset + np.arange(n, dtype=np.int32)
         idx_arrays.append(arr)
+        rid_arrays.append(rid)
         offset += R
-    return idx_arrays, row_pos
+    return idx_arrays, row_pos, rid_arrays
 
 
 def stack_ell(per_part_buckets: Sequence[dict], part_nodes: int,
@@ -194,7 +207,11 @@ def stack_ell(per_part_buckets: Sequence[dict], part_nodes: int,
         np.stack([per_part[p][0][wi] for p in range(P)])
         for wi in range(len(widths)))
     row_pos = np.stack([per_part[p][1] for p in range(P)])
-    return EllTable(widths=widths, idx=idx_arrays, row_pos=row_pos)
+    row_id = tuple(
+        np.stack([per_part[p][2][wi] for p in range(P)])
+        for wi in range(len(widths)))
+    return EllTable(widths=widths, idx=idx_arrays, row_pos=row_pos,
+                    row_id=row_id)
 
 
 def ell_from_padded_parts(part_row_ptr: np.ndarray,

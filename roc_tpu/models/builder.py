@@ -84,6 +84,9 @@ class GraphContext:
     # arrays + [num_rows] output permutation (core/ell.py)
     ell_idx: Tuple[jax.Array, ...] = ()
     ell_row_pos: Optional[jax.Array] = None
+    # forward row map per bucket ([rows_b], padding = num_rows) —
+    # needed only by attention aggregation (EllTable.row_id)
+    ell_row_id: Tuple[jax.Array, ...] = ()
     # Sectioned layout (aggr_impl == "sectioned"): per-section
     # [n_chunks, seg_rows, 8] sub-row tables + [n_chunks, seg_rows]
     # output rows, with static (start, size) metadata (core/ell.py
@@ -165,6 +168,39 @@ class GraphContext:
             return -self._max_fwd(-x)
         raise ValueError(f"unknown aggregator: {aggr}")
 
+    def gat_attention(self, x: jax.Array, a_src: jax.Array,
+                      a_dst: jax.Array,
+                      neg_slope: float = 0.2) -> jax.Array:
+        """Additive-attention aggregation (ops/attention.py): per
+        destination row, softmax over its neighbors of
+        ``LeakyReLU(a_src.h_j + a_dst.h_i)`` weighting the neighbor
+        sum.  Needs the ELL tables (every row's neighborhood in one
+        bucket makes the edge softmax exact); gradients are plain
+        autodiff — attention is nonlinear, the symmetric
+        kernel-reuse trick does not apply."""
+        if self.halo == "ring":
+            raise NotImplementedError(
+                "attention is not supported with halo='ring' (the ring "
+                "accumulator is additive; the edge softmax needs the "
+                "whole neighborhood); use halo='gather'")
+        if self.aggr_impl not in ("ell", "pallas") or not self.ell_idx:
+            raise NotImplementedError(
+                f"attention needs the ELL tables (aggr_impl='ell'), "
+                f"got {self.aggr_impl!r}; sectioned splits a row's "
+                "neighbors across sections and cannot host the edge "
+                "softmax")
+        from ..ops.attention import gat_aggregate_ell
+        full = self.gather_features(x)
+        zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
+        full = jnp.concatenate([full, zero], axis=0)
+        s_full = full @ a_src.astype(full.dtype)        # [G+1]
+        d = x @ a_dst.astype(x.dtype)                   # [num_rows]
+        d_local = jnp.concatenate(
+            [d, jnp.zeros((1,), dtype=d.dtype)])
+        return gat_aggregate_ell(full, s_full, d_local, self.ell_idx,
+                                 self.ell_row_id, self.ell_row_pos,
+                                 self.num_rows, neg_slope=neg_slope)
+
     def _max_fwd(self, x: jax.Array) -> jax.Array:
         """Neighbor max; rows with no neighbors yield 0.  Dummy/padding
         sources are masked out (their zero rows must not win the max)."""
@@ -206,7 +242,8 @@ class GraphContext:
 
 def _gctx_flatten(g: GraphContext):
     children = (g.edge_src, g.edge_dst, g.in_degree, g.ell_idx,
-                g.ell_row_pos, g.ring_idx, g.sect_idx, g.sect_sub_dst)
+                g.ell_row_pos, g.ring_idx, g.sect_idx, g.sect_sub_dst,
+                g.ell_row_id)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
            g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
            g.sect_meta)
@@ -217,7 +254,7 @@ def _gctx_unflatten(aux, children):
     (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
      symmetric, halo, axis_name, sect_meta) = aux
     (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
-     sect_idx, sect_sub_dst) = children
+     sect_idx, sect_sub_dst, ell_row_id) = children
     return GraphContext(
         edge_src=edge_src, edge_dst=edge_dst, in_degree=in_degree,
         num_rows=num_rows, gathered_rows=gathered_rows,
@@ -225,7 +262,8 @@ def _gctx_unflatten(aux, children):
         aggr_impl=aggr_impl, chunk=chunk, symmetric=symmetric,
         ell_idx=ell_idx, ell_row_pos=ell_row_pos, halo=halo,
         ring_idx=ring_idx, axis_name=axis_name, sect_idx=sect_idx,
-        sect_sub_dst=sect_sub_dst, sect_meta=sect_meta)
+        sect_sub_dst=sect_sub_dst, sect_meta=sect_meta,
+        ell_row_id=ell_row_id)
 
 
 # GraphContext is a pytree so the graph tables travel as jit ARGUMENTS.
@@ -263,7 +301,13 @@ class Model:
     def __init__(self, in_dim: int):
         self._ops: List[_Op] = [_Op("input", (), in_dim)]
         self._n_linear = 0
+        self._n_gat = 0
         self._loss_op: Optional[int] = None
+
+    def uses_attention(self) -> bool:
+        """True when the op list contains a gat op — such models need
+        the ELL tables (trainers force aggr_impl='ell')."""
+        return any(op.kind == "gat" for op in self._ops)
 
     # ---- builder API (names match the reference) ----
 
@@ -289,6 +333,16 @@ class Model:
         return self._append("scatter_gather", (t.idx,), t.dim,
                             attrs={"aggr": aggr})
 
+    def gat_attention(self, t: TensorHandle,
+                      neg_slope: float = 0.2) -> TensorHandle:
+        """Attention-weighted neighbor aggregation (the GAT layer's
+        core, ops/attention.py).  Adds two learned [dim] attention
+        vectors (``gat_N_src`` / ``gat_N_dst``) to the params."""
+        name = f"gat_{self._n_gat}"
+        self._n_gat += 1
+        return self._append("gat", (t.idx,), t.dim, param=name,
+                            attrs={"neg_slope": neg_slope})
+
     def relu(self, t: TensorHandle) -> TensorHandle:
         return self._append("activation", (t.idx,), t.dim,
                             attrs={"mode": AC_MODE_RELU})
@@ -296,6 +350,13 @@ class Model:
     def sigmoid(self, t: TensorHandle) -> TensorHandle:
         return self._append("activation", (t.idx,), t.dim,
                             attrs={"mode": AC_MODE_SIGMOID})
+
+    def elu(self, t: TensorHandle) -> TensorHandle:
+        """Beyond the reference's ActiMode set (gnn.h:82-86); used by
+        the GAT family (models/gat.py)."""
+        from ..ops.dense import AC_MODE_ELU
+        return self._append("activation", (t.idx,), t.dim,
+                            attrs={"mode": AC_MODE_ELU})
 
     def add(self, a: TensorHandle, b: TensorHandle) -> TensorHandle:
         assert a.dim == b.dim
@@ -372,6 +433,15 @@ class Model:
                 s = float(np.sqrt(6.0 / (in_dim + op.dim)))
                 params[op.param] = jax.random.uniform(
                     sub, (in_dim, op.dim), dtype=dtype, minval=-s, maxval=s)
+            elif op.kind == "gat":
+                # the attention vectors are the [2*dim] -> 1 projection
+                # of the GAT paper split at the concat boundary —
+                # Glorot over that logical shape
+                s = float(np.sqrt(6.0 / (2 * op.dim + 1)))
+                for suffix in ("src", "dst"):
+                    key, sub = jax.random.split(key)
+                    params[f"{op.param}_{suffix}"] = jax.random.uniform(
+                        sub, (op.dim,), dtype=dtype, minval=-s, maxval=s)
         return params
 
     # ---- interpreter ----
@@ -410,6 +480,12 @@ class Model:
                 # (train/trainer.py remat_policy="save_aggregates")
                 vals[i] = checkpoint_name(
                     gctx.aggregate(x, op.attrs["aggr"]), "aggregate")
+            elif op.kind == "gat":
+                vals[i] = checkpoint_name(
+                    gctx.gat_attention(
+                        x, params[f"{op.param}_src"],
+                        params[f"{op.param}_dst"],
+                        neg_slope=op.attrs["neg_slope"]), "aggregate")
             elif op.kind == "activation":
                 vals[i] = dense.activation(x, op.attrs["mode"])
             elif op.kind == "add":

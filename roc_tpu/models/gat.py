@@ -1,0 +1,39 @@
+"""GAT model family (graph attention networks).
+
+The reference has no attention model — its only aggregation is the
+unweighted CSR sum (``scattergather_kernel.cu:20-76``).  GAT is the
+framework's TPU-native extension, showing the op set generalizes past
+the reference's fixed GCN stack: the single-head additive attention of
+Velickovic et al. (ICLR'18), expressed with the builder ops::
+
+    t = dropout(t, rate)
+    t = linear(t, layers[i], AC_MODE_NONE)     # h = W x
+    t = gat_attention(t)                       # softmax-weighted sum
+    if not last: t = elu(t)
+
+The edge softmax runs exactly on the ELL layout (every row's whole
+neighborhood in one bucket — ops/attention.py has the mechanism);
+trainers force ``aggr_impl='ell'`` for attention models.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .builder import Model
+from ..ops.dense import AC_MODE_NONE
+
+
+def build_gat(layers: Sequence[int], dropout_rate: float = 0.5,
+              neg_slope: float = 0.2) -> Model:
+    model = Model(in_dim=layers[0])
+    t = model.input()
+    n = len(layers)
+    for i in range(1, n):
+        t = model.dropout(t, dropout_rate)
+        t = model.linear(t, layers[i], AC_MODE_NONE)
+        t = model.gat_attention(t, neg_slope=neg_slope)
+        if i != n - 1:
+            t = model.elu(t)
+    model.softmax_cross_entropy(t)
+    return model

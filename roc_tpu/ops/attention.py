@@ -1,0 +1,97 @@
+"""Graph attention aggregation (GAT) on the degree-bucketed ELL layout.
+
+The reference implements only unweighted CSR sum aggregation
+(``scattergather_kernel.cu:20-76``); attention is the framework's
+TPU-native extension for the GAT model family (Velickovic et al.,
+ICLR'18 — additive single-head attention):
+
+    e_ij   = LeakyReLU(a_src . h_j + a_dst . h_i)   for j in N(i)
+    alpha  = softmax_j(e_ij)
+    out_i  = sum_j alpha_ij h_j
+
+The ELL layout makes the edge softmax *exact and scatter-free*: every
+row's whole neighborhood lives in ONE bucket row (bucket width >= the
+row's degree, ``core/ell.py row_widths``), so the per-row max /
+exp-sum / weighted sum are all reductions over the bucket's width
+axis with padding masked — no segment ops, no two-pass global
+normalization.  This is also why the ``sectioned`` layout cannot host
+attention: it splits a row's neighbors across source sections, which
+would require a cross-section softmax reduction (use ``ell``).
+
+Gradients are plain autodiff: attention is nonlinear in both inputs,
+so the reference's symmetric kernel-reuse trick does not apply.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gat_aggregate_ell(full: jax.Array, s_full: jax.Array,
+                      d_local: jax.Array, ell_idx, ell_row_id,
+                      ell_row_pos: jax.Array, num_rows: int,
+                      neg_slope: float = 0.2,
+                      budget_elems: int = 1 << 24) -> jax.Array:
+    """Attention-weighted neighbor aggregation over ELL buckets.
+
+    full: [G+1, F] gathered features with trailing zero row (the halo
+      result; G == gathered_rows).
+    s_full: [G+1] per-source logits ``a_src . h_j`` with the dummy slot
+      LAST (its value is irrelevant — dummy edges are masked).
+    d_local: [num_rows + 1] per-destination logits ``a_dst . h_i`` with
+      a trailing dummy slot for padding bucket rows.
+    ell_idx / ell_row_id / ell_row_pos: core/ell.py EllTable arrays
+      (single-partition views).
+    Rows with no neighbors return 0 (the sum path's convention).
+
+    Large buckets are row-segmented with ``lax.scan`` under the same
+    ``budget_elems`` transient bound as the sum/max paths: the
+    [rows, width, F] gather is the memory hot spot.
+    """
+    F = full.shape[1]
+    dummy = full.shape[0] - 1
+    neg = jnp.asarray(-jnp.inf, dtype=jnp.float32)
+
+    def seg_out(idx_seg, rid_seg):
+        # scores in fp32 for a stable softmax regardless of compute
+        # dtype (bf16 exp over a wide range loses the tail)
+        e = (s_full[idx_seg].astype(jnp.float32)
+             + d_local[rid_seg].astype(jnp.float32)[:, None])
+        e = jax.nn.leaky_relu(e, neg_slope)
+        valid = idx_seg != dummy
+        e = jnp.where(valid, e, neg)
+        m = jnp.max(e, axis=1, keepdims=True)
+        # all-padding rows have m == -inf; zero them via the guard
+        w = jnp.where(valid, jnp.exp(e - jnp.where(
+            jnp.isfinite(m), m, 0.0)), 0.0)
+        den = jnp.maximum(w.sum(axis=1, keepdims=True), 1e-20)
+        alpha = (w / den).astype(full.dtype)
+        return jnp.einsum("rw,rwf->rf", alpha, full[idx_seg])
+
+    outs = []
+    for idx, rid in zip(ell_idx, ell_row_id):
+        R, W = idx.shape
+        if R * W * F <= budget_elems:
+            outs.append(seg_out(idx, rid))
+            continue
+        segs = -(-R * W * F // budget_elems)
+        seg_rows = -(-R // segs)
+        Rp = seg_rows * segs
+        idx_p = jnp.concatenate(
+            [idx, jnp.full((Rp - R, W), dummy, dtype=idx.dtype)], axis=0)
+        rid_p = jnp.concatenate(
+            [rid, jnp.full((Rp - R,), num_rows, dtype=rid.dtype)],
+            axis=0)
+
+        def body(_, ch):
+            return None, seg_out(*ch)
+
+        _, segs_out = lax.scan(body, None,
+                               (idx_p.reshape(segs, seg_rows, W),
+                                rid_p.reshape(segs, seg_rows)))
+        outs.append(segs_out.reshape(Rp, F)[:R])
+    zero = jnp.zeros((1, F), dtype=full.dtype)
+    cat = jnp.concatenate(outs + [zero], axis=0)
+    return cat[ell_row_pos]

@@ -27,15 +27,18 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-# ActiMode mirror (gnn.h:82-86)
+# ActiMode mirror (gnn.h:82-86); ELU is an extension beyond the
+# reference's cuDNN set, used by the GAT model family (models/gat.py)
 AC_MODE_NONE = "none"
 AC_MODE_RELU = "relu"
 AC_MODE_SIGMOID = "sigmoid"
+AC_MODE_ELU = "elu"
 
 _ACTIVATIONS = {
     AC_MODE_NONE: lambda x: x,
     AC_MODE_RELU: jax.nn.relu,
     AC_MODE_SIGMOID: jax.nn.sigmoid,
+    AC_MODE_ELU: jax.nn.elu,
 }
 
 

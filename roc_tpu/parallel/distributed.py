@@ -128,6 +128,7 @@ class ShardedData:
     in_degree: jax.Array   # [P, part_nodes]      P('parts')
     ell_idx: Tuple[jax.Array, ...] = ()   # per bucket [P, rows_b, width_b]
     ell_row_pos: jax.Array = None         # [P, part_nodes]
+    ell_row_id: Tuple[jax.Array, ...] = ()  # per bucket [P, rows_b]
     ring_idx: Tuple[jax.Array, ...] = ()  # (src, dst) [P, S, pair_edges]
     # sectioned layout (aggr_impl == "sectioned"): per section
     # [P, n_chunks_s, seg_rows, 8] / [P, n_chunks_s, seg_rows], plus
@@ -155,6 +156,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         put = lambda x: jax.device_put(x, sh)
     ell_idx = ()
     ell_row_pos = put(np.zeros((pg.num_parts, 1), dtype=np.int32))
+    ell_row_id = ()
     ring_idx = ()
     sect_idx = ()
     sect_sub_dst = ()
@@ -186,6 +188,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                 pg.part_nodes, dummy=pg.num_parts * pg.part_nodes)
             ell_idx = tuple(put(a) for a in table.idx)
             ell_row_pos = put(table.row_pos)
+            ell_row_id = tuple(put(a) for a in table.row_id)
         elif aggr_impl == "sectioned":
             from ..core.ell import (SECTION_ROWS_DEFAULT,
                                     sectioned_from_padded_parts)
@@ -208,6 +211,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         in_degree=put(pg.part_in_degree),
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
+        ell_row_id=ell_row_id,
         ring_idx=ring_idx,
         sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst,
@@ -243,6 +247,8 @@ class DistributedTrainer:
                 config,
                 aggr_impl=resolve_auto_impl(
                     v, out_rows=-(-v // num_parts)))
+        from ..train.trainer import resolve_attention_impl
+        config = resolve_attention_impl(model, config)
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0
@@ -303,8 +309,8 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, opt_state, feats, labels, mask, edge_src,
-                 edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
-                 sect_idx, sect_sub_dst, key, lr):
+                 edge_dst, in_degree, ell_idx, ell_row_pos, ell_row_id,
+                 ring_idx, sect_idx, sect_sub_dst, key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
@@ -314,6 +320,7 @@ class DistributedTrainer:
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
                 ell_row_pos=ell_row_pos[0],
+                ell_row_id=tuple(a[0] for a in ell_row_id),
                 ring_idx=tuple(a[0] for a in ring_idx),
                 sect_idx=tuple(a[0] for a in sect_idx),
                 sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
@@ -343,7 +350,7 @@ class DistributedTrainer:
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_r, spec_r),
+                      spec_p, spec_p, spec_r, spec_r),
             out_specs=(spec_r, spec_r, spec_r),
             check_vma=False)
         return jax.jit(sm, donate_argnums=(0, 1))
@@ -354,8 +361,8 @@ class DistributedTrainer:
         spec_r = P()
 
         def step(params, feats, labels, mask, edge_src, edge_dst,
-                 in_degree, ell_idx, ell_row_pos, ring_idx, sect_idx,
-                 sect_sub_dst):
+                 in_degree, ell_idx, ell_row_pos, ell_row_id, ring_idx,
+                 sect_idx, sect_sub_dst):
             feats, labels, mask = feats[0], labels[0], mask[0]
             edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
                                              in_degree[0])
@@ -364,6 +371,7 @@ class DistributedTrainer:
                 in_degree=in_degree,
                 ell_idx=tuple(a[0] for a in ell_idx),
                 ell_row_pos=ell_row_pos[0],
+                ell_row_id=tuple(a[0] for a in ell_row_id),
                 ring_idx=tuple(a[0] for a in ring_idx),
                 sect_idx=tuple(a[0] for a in sect_idx),
                 sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
@@ -377,7 +385,8 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p),
+                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
+                      spec_p),
             out_specs=spec_r, check_vma=False)
         return jax.jit(sm)
 
@@ -391,8 +400,8 @@ class DistributedTrainer:
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, d.feats, d.labels,
                 d.mask, d.edge_src, d.edge_dst, d.in_degree,
-                d.ell_idx, d.ell_row_pos, d.ring_idx, d.sect_idx,
-                d.sect_sub_dst, step_key, lr)
+                d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
+                d.sect_idx, d.sect_sub_dst, step_key, lr)
 
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
@@ -408,7 +417,7 @@ class DistributedTrainer:
         m = summarize_metrics(jax.device_get(self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
             d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
-            d.ring_idx, d.sect_idx, d.sect_sub_dst)))
+            d.ell_row_id, d.ring_idx, d.sect_idx, d.sect_sub_dst)))
         m["epoch"] = epoch
         return m
 

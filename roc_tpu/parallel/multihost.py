@@ -254,6 +254,7 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                          np.diff(pg.part_row_ptr[p]))
 
     ell_idx = ()
+    ell_row_id = ()
     ell_row_pos = put_parts(lambda p: np.zeros(1, np.int32), (1,),
                             np.int32)
     ring_idx = ()
@@ -278,6 +279,10 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                       (rows_per_width[w], w), np.int32)
             for wi, w in enumerate(widths))
         ell_row_pos = put_parts(lambda p: tables[p][1], (pn,), np.int32)
+        ell_row_id = tuple(
+            put_parts(lambda p, wi=wi: tables[p][2][wi],
+                      (rows_per_width[w],), np.int32)
+            for wi, w in enumerate(widths))
 
     sect_idx = ()
     sect_sub_dst = ()
@@ -330,6 +335,7 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                             np.int32),
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
+        ell_row_id=ell_row_id,
         ring_idx=ring_idx,
         sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst,

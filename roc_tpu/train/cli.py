@@ -48,7 +48,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("-seed", type=int, default=1)
     ap.add_argument("-verbose", "-v", action="store_true")
     # TPU-era flags
-    ap.add_argument("--model", choices=["gcn", "sage", "gin"],
+    ap.add_argument("--model", choices=["gcn", "sage", "gin", "gat"],
                     default="gcn")
     ap.add_argument("--parts", type=int, default=1,
                     help="graph partitions == mesh devices (the "
@@ -107,6 +107,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     from ..models.gcn import build_gcn
     from ..models.sage import build_sage
     from ..models.gin import build_gin
+    from ..models.gat import build_gat
     from .trainer import TrainConfig, Trainer, resolve_dtypes
     from ..parallel.distributed import DistributedTrainer
     from ..utils.checkpoint import checkpoint_trainer, restore_trainer
@@ -130,7 +131,8 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
           f"impl={args.impl}", file=sys.stderr)
 
-    build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin}
+    build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
+             "gat": build_gat}
     model = build[args.model](layers, dropout_rate=args.dropout)
     dt, cdt = resolve_dtypes(args.dtype)
     memory = args.memory

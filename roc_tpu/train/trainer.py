@@ -142,6 +142,29 @@ def remat_policy(config: TrainConfig):
     return jax.checkpoint_policies.save_only_these_names("aggregate")
 
 
+def resolve_attention_impl(model, config: TrainConfig) -> TrainConfig:
+    """The ONE attention-impl policy both trainers apply: attention
+    models need the ELL tables (edge softmax over one bucket row,
+    ops/attention.py), so any other aggr_impl is overridden to 'ell'
+    with a startup echo; halo='ring' is rejected up front — failing at
+    jit-trace time would waste the whole ring-table build first."""
+    if not model.uses_attention():
+        return config
+    if config.halo == "ring":
+        raise NotImplementedError(
+            "attention models are not supported with halo='ring' (the "
+            "ring accumulator is additive; the edge softmax needs the "
+            "whole neighborhood); use halo='gather'")
+    if config.aggr_impl in ("ell", "pallas"):
+        return config
+    if config.verbose:
+        import sys
+        print(f"# aggr_impl={config.aggr_impl!r} -> 'ell' "
+              "(attention model needs the ELL tables)", file=sys.stderr)
+    import dataclasses
+    return dataclasses.replace(config, aggr_impl="ell")
+
+
 def resolve_symmetric(dataset: Dataset,
                       symmetric: Optional[bool]) -> bool:
     if symmetric is None:
@@ -203,6 +226,7 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         edge_dst = np.zeros(1, dtype=np.int32)
     else:
         edge_src, edge_dst = padded_edge_list(g, multiple=chunk)
+    ell_row_id: tuple = ()
     if aggr_impl in ("ell", "pallas"):
         # both consume the degree-bucketed ELL layout; "pallas" runs it
         # through the one-launch DMA kernel (kernels/ell_spmm.py)
@@ -210,6 +234,7 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         table = ell_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
         ell_idx = tuple(jnp.asarray(a[0]) for a in table.idx)
         ell_row_pos = jnp.asarray(table.row_pos[0])
+        ell_row_id = tuple(jnp.asarray(a[0]) for a in table.row_id)
     elif aggr_impl == "sectioned":
         from ..core.ell import sectioned_from_graph
         sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
@@ -225,6 +250,7 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         symmetric=resolve_symmetric(dataset, symmetric),
         ell_idx=ell_idx,
         ell_row_pos=ell_row_pos,
+        ell_row_id=ell_row_id,
         sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst,
         sect_meta=sect_meta,
@@ -238,6 +264,7 @@ class Trainer:
                  config: TrainConfig = TrainConfig()):
         self.model = model
         config = apply_memory_autopilot(model, dataset, config)
+        config = resolve_attention_impl(model, config)
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0
