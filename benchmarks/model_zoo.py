@@ -36,6 +36,12 @@ CONFIGS = {
               layers=(100, 256, 47)),
     "5": dict(model="gin", nodes=2_449_029, edges=126_000_000,
               layers=(100, 256, 47)),
+    # 6: GAT at ogbn-arxiv shape — the attention family (beyond the
+    # reference's sum-only aggregation; ops/attention.py).  Attention
+    # needs the ELL tables, so impl='auto' resolves through the
+    # trainer's resolve_attention_impl override, not the size split.
+    "6": dict(model="gat", nodes=169_343, edges=4_600_000,
+              layers=(128, 256, 40)),
 }
 _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "model_zoo.jsonl")
@@ -46,6 +52,7 @@ def run(cfg_key: str, epochs: int, impl: str,
     import jax
     import jax.numpy as jnp
     from roc_tpu.core.graph import Dataset, random_csr
+    from roc_tpu.models.gat import build_gat
     from roc_tpu.models.gcn import build_gcn
     from roc_tpu.models.gin import build_gin
     from roc_tpu.models.sage import build_sage
@@ -73,7 +80,8 @@ def run(cfg_key: str, epochs: int, impl: str,
         num_classes=layers[-1], name=f"config{cfg_key}-synth")
     print(f"# data gen {time.time()-t0:.0f}s", file=sys.stderr)
 
-    build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin}
+    build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
+             "gat": build_gat}
     model = build[c["model"]](layers, dropout_rate=0.5)
     # GIN aggregates raw F-wide features (dropout output feeds
     # scatter_gather directly), which the ELL-family impls handle;
@@ -103,7 +111,10 @@ def run(cfg_key: str, epochs: int, impl: str,
         tr.sync()
         times.append((time.time() - t0) * 1e3)
     rec = {"config": cfg_key, "model": c["model"], "V": c["nodes"],
-           "E": int(graph.num_edges), "layers": layers, "impl": impl,
+           "E": int(graph.num_edges), "layers": layers,
+           # the trainer's resolved impl, not the CLI alias — e.g.
+           # attention models override to 'ell' at setup
+           "impl": tr.config.aggr_impl,
            "dtype": dtype,
            "platform": dev.platform, "device_kind": dev.device_kind,
            "epoch_ms": round(float(np.median(times)), 1),
