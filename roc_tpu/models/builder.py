@@ -190,13 +190,20 @@ class GraphContext:
                 "neighbors across sections and cannot host the edge "
                 "softmax")
         from ..ops.attention import gat_aggregate_ell
+        if a_src.ndim == 1:                  # single-head vectors
+            a_src = a_src[None, :]
+            a_dst = a_dst[None, :]
+        K, dh = a_src.shape
         full = self.gather_features(x)
         zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
         full = jnp.concatenate([full, zero], axis=0)
-        s_full = full @ a_src.astype(full.dtype)        # [G+1]
-        d = x @ a_dst.astype(x.dtype)                   # [num_rows]
+        fullr = full.reshape(full.shape[0], K, dh)
+        s_full = jnp.einsum("gkd,kd->gk", fullr,
+                            a_src.astype(full.dtype))   # [G+1, K]
+        d = jnp.einsum("vkd,kd->vk", x.reshape(x.shape[0], K, dh),
+                       a_dst.astype(x.dtype))           # [num_rows, K]
         d_local = jnp.concatenate(
-            [d, jnp.zeros((1,), dtype=d.dtype)])
+            [d, jnp.zeros((1, K), dtype=d.dtype)])
         return gat_aggregate_ell(full, s_full, d_local, self.ell_idx,
                                  self.ell_row_id, self.ell_row_pos,
                                  self.num_rows, neg_slope=neg_slope)
@@ -333,15 +340,23 @@ class Model:
         return self._append("scatter_gather", (t.idx,), t.dim,
                             attrs={"aggr": aggr})
 
-    def gat_attention(self, t: TensorHandle,
-                      neg_slope: float = 0.2) -> TensorHandle:
+    def gat_attention(self, t: TensorHandle, neg_slope: float = 0.2,
+                      heads: int = 1) -> TensorHandle:
         """Attention-weighted neighbor aggregation (the GAT layer's
-        core, ops/attention.py).  Adds two learned [dim] attention
-        vectors (``gat_N_src`` / ``gat_N_dst``) to the params."""
+        core, ops/attention.py).  ``heads`` K-way splits the feature
+        axis: each head attends independently over its dim/K slice and
+        the outputs concatenate (the GAT paper's multi-head concat
+        form).  Adds two learned [K, dim/K] attention weights
+        (``gat_N_src`` / ``gat_N_dst``) to the params."""
+        if t.dim % heads:
+            raise ValueError(
+                f"gat_attention: dim {t.dim} not divisible by "
+                f"heads {heads}")
         name = f"gat_{self._n_gat}"
         self._n_gat += 1
         return self._append("gat", (t.idx,), t.dim, param=name,
-                            attrs={"neg_slope": neg_slope})
+                            attrs={"neg_slope": neg_slope,
+                                   "heads": heads})
 
     def relu(self, t: TensorHandle) -> TensorHandle:
         return self._append("activation", (t.idx,), t.dim,
@@ -434,14 +449,17 @@ class Model:
                 params[op.param] = jax.random.uniform(
                     sub, (in_dim, op.dim), dtype=dtype, minval=-s, maxval=s)
             elif op.kind == "gat":
-                # the attention vectors are the [2*dim] -> 1 projection
-                # of the GAT paper split at the concat boundary —
-                # Glorot over that logical shape
-                s = float(np.sqrt(6.0 / (2 * op.dim + 1)))
+                # per head, the attention vectors are the [2*dh] -> 1
+                # projection of the GAT paper split at the concat
+                # boundary — Glorot over that logical shape
+                heads = op.attrs.get("heads", 1)
+                dh = op.dim // heads
+                s = float(np.sqrt(6.0 / (2 * dh + 1)))
                 for suffix in ("src", "dst"):
                     key, sub = jax.random.split(key)
                     params[f"{op.param}_{suffix}"] = jax.random.uniform(
-                        sub, (op.dim,), dtype=dtype, minval=-s, maxval=s)
+                        sub, (heads, dh), dtype=dtype, minval=-s,
+                        maxval=s)
         return params
 
     # ---- interpreter ----

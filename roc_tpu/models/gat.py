@@ -25,15 +25,20 @@ from ..ops.dense import AC_MODE_NONE
 
 
 def build_gat(layers: Sequence[int], dropout_rate: float = 0.5,
-              neg_slope: float = 0.2) -> Model:
+              neg_slope: float = 0.2, heads: int = 1) -> Model:
+    """``heads`` applies to the hidden layers (multi-head concat —
+    each hidden dim must divide by it); the output layer is always
+    single-head, as in the paper."""
     model = Model(in_dim=layers[0])
     t = model.input()
     n = len(layers)
     for i in range(1, n):
+        last = i == n - 1
         t = model.dropout(t, dropout_rate)
         t = model.linear(t, layers[i], AC_MODE_NONE)
-        t = model.gat_attention(t, neg_slope=neg_slope)
-        if i != n - 1:
+        t = model.gat_attention(t, neg_slope=neg_slope,
+                                heads=1 if last else heads)
+        if not last:
             t = model.elu(t)
     model.softmax_cross_entropy(t)
     return model

@@ -34,49 +34,63 @@ def gat_aggregate_ell(full: jax.Array, s_full: jax.Array,
                       ell_row_pos: jax.Array, num_rows: int,
                       neg_slope: float = 0.2,
                       budget_elems: int = 1 << 24) -> jax.Array:
-    """Attention-weighted neighbor aggregation over ELL buckets.
+    """Attention-weighted neighbor aggregation over ELL buckets,
+    multi-head: K heads attend independently over the same
+    neighborhood and their outputs concatenate (the GAT paper's
+    concat form; K == 1 is single-head).
 
-    full: [G+1, F] gathered features with trailing zero row (the halo
-      result; G == gathered_rows).
-    s_full: [G+1] per-source logits ``a_src . h_j`` with the dummy slot
-      LAST (its value is irrelevant — dummy edges are masked).
-    d_local: [num_rows + 1] per-destination logits ``a_dst . h_i`` with
-      a trailing dummy slot for padding bucket rows.
+    full: [G+1, K*dh] gathered features with trailing zero row (the
+      halo result; G == gathered_rows); the feature axis is the K
+      head slices of width dh, concatenated.
+    s_full: [G+1, K] per-source logits ``a_src^k . h_j^k`` with the
+      dummy slot LAST (its value is irrelevant — dummy edges are
+      masked).
+    d_local: [num_rows + 1, K] per-destination logits with a trailing
+      dummy slot for padding bucket rows.
     ell_idx / ell_row_id / ell_row_pos: core/ell.py EllTable arrays
       (single-partition views).
     Rows with no neighbors return 0 (the sum path's convention).
 
     Large buckets are row-segmented with ``lax.scan`` under the same
-    ``budget_elems`` transient bound as the sum/max paths: the
-    [rows, width, F] gather is the memory hot spot.
+    ``budget_elems`` transient bound as the sum/max paths.  The
+    per-(row, width) transient is the [K*dh] feature gather PLUS the
+    fp32 score tensors (e / w / alpha, [K] each) — at many heads and
+    narrow head width the scores rival the gather, so the budget math
+    counts both.
     """
     F = full.shape[1]
+    K = s_full.shape[1]
+    assert F % K == 0, (F, K)
+    # elements per (row, width) slot the segmentation must bound
+    unit = F + 3 * K
     dummy = full.shape[0] - 1
     neg = jnp.asarray(-jnp.inf, dtype=jnp.float32)
 
     def seg_out(idx_seg, rid_seg):
-        # scores in fp32 for a stable softmax regardless of compute
+        # scores softmax in fp32 for stability regardless of compute
         # dtype (bf16 exp over a wide range loses the tail)
         e = (s_full[idx_seg].astype(jnp.float32)
-             + d_local[rid_seg].astype(jnp.float32)[:, None])
-        e = jax.nn.leaky_relu(e, neg_slope)
-        valid = idx_seg != dummy
+             + d_local[rid_seg].astype(jnp.float32)[:, None, :])
+        e = jax.nn.leaky_relu(e, neg_slope)              # [r, w, K]
+        valid = (idx_seg != dummy)[:, :, None]
         e = jnp.where(valid, e, neg)
         m = jnp.max(e, axis=1, keepdims=True)
         # all-padding rows have m == -inf; zero them via the guard
         w = jnp.where(valid, jnp.exp(e - jnp.where(
             jnp.isfinite(m), m, 0.0)), 0.0)
         den = jnp.maximum(w.sum(axis=1, keepdims=True), 1e-20)
-        alpha = (w / den).astype(full.dtype)
-        return jnp.einsum("rw,rwf->rf", alpha, full[idx_seg])
+        alpha = (w / den).astype(full.dtype)             # [r, w, K]
+        g = full[idx_seg].reshape(*idx_seg.shape, K, F // K)
+        return jnp.einsum("rwk,rwkd->rkd", alpha,
+                          g).reshape(idx_seg.shape[0], F)
 
     outs = []
     for idx, rid in zip(ell_idx, ell_row_id):
         R, W = idx.shape
-        if R * W * F <= budget_elems:
+        if R * W * unit <= budget_elems:
             outs.append(seg_out(idx, rid))
             continue
-        segs = -(-R * W * F // budget_elems)
+        segs = -(-R * W * unit // budget_elems)
         seg_rows = -(-R // segs)
         Rp = seg_rows * segs
         idx_p = jnp.concatenate(

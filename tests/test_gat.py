@@ -70,9 +70,9 @@ def test_gat_aggregate_matches_dense_reference(dataset, budget):
 
     full = jnp.concatenate(
         [jnp.asarray(h), jnp.zeros((1, F), jnp.float32)])
-    s_full = full @ jnp.asarray(a_src)
+    s_full = (full @ jnp.asarray(a_src))[:, None]
     d_local = jnp.concatenate(
-        [jnp.asarray(h @ a_dst), jnp.zeros((1,), jnp.float32)])
+        [jnp.asarray(h @ a_dst), jnp.zeros((1,), jnp.float32)])[:, None]
     out = gat_aggregate_ell(full, s_full, d_local, idx, rid, pos, V,
                             budget_elems=budget)
     ref = dense_gat_reference(_adj_from_graph(g), h, a_src, a_dst)
@@ -93,11 +93,71 @@ def test_gat_zero_degree_rows_are_zero():
     h = jnp.asarray(np.random.RandomState(0).randn(3, 4),
                     dtype=jnp.float32)
     full = jnp.concatenate([h, jnp.zeros((1, 4), jnp.float32)])
-    s_full = jnp.ones((4,), jnp.float32) @ full.T
-    d_local = jnp.zeros((4,), jnp.float32)
+    s_full = (jnp.ones((4,), jnp.float32) @ full.T)[:, None]
+    d_local = jnp.zeros((4, 1), jnp.float32)
     out = gat_aggregate_ell(full, s_full, d_local, idx, rid, pos, 3)
     assert np.isfinite(np.asarray(out)).all()
     np.testing.assert_array_equal(np.asarray(out)[2], 0.0)
+
+
+def test_multihead_equals_per_slice_single_head(dataset):
+    """K-head attention == K independent single-head attentions on the
+    K feature slices, concatenated — the defining property of the
+    concat form."""
+    g = dataset.graph
+    V, K, dh = g.num_nodes, 4, 5
+    F = K * dh
+    rng = np.random.RandomState(1)
+    h = rng.randn(V, F).astype(np.float32)
+    a_src = rng.randn(K, dh).astype(np.float32) * 0.3
+    a_dst = rng.randn(K, dh).astype(np.float32) * 0.3
+
+    table = ell_from_graph(g.row_ptr, g.col_idx, V)
+    idx = tuple(jnp.asarray(a[0]) for a in table.idx)
+    rid = tuple(jnp.asarray(a[0]) for a in table.row_id)
+    pos = jnp.asarray(table.row_pos[0])
+
+    def run(hh, asrc, adst):
+        k = asrc.shape[0]
+        full = jnp.concatenate(
+            [jnp.asarray(hh),
+             jnp.zeros((1, hh.shape[1]), jnp.float32)])
+        fr = full.reshape(full.shape[0], k, -1)
+        s = jnp.einsum("gkd,kd->gk", fr, jnp.asarray(asrc))
+        d = jnp.einsum("vkd,kd->vk",
+                       jnp.asarray(hh).reshape(V, k, -1),
+                       jnp.asarray(adst))
+        dl = jnp.concatenate([d, jnp.zeros((1, k), jnp.float32)])
+        return np.asarray(gat_aggregate_ell(full, s, dl, idx, rid,
+                                            pos, V))
+
+    multi = run(h, a_src, a_dst)
+    for k in range(K):
+        sl = slice(k * dh, (k + 1) * dh)
+        single = run(h[:, sl], a_src[k:k + 1], a_dst[k:k + 1])
+        np.testing.assert_allclose(multi[:, sl], single, rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_multihead_model_converges(dataset):
+    model = build_gat([dataset.in_dim, 16, dataset.num_classes],
+                      dropout_rate=0.0, heads=4)
+    assert model.init_params(
+        jax.random.PRNGKey(0))["gat_0_src"].shape == (4, 4)
+    cfg = TrainConfig(aggr_impl="ell", verbose=False,
+                      eval_every=1 << 30)
+    tr = Trainer(model, dataset, cfg)
+    tr.train(epochs=60)
+    assert tr.evaluate()["train_acc"] > 0.9
+
+
+def test_gat_heads_must_divide_dim():
+    from roc_tpu.models.builder import Model
+    m = Model(in_dim=8)
+    t = m.input()
+    t = m.linear(t, 10)
+    with pytest.raises(ValueError, match="divisible"):
+        m.gat_attention(t, heads=4)
 
 
 def test_gat_model_converges(dataset):
@@ -122,8 +182,10 @@ def test_gat_distributed_matches_single(dataset):
     """SPMD GAT: 4-part shard_map step converges and its eval agrees
     with a single-device trainer given the same params."""
     from roc_tpu.parallel.distributed import DistributedTrainer
+    # heads=4: the multi-head reshape/einsum must agree with the
+    # padded-part row order under shard_map, not just single-device
     model = build_gat([dataset.in_dim, 16, dataset.num_classes],
-                      dropout_rate=0.0)
+                      dropout_rate=0.0, heads=4)
     cfg = TrainConfig(aggr_impl="ell", verbose=False, chunk=64,
                       eval_every=1 << 30)
     dt = DistributedTrainer(model, dataset, 4, cfg)
