@@ -315,6 +315,8 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
             "V": nodes, "E": int(graph.num_edges),
             "layers": args.layers, "impl": args.impl,
             "dtype": args.dtype, "epochs_timed": args.epochs,
+            # compile_s includes persistent-cache hits (near-zero on
+            # repeat runs) — epoch_ms is the comparable metric
             "compile_s": round(compile_s, 1),
             "epoch_ms": round(epoch_ms, 2),
             "epoch_ms_all": [round(t, 1) for t in times],
@@ -323,6 +325,11 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
 
 
 def run_child(args) -> None:
+    # persistent XLA cache: repeat runs (driver retries, staged
+    # protocol, round-over-round) skip the 1-2 min full-scale compile
+    # — directly shrinks the timeout risk the staging exists for
+    from roc_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
     if args.stage == "probe":
         out = child_probe(args)
     elif args.stage == "micro":

@@ -50,6 +50,8 @@ _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def run(cfg_key: str, epochs: int, impl: str,
         dtype: str = "float32") -> dict:
     import jax
+    from roc_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
     import jax.numpy as jnp
     from roc_tpu.core.graph import Dataset, random_csr
     from roc_tpu.models.gat import build_gat

@@ -38,6 +38,7 @@ ell (XLA gather)        119.1      86.0
 pallas (this kernel)   1006.2      10.2
 scan:4096               260.0      39.4
 blocked:1024            294.6      34.8
+====================  =========  ========
 
 **bf16 limitation (measured 2026-07-30):** with bfloat16 features the
 kernel fails Mosaic compilation on v5e (remote-compile INTERNAL error;
@@ -46,7 +47,6 @@ runs).  The framework never routes bf16 through this kernel by
 default (``ell`` wins the race anyway); the micro bench records the
 error as data (``measured_baselines.json
 neighbor_aggregation_reduced_mixed.impls.pallas``).
-====================  =========  ========
 
 The XLA gather path wins by ~18x net of sync overhead and **is the
 framework default**.  Two structural reasons, both discovered only by

@@ -93,6 +93,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="restore a checkpoint before training")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend")
+    ap.add_argument("--no-compile-cache", action="store_true",
+                    help="disable the persistent XLA compilation "
+                         "cache (utils/compile_cache.py; default on — "
+                         "repeat runs skip the 1-2 min Reddit-scale "
+                         "compile)")
     ap.add_argument("--profile-dir", type=str, default=None,
                     help="write a jax.profiler trace of one epoch here")
     return ap.parse_args(argv)
@@ -103,6 +108,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if not args.no_compile_cache:
+        from ..utils.compile_cache import enable_compile_cache
+        enable_compile_cache()
     from ..core.graph import load_dataset, synthetic_dataset
     from ..models.gcn import build_gcn
     from ..models.sage import build_sage

@@ -1,0 +1,43 @@
+"""Persistent XLA compilation cache.
+
+The reference pays no compilation cost (hand-written CUDA kernels);
+the JAX rebuild's one-time cost is XLA compilation of the jitted step
+— 56-122 s at Reddit scale through the remote-compile tunnel, fresh
+per process.  JAX's persistent cache keyed on (HLO, compiler version,
+device kind) removes that for every process after the first:
+measured on v5e through the axon relay, a 2.5 s compile drops to
+0.5 s in the next process.  Enabled by default in the CLI and the
+benchmark harnesses; library users opt in by calling this before the
+first jit.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
+                           "roc_tpu", "xla")
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None,
+                         min_compile_secs: float = 1.0) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir``
+    (default: $ROC_TPU_CACHE_DIR or ~/.cache/roc_tpu/xla).  Safe to
+    call any time before the first compilation; returns the directory
+    used, or None when the directory cannot be created (read-only
+    HOME, sandboxed CI) — the cache is an optimization, so callers
+    must keep working without it."""
+    import jax
+    d = cache_dir or os.environ.get("ROC_TPU_CACHE_DIR") or DEFAULT_DIR
+    try:
+        os.makedirs(d, exist_ok=True)
+    except OSError as e:
+        import sys
+        print(f"# compile cache disabled: cannot create {d}: {e}",
+              file=sys.stderr)
+        return None
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                      float(min_compile_secs))
+    return d

@@ -1,0 +1,31 @@
+"""Persistent XLA compilation cache (utils/compile_cache.py)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from roc_tpu.utils.compile_cache import enable_compile_cache
+
+
+def test_cache_populates_and_is_honored(tmp_path):
+    d = str(tmp_path / "xla")
+    got = enable_compile_cache(d, min_compile_secs=0.0)
+    assert got == d and os.path.isdir(d)
+    f = jax.jit(lambda a: jnp.tanh(a @ a).sum() + 41.0)
+    f(jnp.ones((256, 256))).block_until_ready()
+    assert os.listdir(d), "compilation cache stayed empty"
+
+
+def test_env_var_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "envcache")
+    monkeypatch.setenv("ROC_TPU_CACHE_DIR", d)
+    assert enable_compile_cache() == d
+
+
+def test_uncreatable_dir_degrades_gracefully(tmp_path):
+    # a path under a regular FILE can never be created (works even as
+    # root, unlike a permissions-based setup)
+    f = tmp_path / "plainfile"
+    f.write_text("x")
+    assert enable_compile_cache(str(f / "sub")) is None
