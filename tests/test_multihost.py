@@ -74,6 +74,32 @@ def test_local_ell_plan_matches_global_on_full_part():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     np.testing.assert_array_equal(np.asarray(loc.ell_row_pos),
                                   np.asarray(glo.ell_row_pos))
+    # the attention row map must agree too (EllTable.row_id)
+    assert len(loc.ell_row_id) == len(glo.ell_row_id)
+    for a, b in zip(loc.ell_row_id, glo.ell_row_id):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_gat_trains_on_local_shards():
+    """Attention over partition-local ELL tables: the multihost
+    row_id upload must feed the edge softmax identically to the
+    global path."""
+    from roc_tpu.models.gat import build_gat
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=5)
+    mesh = mh.make_parts_mesh(4)
+    cfg = TrainConfig(epochs=2, verbose=False, aggr_impl="ell",
+                      symmetric=True, dropout_rate=0.0)
+    tr = DistributedTrainer(build_gat([12, 8, 3], dropout_rate=0.0),
+                            ds, 4, cfg, mesh=mesh)
+    want = tr.evaluate()["train_loss"]
+    tr.data = mh.shard_dataset_local(ds, tr.pg, mesh, aggr_impl="ell")
+    got = tr.evaluate()["train_loss"]
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    tr.train(epochs=2)
+    assert np.isfinite(tr.evaluate()["train_loss"])
 
 
 @pytest.mark.parametrize("halo", ["gather", "ring"])
