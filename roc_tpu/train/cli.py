@@ -50,6 +50,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     # TPU-era flags
     ap.add_argument("--model", choices=["gcn", "sage", "gin", "gat"],
                     default="gcn")
+    ap.add_argument("--heads", type=int, default=1,
+                    help="attention heads for --model gat (hidden "
+                         "dims must divide by it; output layer stays "
+                         "single-head)")
     ap.add_argument("--parts", type=int, default=1,
                     help="graph partitions == mesh devices (the "
                          "reference's numMachines*numGPUs)")
@@ -125,6 +129,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: -layers needs at least in-dim and classes",
               file=sys.stderr)
         return 2
+    # flag validation BEFORE the (possibly minutes-long) dataset load
+    if args.model != "gat" and args.heads != 1:
+        print("error: --heads applies to --model gat only",
+              file=sys.stderr)
+        return 2
+    if args.model == "gat":
+        if args.heads < 1:
+            print("error: --heads must be >= 1", file=sys.stderr)
+            return 2
+        bad = [d for d in layers[1:-1] if d % args.heads]
+        if bad:
+            print(f"error: hidden dims {bad} not divisible by "
+                  f"--heads {args.heads}", file=sys.stderr)
+            return 2
 
     if args.file:
         ds = load_dataset(args.file, in_dim=layers[0],
@@ -141,7 +159,9 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
              "gat": build_gat}
-    model = build[args.model](layers, dropout_rate=args.dropout)
+    kwargs = {"heads": args.heads} if args.model == "gat" else {}
+    model = build[args.model](layers, dropout_rate=args.dropout,
+                              **kwargs)
     dt, cdt = resolve_dtypes(args.dtype)
     memory = args.memory
     if memory == "auto" and (args.halo != "gather"
