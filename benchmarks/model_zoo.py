@@ -48,7 +48,7 @@ _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def run(cfg_key: str, epochs: int, impl: str,
-        dtype: str = "float32") -> dict:
+        dtype: str = "float32", heads: int = 1) -> dict:
     import jax
     from roc_tpu.utils.compile_cache import enable_compile_cache
     enable_compile_cache()
@@ -84,7 +84,8 @@ def run(cfg_key: str, epochs: int, impl: str,
 
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
              "gat": build_gat}
-    model = build[c["model"]](layers, dropout_rate=0.5)
+    kwargs = {"heads": heads} if c["model"] == "gat" else {}
+    model = build[c["model"]](layers, dropout_rate=0.5, **kwargs)
     # GIN aggregates raw F-wide features (dropout output feeds
     # scatter_gather directly), which the ELL-family impls handle;
     # 'auto' resolves per the measured window (ell at products scale,
@@ -117,7 +118,7 @@ def run(cfg_key: str, epochs: int, impl: str,
            # the trainer's resolved impl, not the CLI alias — e.g.
            # attention models override to 'ell' at setup
            "impl": tr.config.aggr_impl,
-           "dtype": dtype,
+           "dtype": dtype, **({"heads": heads} if heads != 1 else {}),
            "platform": dev.platform, "device_kind": dev.device_kind,
            "epoch_ms": round(float(np.median(times)), 1),
            "epoch_ms_all": [round(t) for t in times],
@@ -137,8 +138,10 @@ def main():
     ap.add_argument("--impl", default="auto")
     ap.add_argument("--dtype", default="float32",
                     choices=["float32", "bfloat16", "mixed"])
+    ap.add_argument("--heads", type=int, default=1,
+                    help="attention heads (gat configs only)")
     args = ap.parse_args()
-    run(args.config, args.epochs, args.impl, args.dtype)
+    run(args.config, args.epochs, args.impl, args.dtype, args.heads)
 
 
 if __name__ == "__main__":
