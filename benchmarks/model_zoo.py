@@ -52,7 +52,6 @@ def run(cfg_key: str, epochs: int, impl: str,
     import jax
     from roc_tpu.utils.compile_cache import enable_compile_cache
     enable_compile_cache()
-    import jax.numpy as jnp
     from roc_tpu.core.graph import Dataset, random_csr
     from roc_tpu.models.gat import build_gat
     from roc_tpu.models.gcn import build_gcn
@@ -62,6 +61,17 @@ def run(cfg_key: str, epochs: int, impl: str,
 
     c = CONFIGS[cfg_key]
     layers = list(c["layers"])
+    # validate BEFORE the minutes-long synthetic graph generation
+    # (same policy as roc_tpu/train/cli.py's up-front flag checks)
+    if heads != 1:
+        if c["model"] != "gat":
+            raise SystemExit(
+                f"--heads applies to gat configs only; config "
+                f"{cfg_key} is {c['model']}")
+        bad = [d for d in layers[1:-1] if d % heads]
+        if heads < 1 or bad:
+            raise SystemExit(
+                f"--heads {heads} invalid for hidden dims {layers[1:-1]}")
     if impl == "auto":
         # record the kernel that actually runs, not the CLI alias
         from roc_tpu.core.ell import resolve_auto_impl
@@ -118,7 +128,9 @@ def run(cfg_key: str, epochs: int, impl: str,
            # the trainer's resolved impl, not the CLI alias — e.g.
            # attention models override to 'ell' at setup
            "impl": tr.config.aggr_impl,
-           "dtype": dtype, **({"heads": heads} if heads != 1 else {}),
+           "dtype": dtype,
+           **({"heads": heads} if c["model"] == "gat" and heads != 1
+              else {}),
            "platform": dev.platform, "device_kind": dev.device_kind,
            "epoch_ms": round(float(np.median(times)), 1),
            "epoch_ms_all": [round(t) for t in times],

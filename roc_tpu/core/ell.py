@@ -30,7 +30,7 @@ shard over the 1-D parts mesh with identical static shapes per device.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional, Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import numpy as np
 

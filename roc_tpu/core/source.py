@@ -28,7 +28,7 @@ from typing import Optional
 import numpy as np
 
 from . import graph as _graph
-from .graph import (Dataset, Graph, load_features, load_labels,
+from .graph import (Dataset, load_features, load_labels,
                     load_lux_header, load_mask)
 
 

@@ -31,9 +31,9 @@ import numpy as np
 
 from ..ops import dense
 from ..ops.aggregate import (aggregate, aggregate_ell, aggregate_ell_max,
-                             aggregate_ell_sect, aggregate_mean)
+                             aggregate_ell_sect)
 from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU, AC_MODE_SIGMOID
-from ..ops.loss import masked_softmax_cross_entropy, perf_metrics
+from ..ops.loss import masked_softmax_cross_entropy
 from ..ops.norm import indegree_norm
 
 # AggrType mirror (gnn.h:75-80); the reference declares SUM/AVG/MAX/MIN

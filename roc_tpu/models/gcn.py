@@ -23,7 +23,7 @@ from __future__ import annotations
 
 from typing import Sequence
 
-from .builder import AGGR_AVG, AGGR_SUM, Model
+from .builder import Model
 from ..ops.dense import AC_MODE_NONE
 
 

@@ -62,7 +62,6 @@ default picks by table size.
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -23,7 +23,7 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from ..core.graph import MASK_NONE, MASK_TRAIN, MASK_VAL, MASK_TEST
+from ..core.graph import MASK_TRAIN, MASK_VAL, MASK_TEST
 
 
 def masked_softmax_cross_entropy(logits: jax.Array, labels: jax.Array,

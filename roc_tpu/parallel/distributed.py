@@ -30,9 +30,8 @@ devices.
 
 from __future__ import annotations
 
-import functools
 from dataclasses import dataclass, replace as dc_replace
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,8 +44,7 @@ from ..core.graph import Dataset, MASK_NONE
 from ..core.partition import PartitionedGraph, partition_graph
 from ..models.builder import GraphContext, Model
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics, summarize_metrics
-from ..train.optimizer import (AdamConfig, AdamState, adam_init,
-                               adam_update)
+from ..train.optimizer import AdamConfig, adam_init, adam_update
 from ..train.trainer import (TrainConfig, cast_floats, compute_dtype_of,
                              remat_policy, resolve_symmetric)
 

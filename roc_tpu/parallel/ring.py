@@ -27,7 +27,7 @@ into the last output row, so they are numeric no-ops.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -22,8 +22,6 @@ import sys
 import time
 from typing import List, Optional
 
-import numpy as np
-
 
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
@@ -95,6 +93,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="also save every N epochs")
     ap.add_argument("--resume", type=str, default=None,
                     help="restore a checkpoint before training")
+    ap.add_argument("--eval-only", action="store_true",
+                    help="run one inference pass (the reference's "
+                         "every-5th-epoch infer, gnn.cc:107-110, as a "
+                         "standalone step — typically with --resume) "
+                         "and exit")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend")
     ap.add_argument("--no-compile-cache", action="store_true",
@@ -190,6 +193,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         restore_trainer(trainer, args.resume)
         print(f"# resumed from {args.resume} at epoch {trainer.epoch}",
               file=sys.stderr)
+
+    if args.eval_only:
+        from .trainer import format_metrics
+        m = trainer.evaluate()
+        print(format_metrics(trainer.epoch, m))
+        return 0
 
     if args.profile_dir:
         trainer.train(epochs=1)  # compile outside the trace

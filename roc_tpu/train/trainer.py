@@ -10,10 +10,9 @@ the minimum end-to-end slice (BASELINE.md config 1/2 path).
 
 from __future__ import annotations
 
-import functools
 import time
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -23,7 +22,7 @@ from ..core.graph import Dataset
 from ..core.partition import padded_edge_list
 from ..models.builder import GraphContext, Model
 from ..ops.loss import perf_metrics, summarize_metrics
-from .optimizer import AdamConfig, AdamState, adam_init, adam_update, decayed_lr
+from .optimizer import AdamConfig, adam_init, adam_update, decayed_lr
 
 
 @dataclass
