@@ -42,13 +42,24 @@ CONFIGS = {
     # trainer's resolve_attention_impl override, not the size split.
     "6": dict(model="gat", nodes=169_343, edges=4_600_000,
               layers=(128, 256, 40)),
+    # 7: GAT at the products/Amazon-2M shape — the attention capability
+    # bound on one chip.  Status (v5e, 2026-07-30): does NOT land.
+    # Without the scan-body remat in ops/attention.py the backward
+    # residuals OOM at compile (18.5 GiB stacked gathers — that remat
+    # is now in); with --dtype mixed --remat the program then exceeds
+    # practical compile time through the remote-compile tunnel (>40
+    # min, killed).  Config 6 (arxiv shape) is the measured attention
+    # config; this entry documents the boundary honestly.
+    "7": dict(model="gat", nodes=2_449_029, edges=126_000_000,
+              layers=(100, 256, 47)),
 }
 _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "model_zoo.jsonl")
 
 
 def run(cfg_key: str, epochs: int, impl: str,
-        dtype: str = "float32", heads: int = 1) -> dict:
+        dtype: str = "float32", heads: int = 1,
+        remat: bool = False) -> dict:
     import jax
     from roc_tpu.utils.compile_cache import enable_compile_cache
     enable_compile_cache()
@@ -68,8 +79,7 @@ def run(cfg_key: str, epochs: int, impl: str,
             raise SystemExit(
                 f"--heads applies to gat configs only; config "
                 f"{cfg_key} is {c['model']}")
-        bad = [d for d in layers[1:-1] if d % heads]
-        if heads < 1 or bad:
+        if heads < 1 or any(d % heads for d in layers[1:-1]):
             raise SystemExit(
                 f"--heads {heads} invalid for hidden dims {layers[1:-1]}")
     if impl == "auto":
@@ -107,10 +117,14 @@ def run(cfg_key: str, epochs: int, impl: str,
     # a 16G chip by ~0.4G) and halves aggregation HBM traffic
     from roc_tpu.train.trainer import resolve_dtypes
     dt, cdt = resolve_dtypes(dtype)
+    # --remat forces manual remat (the autopilot's estimator doesn't
+    # model attention's extra transients; config 7 needs this)
     tc = TrainConfig(learning_rate=0.01, weight_decay=1e-4,
                      aggr_impl=impl, verbose=True,
                      dtype=dt, compute_dtype=cdt,
-                     eval_every=1 << 30, symmetric=True, memory="auto")
+                     eval_every=1 << 30, symmetric=True,
+                     memory="manual" if remat else "auto",
+                     remat=remat)
     t0 = time.time()
     tr = Trainer(model, ds, tc)
     tr.train(epochs=2)
@@ -131,6 +145,7 @@ def run(cfg_key: str, epochs: int, impl: str,
            "dtype": dtype,
            **({"heads": heads} if c["model"] == "gat" and heads != 1
               else {}),
+           **({"remat": True} if remat else {}),
            "platform": dev.platform, "device_kind": dev.device_kind,
            "epoch_ms": round(float(np.median(times)), 1),
            "epoch_ms_all": [round(t) for t in times],
@@ -152,8 +167,11 @@ def main():
                     choices=["float32", "bfloat16", "mixed"])
     ap.add_argument("--heads", type=int, default=1,
                     help="attention heads (gat configs only)")
+    ap.add_argument("--remat", action="store_true",
+                    help="force remat (skip the memory autopilot)")
     args = ap.parse_args()
-    run(args.config, args.epochs, args.impl, args.dtype, args.heads)
+    run(args.config, args.epochs, args.impl, args.dtype, args.heads,
+        args.remat)
 
 
 if __name__ == "__main__":

@@ -99,8 +99,17 @@ def gat_aggregate_ell(full: jax.Array, s_full: jax.Array,
             [rid, jnp.full((Rp - R,), num_rows, dtype=rid.dtype)],
             axis=0)
 
+        # remat each step: WITHOUT it, autodiff saves every step's
+        # [seg_rows, W, F] feature gather as a stacked scan residual —
+        # [segs, seg_rows, W, F] = 18.5 GiB at products scale
+        # (observed OOM, v5e 2026-07-30).  Attention is nonlinear, so
+        # unlike the sum path the backward genuinely needs the
+        # gathered values; recomputing them per step in the backward
+        # sweep bounds memory at one step's transient.
+        seg_out_ckpt = jax.checkpoint(seg_out)
+
         def body(_, ch):
-            return None, seg_out(*ch)
+            return None, seg_out_ckpt(*ch)
 
         _, segs_out = lax.scan(body, None,
                                (idx_p.reshape(segs, seg_rows, W),

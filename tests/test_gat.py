@@ -214,6 +214,22 @@ def test_gat_mixed_precision(dataset):
     assert m["train_acc"] > 0.85, m
 
 
+def test_gat_streamable_head(dataset):
+    """GAT's first layer (input -> dropout -> linear) qualifies for
+    the host-feature streaming tier; training must work with the
+    features never device-resident."""
+    model = build_gat([dataset.in_dim, 16, dataset.num_classes],
+                      dropout_rate=0.5)
+    assert model.streamable_head() is not None
+    tr = Trainer(model, dataset,
+                 TrainConfig(aggr_impl="ell", verbose=False,
+                             eval_every=1 << 30, features="host"))
+    assert tr.feats is None          # never uploaded whole
+    tr.train(epochs=3)
+    m = tr.evaluate()
+    assert np.isfinite(m["train_loss"])
+
+
 def test_gat_ring_rejected_at_setup(dataset):
     """halo='ring' + attention fails fast at trainer construction,
     before any ring-table build."""
