@@ -57,9 +57,12 @@ METRIC_SMALL = "full_graph_gcn_small_epoch_time"
 METRIC_MICRO = "neighbor_aggregation_reduced"
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_BASELINES_PATH = os.path.join(_HERE, "benchmarks",
-                               "measured_baselines.json")
-_STAGES_PATH = os.path.join(_HERE, "benchmarks", "bench_stages.jsonl")
+# tests (and any sandboxed run) point this at a temp dir so stage
+# attempts / baselines never dirty the repo's recorded artifacts
+_ART_DIR = (os.environ.get("ROC_TPU_BENCH_ARTIFACTS")
+            or os.path.join(_HERE, "benchmarks"))
+_BASELINES_PATH = os.path.join(_ART_DIR, "measured_baselines.json")
+_STAGES_PATH = os.path.join(_ART_DIR, "bench_stages.jsonl")
 
 # (name, default child timeout s, minimum useful budget s)
 STAGES = (("probe", 150.0, 40.0),

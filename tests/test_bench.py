@@ -1,0 +1,70 @@
+"""The staged benchmark protocol (bench.py) — CPU smoke.
+
+bench.py is the driver's scoring entry point; these tests pin its
+always-one-JSON-line contract and the graceful-degradation behavior
+the staged design exists for, without touching the TPU (--cpu)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_BENCH = os.path.join(_REPO, "bench.py")
+
+
+def _run(args, timeout=300, art_dir=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    if art_dir:
+        # keep test runs out of the repo's recorded artifacts
+        env["ROC_TPU_BENCH_ARTIFACTS"] = art_dir
+    return subprocess.run(
+        [sys.executable, _BENCH] + args, capture_output=True,
+        text=True, timeout=timeout, cwd=_REPO, env=env)
+
+
+def _last_json(out: str) -> dict:
+    lines = [l for l in out.splitlines() if l.strip().startswith("{")]
+    assert lines, out
+    return json.loads(lines[-1])
+
+
+@pytest.mark.slow
+def test_small_stage_emits_json_line(tmp_path):
+    r = _run(["--cpu", "--stages", "small", "--epochs", "2"],
+             art_dir=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = _last_json(r.stdout)
+    assert line["unit"] == "ms"
+    assert line["value"] > 0
+    assert line["stage"] == "small"
+    # CPU runs must never be recorded as baselines
+    assert line.get("baseline") != "recorded_now"
+
+
+def test_unknown_stage_still_prints_contract_line(tmp_path):
+    r = _run(["--cpu", "--stages", "nope"], art_dir=str(tmp_path))
+    line = _last_json(r.stdout)
+    assert line["value"] is None
+    assert "unknown stages" in line["error"]
+
+
+def test_depleted_deadline_degrades_to_skip(tmp_path):
+    """A deadline too small for any stage must yield the JSON contract
+    line with per-stage skip errors — never a crash or silence."""
+    r = _run(["--cpu", "--stages", "small", "--deadline", "30"],
+             art_dir=str(tmp_path))
+    line = _last_json(r.stdout)
+    assert line["value"] is None
+    assert "skipped" in line["stages"]["small"]["error"]
+
+
+@pytest.mark.slow
+def test_dtype_suffix_keeps_metrics_separate(tmp_path):
+    r = _run(["--cpu", "--stages", "small", "--epochs", "2",
+              "--dtype", "mixed"], art_dir=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = _last_json(r.stdout)
+    assert line["metric"].endswith("_mixed")
