@@ -1,0 +1,123 @@
+"""Vertex reordering for gather locality.
+
+The sectioned aggregation's win comes from VMEM-resident source
+sections (``core/ell.py SectionedEll``): every (row, section) pair an
+edge crosses costs a padded width-8 sub-row, so the layout is cheapest
+when each row's neighbors CLUSTER into few sections.  Real-world
+graphs have strong community structure but often arbitrary vertex ids;
+a locality-preserving relabeling concentrates each neighborhood into a
+narrow id range.  This module provides that preprocessing pass:
+
+- :func:`bfs_order` — breadth-first relabeling from a max-degree seed
+  (the classic bandwidth-reduction family: neighbors get consecutive
+  ids, communities become contiguous id blocks);
+- :func:`apply_vertex_order` — permute a whole Dataset (CSR, features,
+  labels, masks) so training on the reordered graph is equivalent up
+  to the vertex relabeling (logits come back in the NEW order; use the
+  returned permutation to map back).
+
+The reference has no analog (its loader keeps file order,
+``load_task.cu:201-245``); this is a TPU-era optimization pass.  On
+the synthetic *uniform-random* benchmark graphs reordering cannot help
+(no structure to recover — measured neutral); the planted-community
+test (``tests/test_reorder.py``) demonstrates the mechanism the pass
+exists for: cross-section edges drop by >2x on a clustered graph with
+shuffled ids.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from .graph import Dataset, Graph
+
+
+def bfs_order(graph: Graph) -> np.ndarray:
+    """``perm[new_id] == old_id``: BFS relabeling over the undirected
+    view of the CSR, seeded at the max-in-degree vertex of each
+    component (processed in decreasing seed degree).  O(V + E)."""
+    V = graph.num_nodes
+    row_ptr, col = graph.row_ptr, graph.col_idx
+    # undirected adjacency: in-edges (CSR rows) + out-edges (reverse)
+    deg_in = np.diff(row_ptr)
+    dst_all = np.repeat(np.arange(V, dtype=np.int64), deg_in)
+    src_all = col.astype(np.int64)
+    u = np.concatenate([src_all, dst_all])
+    v = np.concatenate([dst_all, src_all])
+    order = np.argsort(u, kind="stable")
+    u, v = u[order], v[order]
+    nbr_ptr = np.zeros(V + 1, dtype=np.int64)
+    np.cumsum(np.bincount(u, minlength=V), out=nbr_ptr[1:])
+
+    visited = np.zeros(V, dtype=bool)
+    out = np.empty(V, dtype=np.int64)
+    pos = 0
+    for seed in np.argsort(-deg_in, kind="stable"):
+        if visited[seed]:
+            continue
+        frontier = np.array([seed], dtype=np.int64)
+        visited[seed] = True
+        while frontier.size:
+            out[pos:pos + frontier.size] = frontier
+            pos += frontier.size
+            # all neighbors of the frontier, vectorized
+            spans = [v[nbr_ptr[f]:nbr_ptr[f + 1]] for f in frontier]
+            nxt = np.unique(np.concatenate(spans)) if spans else \
+                np.empty(0, np.int64)
+            nxt = nxt[~visited[nxt]]
+            visited[nxt] = True
+            frontier = nxt
+    assert pos == V
+    return out
+
+
+def apply_vertex_order(dataset: Dataset,
+                       perm: np.ndarray) -> Tuple[Dataset, np.ndarray]:
+    """Dataset with vertices relabeled so ``new_id = rank(old_id)``.
+
+    perm: ``perm[new_id] == old_id`` (from :func:`bfs_order`).
+    Returns ``(reordered_dataset, perm)``; row ``perm[i]`` of the
+    original corresponds to row ``i`` of the result, so original-order
+    logits are ``new_logits[inv]`` with ``inv = argsort(perm)``...
+    i.e. ``orig_logits = new_logits[rank]`` where ``rank[old] = new``.
+    Per-row neighbor lists are re-sorted ascending, preserving the
+    loaders' monotone-CSR convention.
+    """
+    g = dataset.graph
+    V = g.num_nodes
+    perm = np.asarray(perm, dtype=np.int64)
+    assert perm.shape == (V,)
+    rank = np.empty(V, dtype=np.int64)
+    rank[perm] = np.arange(V, dtype=np.int64)
+
+    deg = np.diff(g.row_ptr)
+    new_deg = deg[perm]
+    new_row_ptr = np.zeros(V + 1, dtype=np.int64)
+    np.cumsum(new_deg, out=new_row_ptr[1:])
+    # vectorized edge relabel: sort all edges by (new dst, new src) —
+    # one lexsort instead of a V-iteration Python loop
+    old_dst = np.repeat(np.arange(V, dtype=np.int64), deg)
+    new_dst = rank[old_dst]
+    new_src = rank[g.col_idx.astype(np.int64)]
+    order = np.lexsort((new_src, new_dst))
+    new_col = new_src[order].astype(np.int32)
+    new_graph = Graph(row_ptr=new_row_ptr, col_idx=new_col)
+    return Dataset(
+        graph=new_graph,
+        features=np.ascontiguousarray(dataset.features[perm]),
+        labels=np.ascontiguousarray(dataset.labels[perm]),
+        mask=np.ascontiguousarray(dataset.mask[perm]),
+        num_classes=dataset.num_classes,
+        name=dataset.name + "+bfs"), perm
+
+
+def cross_section_pairs(graph: Graph, section_rows: int) -> int:
+    """Number of distinct (destination row, source section) pairs — the
+    sectioned layout's padding driver (each pair costs >= one width-8
+    sub-row).  The quantity :func:`bfs_order` exists to reduce."""
+    V = graph.num_nodes
+    dst = np.repeat(np.arange(V, dtype=np.int64), np.diff(graph.row_ptr))
+    sec = graph.col_idx.astype(np.int64) // section_rows
+    return int(np.unique(dst * (sec.max() + 1) + sec).shape[0])
