@@ -62,10 +62,18 @@ def bfs_order(graph: Graph) -> np.ndarray:
         while frontier.size:
             out[pos:pos + frontier.size] = frontier
             pos += frontier.size
-            # all neighbors of the frontier, vectorized
-            spans = [v[nbr_ptr[f]:nbr_ptr[f + 1]] for f in frontier]
-            nxt = np.unique(np.concatenate(spans)) if spans else \
-                np.empty(0, np.int64)
+            # frontier's neighbor ids, fully vectorized: flatten the
+            # [nbr_ptr[f], nbr_ptr[f+1]) ranges with repeat+cumsum
+            # arithmetic (no per-vertex Python)
+            starts = nbr_ptr[frontier]
+            counts = nbr_ptr[frontier + 1] - starts
+            total = int(counts.sum())
+            if total == 0:
+                break
+            offs = np.arange(total, dtype=np.int64)
+            row_start = np.repeat(np.cumsum(counts) - counts, counts)
+            flat = np.repeat(starts, counts) + (offs - row_start)
+            nxt = np.unique(v[flat])
             nxt = nxt[~visited[nxt]]
             visited[nxt] = True
             frontier = nxt
