@@ -84,6 +84,27 @@ def _planted_community_dataset(C=8, per=64, seed=0):
                    name="planted")
 
 
+def test_bfs_shrinks_sectioned_tables_on_community_graph():
+    """End-to-end: the actual SectionedEll layout (padded sub-rows =
+    device memory + gather work) shrinks after reordering the planted
+    community graph."""
+    from roc_tpu.core.ell import section_sub_counts
+    ds = _planted_community_dataset()
+    new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph))
+    sec = 64
+
+    def sub_rows(g):
+        return int(section_sub_counts(g.row_ptr, g.col_idx,
+                                      g.num_nodes, g.num_nodes,
+                                      section_rows=sec).sum())
+
+    before = sub_rows(ds.graph)
+    after = sub_rows(new_ds.graph)
+    # each sub-row is 8 gather slots; fewer sub-rows = smaller tables
+    # and fewer padded gathers
+    assert after * 2 <= before, (before, after)
+
+
 def test_bfs_reduces_cross_section_pairs_on_community_graph():
     """The mechanism: on a community graph with shuffled ids, BFS
     relabeling clusters each neighborhood into few sections —
