@@ -316,6 +316,15 @@ class Model:
         the ELL tables (trainers force aggr_impl='ell')."""
         return any(op.kind == "gat" for op in self._ops)
 
+    def uses_max_aggregation(self) -> bool:
+        """True when any scatter_gather op is MAX/MIN — those have no
+        sectioned/blocked/scan implementation and no ring form, so the
+        trainers' impl resolver forces 'ell' and rejects halo='ring'
+        up front (same policy as attention)."""
+        return any(op.kind == "scatter_gather"
+                   and op.attrs.get("aggr") in (AGGR_MAX, AGGR_MIN)
+                   for op in self._ops)
+
     # ---- builder API (names match the reference) ----
 
     def input(self) -> TensorHandle:

@@ -18,12 +18,25 @@ from __future__ import annotations
 
 from typing import Sequence
 
-from .builder import AGGR_AVG, AGGR_SUM, Model
-from ..ops.dense import AC_MODE_NONE
+from .builder import AGGR_AVG, AGGR_MAX, AGGR_SUM, Model
+from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU
 
 
 def build_sage(layers: Sequence[int], dropout_rate: float = 0.5,
-               use_norm: bool = False) -> Model:
+               use_norm: bool = False,
+               aggregator: str = "mean") -> Model:
+    """``aggregator``: "mean" (the default SAGE-mean layer) or "pool"
+    (max-pooling: neighbors pass through a learned ReLU projection and
+    the elementwise MAX over the neighborhood is taken — Hamilton et
+    al.'s pool aggregator, using the framework's AGGR_MAX path).
+    ``use_norm`` swaps mean for the symmetric GraphNorm form (mean
+    only)."""
+    if aggregator not in ("mean", "pool"):
+        raise ValueError(f"unknown SAGE aggregator {aggregator!r}; "
+                         "expected 'mean' or 'pool'")
+    if aggregator == "pool" and use_norm:
+        raise ValueError("use_norm applies to the mean aggregator "
+                         "(GraphNorm replaces the mean, not the pool)")
     model = Model(in_dim=layers[0])
     t = model.input()
     n = len(layers)
@@ -31,7 +44,11 @@ def build_sage(layers: Sequence[int], dropout_rate: float = 0.5,
         t = model.dropout(t, dropout_rate)
         self_proj = model.linear(t, layers[i], AC_MODE_NONE)
         neigh = t
-        if use_norm:
+        if aggregator == "pool":
+            # learned pre-pool transform, then neighborhood max
+            neigh = model.linear(neigh, layers[i], AC_MODE_RELU)
+            neigh = model.scatter_gather(neigh, aggr=AGGR_MAX)
+        elif use_norm:
             neigh = model.indegree_norm(neigh)
             neigh = model.scatter_gather(neigh, aggr=AGGR_SUM)
             neigh = model.indegree_norm(neigh)

@@ -142,24 +142,29 @@ def remat_policy(config: TrainConfig):
 
 
 def resolve_attention_impl(model, config: TrainConfig) -> TrainConfig:
-    """The ONE attention-impl policy both trainers apply: attention
-    models need the ELL tables (edge softmax over one bucket row,
-    ops/attention.py), so any other aggr_impl is overridden to 'ell'
-    with a startup echo; halo='ring' is rejected up front — failing at
-    jit-trace time would waste the whole ring-table build first."""
-    if not model.uses_attention():
+    """The ONE model-driven impl policy both trainers apply: models
+    whose ops need the ELL tables — attention (edge softmax over one
+    bucket row, ops/attention.py) and MAX/MIN aggregation (no
+    sectioned/blocked/scan form) — get aggr_impl overridden to 'ell'
+    with a startup echo, and halo='ring' rejected up front (the ring
+    accumulator is additive; failing at jit-trace time would waste
+    the whole ring-table build first)."""
+    why = ("attention" if model.uses_attention()
+           else "MAX/MIN aggregation" if model.uses_max_aggregation()
+           else None)
+    if why is None:
         return config
     if config.halo == "ring":
         raise NotImplementedError(
-            "attention models are not supported with halo='ring' (the "
-            "ring accumulator is additive; the edge softmax needs the "
-            "whole neighborhood); use halo='gather'")
+            f"{why} models are not supported with halo='ring' (the "
+            "ring accumulator is additive; the whole neighborhood is "
+            "needed per row); use halo='gather'")
     if config.aggr_impl in ("ell", "pallas"):
         return config
     if config.verbose:
         import sys
         print(f"# aggr_impl={config.aggr_impl!r} -> 'ell' "
-              "(attention model needs the ELL tables)", file=sys.stderr)
+              f"({why} model needs the ELL tables)", file=sys.stderr)
     import dataclasses
     return dataclasses.replace(config, aggr_impl="ell")
 

@@ -51,6 +51,34 @@ def test_model_families_impl_invariance(dataset, build):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_sage_pool_converges_and_validates(dataset):
+    """Hamilton et al.'s max-pool aggregator: learned ReLU pre-pool
+    transform + neighborhood MAX (the AGGR_MAX path's first real
+    model consumer); bad option combos error up front."""
+    model = build_sage([dataset.in_dim, 24, dataset.num_classes],
+                       dropout_rate=0.0, aggregator="pool")
+    # 'auto' must resolve to 'ell' via the shared model-driven impl
+    # policy (sectioned/blocked/scan have no MAX form)
+    cfg = TrainConfig(learning_rate=0.01, weight_decay=1e-4,
+                      aggr_impl="auto", verbose=False,
+                      eval_every=1 << 30)
+    t = Trainer(model, dataset, cfg)
+    assert t.config.aggr_impl == "ell"
+    t.train(epochs=80)
+    m = t.evaluate()
+    assert m["train_acc"] > 0.9, m
+    with pytest.raises(ValueError, match="aggregator"):
+        build_sage([4, 8, 2], aggregator="median")
+    with pytest.raises(ValueError, match="use_norm"):
+        build_sage([4, 8, 2], aggregator="pool", use_norm=True)
+    # ring + MAX fails fast at trainer setup, before any table build
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    with pytest.raises(NotImplementedError, match="ring"):
+        DistributedTrainer(model, dataset, 4,
+                           TrainConfig(aggr_impl="ell", halo="ring",
+                                       verbose=False))
+
+
 def test_max_aggregator_matches_numpy(dataset):
     g = dataset.graph
     feats = dataset.features
