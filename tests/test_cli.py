@@ -1,0 +1,55 @@
+"""CLI surface (roc_tpu/train/cli.py): flag plumbing, validation, and
+the train/eval/checkpoint entry points, in-process on CPU."""
+
+import numpy as np
+import pytest
+
+from roc_tpu.train import cli
+
+
+def _run(argv):
+    return cli.main(["--cpu", "--no-compile-cache"] + argv)
+
+
+def test_synthetic_train_succeeds(capsys):
+    rc = _run(["-e", "3", "-layers", "8-8-3", "--eval-every", "3",
+               "--impl", "ell"])
+    assert rc == 0
+    assert "[INFER]" in capsys.readouterr().out
+
+
+def test_checkpoint_resume_eval_only(tmp_path, capsys):
+    ck = str(tmp_path / "ck.npz")
+    assert _run(["-e", "3", "-layers", "8-8-3", "--impl", "ell",
+                 "--checkpoint", ck]) == 0
+    capsys.readouterr()
+    rc = _run(["-e", "3", "-layers", "8-8-3", "--impl", "ell",
+               "--resume", ck, "--eval-only"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # one INFER line at the restored epoch, no training
+    assert out.count("[INFER]") == 1
+    assert "[INFER][3]" in out
+
+
+@pytest.mark.parametrize("argv,msg", [
+    (["-layers", "8"], "at least"),
+    (["--model", "gcn", "--heads", "4", "-layers", "8-8-3"],
+     "--heads applies"),
+    (["--model", "gat", "--heads", "0", "-layers", "8-8-3"],
+     ">= 1"),
+    (["--model", "gat", "--heads", "3", "-layers", "8-8-3"],
+     "divisible"),
+    (["--halo", "ring", "-layers", "8-8-3"], "--parts"),
+])
+def test_flag_validation_fails_fast(argv, msg, capsys):
+    assert _run(argv) == 2
+    assert msg in capsys.readouterr().err
+
+
+def test_gat_mixed_distributed(capsys):
+    rc = _run(["-e", "2", "-layers", "8-8-3", "--model", "gat",
+               "--heads", "2", "--dtype", "mixed", "--parts", "2",
+               "--eval-every", "2"])
+    assert rc == 0
+    assert "[INFER]" in capsys.readouterr().out
