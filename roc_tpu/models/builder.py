@@ -309,6 +309,7 @@ class Model:
         self._ops: List[_Op] = [_Op("input", (), in_dim)]
         self._n_linear = 0
         self._n_gat = 0
+        self._n_eps = 0
         self._loss_op: Optional[int] = None
 
     def uses_attention(self) -> bool:
@@ -386,6 +387,16 @@ class Model:
         assert a.dim == b.dim
         return self._append("add", (a.idx, b.idx), a.dim)
 
+    def scale_add(self, a: TensorHandle, b: TensorHandle) -> TensorHandle:
+        """``a + eps * b`` with a LEARNABLE scalar ``eps`` (zero-init).
+        GIN's (1+eps) self-weight reduces to this on self-edged graphs:
+        (1+eps)x + sum_{u != v} x_u == agg + eps*x (models/gin.py)."""
+        assert a.dim == b.dim
+        name = f"eps_{self._n_eps}"
+        self._n_eps += 1
+        return self._append("scale_add", (a.idx, b.idx), a.dim,
+                            param=name)
+
     def mul(self, a: TensorHandle, b: TensorHandle) -> TensorHandle:
         assert a.dim == b.dim
         return self._append("mul", (a.idx, b.idx), a.dim)
@@ -457,6 +468,9 @@ class Model:
                 s = float(np.sqrt(6.0 / (in_dim + op.dim)))
                 params[op.param] = jax.random.uniform(
                     sub, (in_dim, op.dim), dtype=dtype, minval=-s, maxval=s)
+            elif op.kind == "scale_add":
+                # learnable GIN eps: zero-init (the paper's GIN-0)
+                params[op.param] = jnp.zeros((), dtype=dtype)
             elif op.kind == "gat":
                 # per head, the attention vectors are the [2*dh] -> 1
                 # projection of the GAT paper split at the concat
@@ -517,6 +531,10 @@ class Model:
                 vals[i] = dense.activation(x, op.attrs["mode"])
             elif op.kind == "add":
                 vals[i] = vals[op.inputs[0]] + vals[op.inputs[1]]
+            elif op.kind == "scale_add":
+                eps = params[op.param].astype(vals[op.inputs[0]].dtype)
+                vals[i] = (vals[op.inputs[0]]
+                           + eps * vals[op.inputs[1]])
             elif op.kind == "mul":
                 vals[i] = vals[op.inputs[0]] * vals[op.inputs[1]]
             else:

@@ -24,16 +24,22 @@ from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU
 
 
 def build_gin(layers: Sequence[int], dropout_rate: float = 0.5,
-              mlp_hidden: int = 0) -> Model:
+              mlp_hidden: int = 0, learn_eps: bool = False) -> Model:
     """``mlp_hidden`` == 0 uses the layer's own width for the MLP's
-    hidden dim."""
+    hidden dim.  ``learn_eps`` swaps the fixed self-contribution for
+    the paper's learnable epsilon: on self-edged graphs
+    (1+eps)x + sum_{u != v} x_u == agg + eps*x, so the layer becomes
+    ``scale_add(agg, x)`` with a zero-init scalar (GIN-0 start)."""
     model = Model(in_dim=layers[0])
     t = model.input()
     n = len(layers)
     for i in range(1, n):
         t = model.dropout(t, dropout_rate)
         agg = model.scatter_gather(t, aggr=AGGR_SUM)
-        t = model.add(t, agg)
+        if learn_eps:
+            t = model.scale_add(agg, t)
+        else:
+            t = model.add(t, agg)
         hidden = mlp_hidden or layers[i]
         t = model.linear(t, hidden, AC_MODE_RELU)
         t = model.linear(t, layers[i], AC_MODE_NONE)

@@ -65,7 +65,13 @@ def adam_update(params: Any, grads: Any, state: AdamState, lr: jax.Array,
 
     def upd(w, g, m, v):
         w32 = w.astype(jnp.float32)
-        gt = g.astype(jnp.float32) + cfg.weight_decay * w32
+        # L2-coupled decay on weight MATRICES only (the reference's
+        # params are all matrices, optimizer_kernel.cu:52-62); scalar
+        # params (GIN's learnable eps) are excluded — decaying them
+        # would regularize eps back to GIN-0 against the paper's
+        # free epsilon
+        wd = cfg.weight_decay if w.ndim > 0 else 0.0
+        gt = g.astype(jnp.float32) + wd * w32
         mt = cfg.beta1 * m + (1.0 - cfg.beta1) * gt
         vt = cfg.beta2 * v + (1.0 - cfg.beta2) * gt * gt
         new_w = w32 - alpha_t * mt / (jnp.sqrt(vt) + cfg.epsilon)

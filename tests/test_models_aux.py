@@ -51,6 +51,52 @@ def test_model_families_impl_invariance(dataset, build):
                                rtol=1e-4, atol=1e-4)
 
 
+def test_gin_learnable_eps(dataset):
+    """learn_eps=True: zero-init scalar (GIN-0), updated by training,
+    and at eps == 0 the forward equals plain aggregation (no self
+    doubling)."""
+    model = build_gin([dataset.in_dim, 16, dataset.num_classes],
+                      dropout_rate=0.0, learn_eps=True)
+    params = model.init_params(jax.random.PRNGKey(0))
+    assert params["eps_0"].shape == ()
+    assert float(params["eps_0"]) == 0.0
+    # the algebra the docstring claims: at eps == 0 the layer output
+    # is EXACTLY the aggregation (no self term) — pin the forward
+    # against a hand-built model with the eps layer removed, sharing
+    # the same linear params (scale_add consumes no PRNG key, so the
+    # param names and values line up)
+    from roc_tpu.models.builder import AGGR_SUM, Model
+    from roc_tpu.ops.dense import AC_MODE_NONE, AC_MODE_RELU
+    ref_model = Model(in_dim=dataset.in_dim)
+    rt = ref_model.input()
+    for dim in (16, dataset.num_classes):
+        rt = ref_model.dropout(rt, 0.0)
+        rt = ref_model.scatter_gather(rt, aggr=AGGR_SUM)
+        rt = ref_model.linear(rt, dim, AC_MODE_RELU)
+        rt = ref_model.linear(rt, dim, AC_MODE_NONE)
+        if dim != dataset.num_classes:
+            rt = ref_model.relu(rt)
+    ref_model.softmax_cross_entropy(rt)
+    gctx = make_graph_context(dataset, aggr_impl="ell")
+    feats = jnp.asarray(dataset.features)
+    got = model.apply(params, feats, gctx, train=False)
+    ref = ref_model.apply(params, feats, gctx, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6)
+    cfg = TrainConfig(learning_rate=0.01, aggr_impl="ell",
+                      verbose=False, eval_every=1 << 30)
+    t = Trainer(model, dataset, cfg)
+    loss0 = t.evaluate()["train_loss"]
+    t.train(epochs=60)
+    m = t.evaluate()
+    # mechanics, not a convergence bar: GIN-0's zero-init self weight
+    # is a much weaker inductive bias than the fixed eps=1 form on
+    # this tiny fixture (which test_model_families_converge gates);
+    # here we pin that the objective moves and eps is actually trained
+    assert m["train_loss"] < 0.75 * loss0, (loss0, m["train_loss"])
+    assert float(t.params["eps_0"]) != 0.0  # actually learned
+
+
 def test_sage_pool_converges_and_validates(dataset):
     """Hamilton et al.'s max-pool aggregator: learned ReLU pre-pool
     transform + neighborhood MAX (the AGGR_MAX path's first real
