@@ -278,6 +278,7 @@ class DistributedTrainer:
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()
+        self._predict_step = None   # built lazily on first predict()
         from ..utils.profiling import EpochTimer, MetricsLog
         self.timer = EpochTimer()
         self.metrics_log = MetricsLog(config.metrics_path)
@@ -353,30 +354,37 @@ class DistributedTrainer:
             check_vma=False)
         return jax.jit(sm, donate_argnums=(0, 1))
 
+    def _local_forward(self, params, feats, edge_src, edge_dst,
+                       in_degree, ell_idx, ell_row_pos, ell_row_id,
+                       ring_idx, sect_idx, sect_sub_dst):
+        """Shared shard_map body: slice the parts axis off the local
+        blocks, assemble the local GraphContext, run the inference
+        forward — eval (adds metrics+psum) and predict (adds
+        all_gather) both build on this, so the gctx wiring exists in
+        ONE place."""
+        feats = feats[0]
+        edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
+                                         in_degree[0])
+        gctx = dc_replace(
+            self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
+            in_degree=in_degree,
+            ell_idx=tuple(a[0] for a in ell_idx),
+            ell_row_pos=ell_row_pos[0],
+            ell_row_id=tuple(a[0] for a in ell_row_id),
+            ring_idx=tuple(a[0] for a in ring_idx),
+            sect_idx=tuple(a[0] for a in sect_idx),
+            sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
+        return self.model.apply(cast_floats(params, self.compute),
+                                feats, gctx, key=None, train=False)
+
     def _build_eval_step(self):
         mesh = self.mesh
         spec_p = P("parts")
         spec_r = P()
 
-        def step(params, feats, labels, mask, edge_src, edge_dst,
-                 in_degree, ell_idx, ell_row_pos, ell_row_id, ring_idx,
-                 sect_idx, sect_sub_dst):
-            feats, labels, mask = feats[0], labels[0], mask[0]
-            edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
-                                             in_degree[0])
-            gctx = dc_replace(
-                self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
-                in_degree=in_degree,
-                ell_idx=tuple(a[0] for a in ell_idx),
-                ell_row_pos=ell_row_pos[0],
-                ell_row_id=tuple(a[0] for a in ell_row_id),
-                ring_idx=tuple(a[0] for a in ring_idx),
-                sect_idx=tuple(a[0] for a in sect_idx),
-                sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
-            logits = self.model.apply(cast_floats(params, self.compute),
-                                      feats, gctx, key=None,
-                                      train=False)
-            m = perf_metrics(logits, labels, mask)
+        def step(params, feats, labels, mask, *graph_args):
+            logits = self._local_forward(params, feats, *graph_args)
+            m = perf_metrics(logits, labels[0], mask[0])
             return jax.tree_util.tree_map(
                 lambda t: lax.psum(t, "parts"), m)
 
@@ -421,3 +429,35 @@ class DistributedTrainer:
 
     def evaluate(self) -> Dict[str, float]:
         return self._eval(-1)
+
+    def predict(self) -> np.ndarray:
+        """[V, C] inference-mode logits in ORIGINAL vertex order.
+        The per-shard logits are all_gathered to a replicated result
+        before the fetch, so this works on multi-process meshes too
+        (a P('parts')-sharded device_get would touch non-addressable
+        shards there)."""
+        if self._predict_step is None:
+            self._predict_step = self._build_predict_step()
+        d = self.data
+        logits = jax.device_get(self._predict_step(
+            self.params, d.feats, d.edge_src, d.edge_dst, d.in_degree,
+            d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
+            d.sect_idx, d.sect_sub_dst))
+        return unpad_nodes(logits, self.pg)
+
+    def _build_predict_step(self):
+        mesh = self.mesh
+        spec_p = P("parts")
+        spec_r = P()
+
+        def step(params, feats, *graph_args):
+            logits = self._local_forward(params, feats, *graph_args)
+            # replicated [P, part_nodes, C]
+            return lax.all_gather(logits, "parts", axis=0)
+
+        sm = jax.shard_map(
+            step, mesh=mesh,
+            in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
+                      spec_p, spec_p, spec_p, spec_p, spec_p),
+            out_specs=spec_r, check_vma=False)
+        return jax.jit(sm)

@@ -52,6 +52,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="attention heads for --model gat (hidden "
                          "dims must divide by it; output layer stays "
                          "single-head)")
+    ap.add_argument("--learn-eps", action="store_true",
+                    help="for --model gin: learnable per-layer "
+                         "epsilon self-weight (zero-init GIN-0) "
+                         "instead of the fixed self-add")
     ap.add_argument("--parts", type=int, default=1,
                     help="graph partitions == mesh devices (the "
                          "reference's numMachines*numGPUs)")
@@ -98,6 +102,10 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "every-5th-epoch infer, gnn.cc:107-110, as a "
                          "standalone step — typically with --resume) "
                          "and exit")
+    ap.add_argument("--save-logits", type=str, default=None,
+                    help="write the [V, C] inference logits here "
+                         "(.npy, float32, ORIGINAL vertex order even "
+                         "under --reorder) after training/eval")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend")
     ap.add_argument("--no-compile-cache", action="store_true",
@@ -144,6 +152,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: --heads applies to --model gat only",
               file=sys.stderr)
         return 2
+    if args.learn_eps and args.model != "gin":
+        print("error: --learn-eps applies to --model gin only",
+              file=sys.stderr)
+        return 2
     if args.model == "gat":
         if args.heads < 1:
             print("error: --heads must be >= 1", file=sys.stderr)
@@ -160,10 +172,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         ds = synthetic_dataset(512, 8, in_dim=layers[0],
                                num_classes=layers[-1], seed=args.seed)
+    perm = None
     if args.reorder == "bfs":
         from ..core.reorder import apply_vertex_order, bfs_order
         t0 = time.time()
-        ds, _ = apply_vertex_order(ds, bfs_order(ds.graph))
+        ds, perm = apply_vertex_order(ds, bfs_order(ds.graph))
         print(f"# reorder=bfs applied in {time.time() - t0:.1f}s",
               file=sys.stderr)
     # config echo, like gnn.cc:48-60
@@ -176,6 +189,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
              "gat": build_gat}
     kwargs = {"heads": args.heads} if args.model == "gat" else {}
+    if args.model == "gin" and args.learn_eps:
+        kwargs["learn_eps"] = True
     model = build[args.model](layers, dropout_rate=args.dropout,
                               **kwargs)
     dt, cdt = resolve_dtypes(args.dtype)
@@ -207,10 +222,26 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"# resumed from {args.resume} at epoch {trainer.epoch}",
               file=sys.stderr)
 
+    def save_logits():
+        if not args.save_logits:
+            return
+        import numpy as np
+        logits = np.asarray(trainer.predict(), dtype=np.float32)
+        if perm is not None:
+            # rows are in reordered coordinates; new row i holds old
+            # vertex perm[i] — scatter back to original order
+            out = np.empty_like(logits)
+            out[perm] = logits
+            logits = out
+        np.save(args.save_logits, logits)
+        print(f"# logits [{logits.shape[0]}, {logits.shape[1]}] "
+              f"saved to {args.save_logits}", file=sys.stderr)
+
     if args.eval_only:
         from .trainer import format_metrics
         m = trainer.evaluate()
         print(format_metrics(trainer.epoch, m))
+        save_logits()
         return 0
 
     if args.profile_dir:
@@ -236,6 +267,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.checkpoint:
         checkpoint_trainer(trainer, args.checkpoint)
         print(f"# checkpoint saved to {args.checkpoint}", file=sys.stderr)
+    save_logits()
     return 0
 
 

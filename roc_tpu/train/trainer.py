@@ -283,6 +283,7 @@ class Trainer:
         self.opt_state = adam_init(self.params)
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
         self._head = None
+        self._tail_predict = None
         if config.features == "host":
             # host-resident features streamed through the first layer
             # (the reference's ZC tier, types.cu:22-32)
@@ -322,6 +323,7 @@ class Trainer:
         self._train_step = jax.jit(self._train_step_impl,
                                    donate_argnums=(0, 1))
         self._eval_step = jax.jit(self._eval_step_impl)
+        self._predict_step = jax.jit(self._predict_impl)
         from ..utils.profiling import EpochTimer, MetricsLog
         self.timer = EpochTimer()
         self.metrics_log = MetricsLog(config.metrics_path)
@@ -350,6 +352,10 @@ class Trainer:
         logits = self.model.apply(cast_floats(params, self.compute),
                                   feats, gctx, key=None, train=False)
         return perf_metrics(logits, labels, mask)
+
+    def _predict_impl(self, params, feats, gctx):
+        return self.model.apply(cast_floats(params, self.compute),
+                                feats, gctx, key=None, train=False)
 
     # ---- host-feature streaming path (config.features == "host") ----
 
@@ -413,6 +419,22 @@ class Trainer:
         synchronize under the axon TPU relay (utils/profiling.py)."""
         from ..utils.profiling import sync
         sync(self.params)
+
+    def predict(self) -> jax.Array:
+        """[V, C] inference-mode logits (the tensor the reference only
+        ever reduces to metrics, softmax_kernel.cu:41-79 — exposed so
+        a user can export predictions).  Jitted — the eager interpreter
+        would hold every intermediate activation alive."""
+        if self._head is not None:
+            w0 = self.params[self._head_param].astype(self.compute)
+            y = self._head.forward(w0, self.feats_host, None, False)
+            if self._tail_predict is None:
+                self._tail_predict = jax.jit(
+                    lambda p, yy, g: self._tail_model.apply(
+                        cast_floats(p, self.compute), yy, g,
+                        key=None, train=False))
+            return self._tail_predict(self.params, y, self.gctx)
+        return self._predict_step(self.params, self.feats, self.gctx)
 
     def evaluate(self) -> Dict[str, float]:
         if self._head is not None:

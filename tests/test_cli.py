@@ -41,10 +41,81 @@ def test_checkpoint_resume_eval_only(tmp_path, capsys):
     (["--model", "gat", "--heads", "3", "-layers", "8-8-3"],
      "divisible"),
     (["--halo", "ring", "-layers", "8-8-3"], "--parts"),
+    (["--model", "gcn", "--learn-eps", "-layers", "8-8-3"],
+     "--learn-eps applies"),
 ])
 def test_flag_validation_fails_fast(argv, msg, capsys):
     assert _run(argv) == 2
     assert msg in capsys.readouterr().err
+
+
+def test_save_logits_matches_metrics(tmp_path, capsys):
+    """--save-logits writes [V, C] fp32 whose argmax reproduces the
+    printed test accuracy — i.e. the export really is the final
+    model's inference output."""
+    import re
+    path = str(tmp_path / "lg.npy")
+    rc = _run(["-e", "3", "-layers", "8-8-3", "--impl", "ell",
+               "--eval-every", "3", "--save-logits", path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    printed = re.findall(r"test_accuracy: [\d.]+%\((\d+)/(\d+)\)", out)
+    assert printed, out
+    correct, cnt = map(int, printed[-1])
+    logits = np.load(path)
+    assert logits.shape[1] == 3 and logits.dtype == np.float32
+    # recompute test accuracy from the exported logits
+    from roc_tpu.core.graph import MASK_TEST, synthetic_dataset
+    ds = synthetic_dataset(512, 8, in_dim=8, num_classes=3, seed=1)
+    sel = ds.mask == MASK_TEST
+    got_correct = int((np.argmax(logits[sel], axis=1)
+                       == ds.labels[sel]).sum())
+    assert (got_correct, int(sel.sum())) == (correct, cnt)
+
+
+def test_save_logits_reorder_inverts_to_original_order(tmp_path):
+    """The same (seeded) run with and without --reorder bfs must save
+    logits for the same vertices in the same ORIGINAL order — the
+    permutation round-trips."""
+    outs = {}
+    for tag, extra in (("plain", []), ("bfs", ["--reorder", "bfs"])):
+        path = str(tmp_path / f"{tag}.npy")
+        rc = _run(["-e", "4", "-layers", "8-8-3", "--impl", "ell",
+                   "-dropout", "0.0", "--eval-every", "1000",
+                   "--save-logits", path] + extra)
+        assert rc == 0
+        outs[tag] = np.load(path)
+    # identical params (graph-independent init) + relabeling-invariant
+    # math => logits match vertex-for-vertex up to fp association
+    np.testing.assert_allclose(outs["plain"], outs["bfs"],
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_distributed_predict_matches_single():
+    """DistributedTrainer.predict returns original-order logits equal
+    to the single-device forward for the same params."""
+    import jax
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+    ds = synthetic_dataset(128, 6, in_dim=8, num_classes=3, seed=4)
+    model = build_gcn([8, 8, 3], dropout_rate=0.0)
+    cfg = TrainConfig(aggr_impl="ell", verbose=False, chunk=64,
+                      eval_every=1 << 30)
+    dt = DistributedTrainer(model, ds, 4, cfg)
+    tr = Trainer(model, ds, cfg)
+    tr.params = jax.device_get(dt.params)
+    np.testing.assert_allclose(np.asarray(dt.predict()),
+                               np.asarray(tr.predict()),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gin_learn_eps_cli(capsys):
+    rc = _run(["-e", "2", "-layers", "8-8-3", "--model", "gin",
+               "--learn-eps", "--impl", "ell", "--eval-every", "2"])
+    assert rc == 0
+    assert "[INFER]" in capsys.readouterr().out
 
 
 def test_gat_mixed_distributed(capsys):
