@@ -80,6 +80,25 @@ def test_local_ell_plan_matches_global_on_full_part():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+def test_predict_on_local_shards():
+    """predict() (replicated all_gather output) returns the same
+    original-order logits from partition-local shards as from the
+    global build."""
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=7)
+    mesh = mh.make_parts_mesh(4)
+    cfg = TrainConfig(verbose=False, aggr_impl="ell", symmetric=True)
+    tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
+                            ds, 4, cfg, mesh=mesh)
+    want = tr.predict()
+    assert want.shape == (96, 3)
+    tr.data = mh.shard_dataset_local(ds, tr.pg, mesh, aggr_impl="ell")
+    np.testing.assert_allclose(tr.predict(), want, rtol=1e-5)
+
+
 def test_gat_trains_on_local_shards():
     """Attention over partition-local ELL tables: the multihost
     row_id upload must feed the edge softmax identically to the
