@@ -460,7 +460,11 @@ def parent(args, argv) -> int:
                     min(eff_timeout,
                         remaining() - 20 - _TERM_GRACE), argv)
                 if rec.get("ok") or \
-                        remaining() - 20 - _TERM_GRACE < 40 + delay:
+                        remaining() - 20 - _TERM_GRACE < 40 + delay \
+                        or attempt == args.probe_retries:
+                    # no backoff sleep after the LAST attempt — there
+                    # is nothing left to retry (observed: a wedged
+                    # tunnel burned a full 240s sleep at loop exit)
                     break
                 print(f"# probe retry in {delay:.0f}s", file=sys.stderr)
                 time.sleep(min(delay, max(remaining() - 60, 0)))
