@@ -62,9 +62,27 @@ def test_depleted_deadline_degrades_to_skip(tmp_path):
 
 
 @pytest.mark.slow
-def test_dtype_suffix_keeps_metrics_separate(tmp_path):
+def test_headline_metric_unsuffixed_with_dtype_field(tmp_path):
+    """Non-fp32 runs keep the unsuffixed headline metric name but must
+    carry an explicit dtype field (a precision-policy speedup is never
+    a hidden claim)."""
     r = _run(["--cpu", "--stages", "small", "--epochs", "2",
               "--dtype", "mixed"], art_dir=str(tmp_path))
     assert r.returncode == 0, r.stderr[-2000:]
     line = _last_json(r.stdout)
-    assert line["metric"].endswith("_mixed")
+    assert not line["metric"].endswith("_mixed")
+    assert line["dtype"] == "mixed"
+
+
+@pytest.mark.slow
+def test_random_label_accuracy_is_labeled(tmp_path):
+    """The synthetic-graph accuracies are not a quality signal and the
+    stage record must say so (VERDICT r3 weak #4)."""
+    r = _run(["--cpu", "--stages", "small", "--epochs", "2"],
+             art_dir=str(tmp_path), timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = _last_json(r.stdout)
+    small = line["stages"]["small"]
+    assert small.get("labels") == "synthetic_random"
+    assert "train_acc" not in small  # only the labeled keys remain
+    assert "random_label_train_acc" in small
