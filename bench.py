@@ -199,11 +199,24 @@ def _ancestors_and_self() -> set:
     return pids
 
 
+_STALE_MIN_AGE_S = 120.0
+
+
+def _pid_age_s(pid: int) -> float:
+    try:
+        return time.time() - os.stat(f"/proc/{pid}").st_mtime
+    except OSError:
+        return 0.0
+
+
 def _reap_stale_tpu_processes(grace: float = None) -> list:
     """SIGTERM (then SIGKILL) stale processes that could hold the TPU
     tunnel claim, so the probe never queues behind this session's own
     corpses.  Matches known claim-holding command patterns plus
-    anonymous ``python -`` probes writing to tpu_watch logs.  Returns
+    anonymous ``python -`` probes writing to tpu_watch logs; processes
+    younger than ``_STALE_MIN_AGE_S`` or explicitly ``--cpu`` are
+    spared (a just-launched deliberate run is not a corpse — the stale
+    failure mode is watchers/corpses from EARLIER sessions).  Returns
     ``[{pid, cmd}]`` for the stage record."""
     if grace is None:
         grace = _TERM_GRACE  # same claim-unwind budget as stage children
@@ -225,7 +238,7 @@ def _reap_stale_tpu_processes(grace: float = None) -> list:
                     .decode("utf-8", "replace").strip()
         except OSError:
             continue
-        if not cmd:
+        if not cmd or "--cpu" in cmd.split():
             continue
         head = cmd.split()[0].rsplit("/", 1)[-1]
         # only interpreter/launcher processes are candidates: an editor
@@ -242,7 +255,7 @@ def _reap_stale_tpu_processes(grace: float = None) -> list:
                 stale = "tpu_watch" in os.readlink(f"/proc/{pid}/fd/1")
             except OSError:
                 stale = False
-        if stale:
+        if stale and _pid_age_s(pid) >= _STALE_MIN_AGE_S:
             victims.append({"pid": pid, "cmd": cmd[:160]})
     for v in victims:
         try:
@@ -419,15 +432,18 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
     from roc_tpu.train.trainer import TrainConfig, Trainer
 
     layers = [int(x) for x in args.layers.split("-")]
-    if args.impl == "auto":
-        # resolve here so the recorded baseline names the kernel that
-        # actually ran, not the CLI alias
-        from roc_tpu.core.ell import resolve_auto_impl
-        args.impl = resolve_auto_impl(nodes)
     t0 = time.time()
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} {dev.device_kind} "
           f"(claim {time.time() - t0:.1f}s)", file=sys.stderr)
+    if args.impl == "auto":
+        # resolve here so the recorded baseline names the kernel that
+        # actually ran, not the CLI alias.  AFTER the claim above:
+        # sectioned_bounds consults the backend's device_kind, and the
+        # backend claim must stay the explicitly timed step (wedge
+        # diagnosis reads that number)
+        from roc_tpu.core.ell import resolve_auto_impl
+        args.impl = resolve_auto_impl(nodes)
 
     t0 = time.time()
     graph = random_csr(nodes, edges, seed=0)

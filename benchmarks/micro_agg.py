@@ -48,11 +48,23 @@ def main():
                     default="ell,pallas,scan:2048,scan:4096,blocked:1024")
     ap.add_argument("--seg-rows", type=int, default=131_072,
                     help="sectioned carry-scan chunk size (sub-rows)")
+    ap.add_argument("--graph", type=str, default="random",
+                    help="random | planted[:COMMUNITY_ROWS] (community "
+                         "structure with shuffled ids) | "
+                         "skew[:A] (hub sources, u**(1+A) mapping)")
+    ap.add_argument("--reorder", type=str, default="none",
+                    help="none | bfs — relabel vertices before table "
+                         "build (core/reorder.py)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (the env var alone is "
+                         "overridden by the axon sitecustomize)")
     args = ap.parse_args()
 
     import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    from roc_tpu.core.graph import random_csr
+    from roc_tpu.core.graph import planted_community_csr, random_csr
     from roc_tpu.core.partition import padded_edge_list
     from roc_tpu.ops.aggregate import aggregate, aggregate_ell
 
@@ -64,7 +76,28 @@ def main():
     f0 = jax.jit(lambda x: x + 1.0)
     print(f"# sync overhead ~{bench(lambda: f0(z), args.iters):.1f} ms "
           f"(subtract from rows below)")
-    g = random_csr(V, E, seed=0)
+    gspec = args.graph.split(":")
+    if gspec[0] == "random":
+        g = random_csr(V, E, seed=0)
+    elif gspec[0] == "planted":
+        rows = int(gspec[1]) if len(gspec) > 1 else 65_536
+        g = planted_community_csr(V, E, community_rows=rows, seed=0)
+    elif gspec[0] == "skew":
+        a = float(gspec[1]) if len(gspec) > 1 else 3.0
+        # one community spanning the whole graph + skewed member pick
+        # = globally hub-skewed sources
+        g = planted_community_csr(V, E, community_rows=V,
+                                  intra_frac=1.0, seed=0,
+                                  shuffle=False, src_skew=a)
+    else:
+        raise SystemExit(f"unknown --graph {args.graph!r}")
+    if args.reorder == "bfs":
+        from roc_tpu.core.reorder import apply_graph_order, bfs_order
+        t0 = time.time()
+        g = apply_graph_order(g, bfs_order(g))
+        print(f"# bfs reorder: {time.time() - t0:.1f}s")
+    elif args.reorder != "none":
+        raise SystemExit(f"unknown --reorder {args.reorder!r}")
     dtype = getattr(jnp, args.dtype)
     feats_np = np.random.RandomState(0).rand(V + 1, F).astype(np.float32)
     feats_np[-1] = 0
@@ -114,6 +147,92 @@ def main():
             ms = bench(lambda: f(feats, sidx, sdst), args.iters)
             print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
                   f"(prep {prep:.1f}s)")
+            continue
+        if impl in ("sectw", "sectu16", "sectsplit"):
+            # sectioned-layout variants (VERDICT r4 gather levers):
+            #   sectw:W     sub-row width W instead of 8
+            #   sectu16     uint16 section-local indices (section_rows
+            #               65,535 so the dummy id fits)
+            #   sectsplit   W independent [N]-index gathers instead of
+            #               the [N, W] block gather
+            from roc_tpu.core.ell import (SECTION_ROWS_DEFAULT,
+                                          sectioned_from_graph)
+            from roc_tpu.ops.aggregate import (aggregate_ell_sect,
+                                               aggregate_ell_sect_split)
+            sub_w = chunk if impl == "sectw" and ":" in spec else 8
+            sec_rows = (65_535 if impl == "sectu16"
+                        else SECTION_ROWS_DEFAULT)
+            t0 = time.time()
+            sect = sectioned_from_graph(g.row_ptr, g.col_idx, V,
+                                        section_rows=sec_rows,
+                                        seg_rows=args.seg_rows,
+                                        sub_w=sub_w)
+            if impl == "sectu16":
+                sect = sect.with_idx_dtype(np.uint16)
+            prep = time.time() - t0
+            sidx, sdst, meta = sect.as_jax()
+            agg = (aggregate_ell_sect_split if impl == "sectsplit"
+                   else aggregate_ell_sect)
+            f = jax.jit(lambda x, i, d, a=agg: a(x, i, d, meta, V))
+            try:
+                ms = bench(lambda: f(feats, sidx, sdst), args.iters)
+                print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
+                      f"(prep {prep:.1f}s, "
+                      f"{sect.padded_edges/1e6:.1f}M slots)")
+            except Exception as e:  # noqa: BLE001 - report and continue
+                print(f"{spec:16s} FAILED: {type(e).__name__}: {e}")
+            continue
+        if impl == "hub":
+            # hub-split: top-K most referenced sources aggregated as a
+            # dense [V, K] count-matrix matmul on the MXU; the residual
+            # (non-hub) edges through the sectioned gather.  Pays off
+            # only on source-skewed graphs (--graph skew / real
+            # power-law data); uniform sources put ~K/V of the edge
+            # mass on the hubs.
+            K = chunk if ":" in spec else 4096
+            from roc_tpu.core.ell import sectioned_from_graph
+            from roc_tpu.ops.aggregate import aggregate_ell_sect
+            t0 = time.time()
+            freq = np.bincount(g.col_idx, minlength=V)
+            hubs = np.argsort(-freq)[:K].astype(np.int64)
+            cover = float(freq[hubs].sum()) / E
+            is_hub = np.zeros(V, dtype=bool)
+            is_hub[hubs] = True
+            hub_rank = np.zeros(V, dtype=np.int64)
+            hub_rank[hubs] = np.arange(K)
+            deg = np.diff(g.row_ptr)
+            dst_all = np.repeat(np.arange(V, dtype=np.int64), deg)
+            hub_sel = is_hub[g.col_idx]
+            M = np.zeros((V, K), dtype=np.float32)
+            np.add.at(M, (dst_all[hub_sel],
+                          hub_rank[g.col_idx[hub_sel]]), 1.0)
+            rest_col = g.col_idx[~hub_sel]
+            rest_dst = dst_all[~hub_sel]
+            rest_ptr = np.zeros(V + 1, dtype=np.int64)
+            np.cumsum(np.bincount(rest_dst, minlength=V),
+                      out=rest_ptr[1:])
+            sect = sectioned_from_graph(rest_ptr, rest_col, V,
+                                        seg_rows=args.seg_rows)
+            prep = time.time() - t0
+            sidx, sdst, meta = sect.as_jax()
+            Mj = jnp.asarray(M, dtype=feats.dtype)
+            hubj = jnp.asarray(hubs)
+
+            def hub_agg(x, Mx, i, d):
+                import jax as _jax
+                dense = _jax.lax.dot_general(
+                    Mx, x[hubj], (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32).astype(x.dtype)
+                return dense + aggregate_ell_sect(x, i, d, meta, V)
+
+            f = jax.jit(hub_agg)
+            try:
+                ms = bench(lambda: f(feats, Mj, sidx, sdst), args.iters)
+                print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
+                      f"(prep {prep:.1f}s, hub coverage "
+                      f"{cover*100:.1f}% of E)")
+            except Exception as e:  # noqa: BLE001 - report and continue
+                print(f"{spec:16s} FAILED: {type(e).__name__}: {e}")
             continue
         if impl == "ell":
             (idx, pos), prep = get_ell()

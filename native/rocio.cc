@@ -42,6 +42,12 @@ struct FileCloser {
 
 extern "C" {
 
+// Bumped on every C-ABI signature change; roc_tpu/native.py refuses a
+// library whose version does not match (a stale/pinned .so called with
+// new argtypes would read a pointer as an int — SIGSEGV or garbage).
+// v2: sub_w parameter inserted into roc_sectioned_counts/_fill.
+int roc_abi_version(void) { return 2; }
+
 // ---------------------------------------------------------------------------
 // .lux binary format: u32 num_nodes, u64 num_edges, num_nodes x u64
 // inclusive-end row offsets, num_edges x u32 source ids (dst-sorted CSR).
@@ -470,7 +476,9 @@ int roc_ell_widths(const int64_t* row_ptr, int64_t num_rows,
 
 int roc_sectioned_counts(const int64_t* row_ptr, const int32_t* col,
                          int64_t num_rows, int64_t section_rows,
-                         int64_t n_sec, int64_t* counts) {
+                         int64_t n_sec, int64_t sub_w,
+                         int64_t* counts) {
+  if (sub_w <= 0) return kErrValue;
   std::vector<int64_t> local(static_cast<size_t>(n_sec));
   for (int64_t s = 0; s < n_sec; ++s) counts[s] = 0;
   for (int64_t v = 0; v < num_rows; ++v) {
@@ -481,7 +489,7 @@ int roc_sectioned_counts(const int64_t* row_ptr, const int32_t* col,
       local[static_cast<size_t>(s)] += 1;
     }
     for (int64_t s = 0; s < n_sec; ++s) {
-      counts[s] += (local[static_cast<size_t>(s)] + 7) / 8;
+      counts[s] += (local[static_cast<size_t>(s)] + sub_w - 1) / sub_w;
     }
   }
   return kOk;
@@ -490,15 +498,17 @@ int roc_sectioned_counts(const int64_t* row_ptr, const int32_t* col,
 // sec_sizes[s]: the section's row count == its local dummy id.
 // slots[s]: allocated sub-rows per section (chunk plan * seg_rows);
 // must be >= the counts pass's result or kErrValue is returned.
-// idx_flat: [sum(slots) * 8] int32; sub_dst_flat: [sum(slots)] int32.
+// idx_flat: [sum(slots) * sub_w] int32; sub_dst_flat: [sum(slots)] int32.
 // Sub-rows are emitted in ascending dst order per section (matching
 // the numpy builder exactly); leftover slots become padding sub-rows
 // (idx = section dummy, sub_dst = num_rows).
 int roc_sectioned_fill(const int64_t* row_ptr, const int32_t* col,
                        int64_t num_rows, int64_t section_rows,
-                       int64_t n_sec, const int64_t* sec_sizes,
+                       int64_t n_sec, int64_t sub_w,
+                       const int64_t* sec_sizes,
                        const int64_t* slots, int32_t* idx_flat,
                        int32_t* sub_dst_flat) {
+  if (sub_w <= 0) return kErrValue;
   std::vector<int64_t> cursor(static_cast<size_t>(n_sec));
   std::vector<int64_t> limit(static_cast<size_t>(n_sec));
   int64_t off = 0;
@@ -518,13 +528,14 @@ int roc_sectioned_fill(const int64_t* row_ptr, const int32_t* col,
     for (int64_t s = 0; s < n_sec; ++s) {
       std::vector<int32_t>& b = buf[static_cast<size_t>(s)];
       if (b.empty()) continue;
-      int64_t nsub = (static_cast<int64_t>(b.size()) + 7) / 8;
+      int64_t nsub =
+          (static_cast<int64_t>(b.size()) + sub_w - 1) / sub_w;
       if (cursor[static_cast<size_t>(s)] + nsub >
           limit[static_cast<size_t>(s)]) {
         return kErrValue;  // plan smaller than the counts pass said
       }
-      int64_t base = cursor[static_cast<size_t>(s)] * 8;
-      for (int64_t k = 0; k < nsub * 8; ++k) {
+      int64_t base = cursor[static_cast<size_t>(s)] * sub_w;
+      for (int64_t k = 0; k < nsub * sub_w; ++k) {
         idx_flat[base + k] =
             k < static_cast<int64_t>(b.size())
                 ? b[static_cast<size_t>(k)]
@@ -541,8 +552,9 @@ int roc_sectioned_fill(const int64_t* row_ptr, const int32_t* col,
   for (int64_t s = 0; s < n_sec; ++s) {
     for (int64_t slot = cursor[static_cast<size_t>(s)];
          slot < limit[static_cast<size_t>(s)]; ++slot) {
-      for (int64_t k = 0; k < 8; ++k) {
-        idx_flat[slot * 8 + k] = static_cast<int32_t>(sec_sizes[s]);
+      for (int64_t k = 0; k < sub_w; ++k) {
+        idx_flat[slot * sub_w + k] =
+            static_cast<int32_t>(sec_sizes[s]);
       }
       sub_dst_flat[slot] = static_cast<int32_t>(num_rows);
     }

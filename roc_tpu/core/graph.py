@@ -446,20 +446,77 @@ def random_csr(num_nodes: int, num_edges: int, seed: int = 0,
     assert num_edges >= num_nodes, "need >= 1 edge per node (self edges)"
     rng = np.random.RandomState(seed)
     if power_law:
-        raw = rng.lognormal(mean=0.0, sigma=1.25, size=num_nodes)
+        deg = _lognormal_degree_sequence(num_nodes, num_edges, rng)
     else:
         raw = np.ones(num_nodes) + rng.rand(num_nodes) * 0.1
-    extra = num_edges - num_nodes
-    deg = 1 + np.floor(raw / raw.sum() * extra).astype(np.int64)
-    # distribute the rounding remainder over random vertices
-    short = num_edges - int(deg.sum())
-    if short > 0:
-        idx = rng.randint(0, num_nodes, size=short)
-        np.add.at(deg, idx, 1)
+        extra = num_edges - num_nodes
+        deg = 1 + np.floor(raw / raw.sum() * extra).astype(np.int64)
+        short = num_edges - int(deg.sum())
+        if short > 0:
+            np.add.at(deg, rng.randint(0, num_nodes, size=short), 1)
     row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
     np.cumsum(deg, out=row_ptr[1:])
     col_idx = rng.randint(0, num_nodes, size=num_edges, dtype=np.int64)
     return Graph(row_ptr=row_ptr, col_idx=col_idx.astype(np.int32))
+
+
+def _lognormal_degree_sequence(num_nodes: int, num_edges: int,
+                               rng) -> np.ndarray:
+    """In-degree sequence summing to ``num_edges`` with every degree
+    >= 1 (self-edge convention), lognormal-skewed like real social
+    graphs — shared by the benchmark-scale generators."""
+    raw = rng.lognormal(mean=0.0, sigma=1.25, size=num_nodes)
+    extra = num_edges - num_nodes
+    deg = 1 + np.floor(raw / raw.sum() * extra).astype(np.int64)
+    short = num_edges - int(deg.sum())
+    if short > 0:
+        np.add.at(deg, rng.randint(0, num_nodes, size=short), 1)
+    return deg
+
+
+def planted_community_csr(num_nodes: int, num_edges: int,
+                          community_rows: int = 65_536,
+                          intra_frac: float = 0.8, seed: int = 0,
+                          shuffle: bool = True,
+                          src_skew: float = 0.0) -> Graph:
+    """Benchmark-scale dst-major CSR with PLANTED community structure:
+    each edge's source lands in its destination's community block with
+    probability ``intra_frac``, uniformly elsewhere otherwise.  With
+    ``shuffle=True`` vertex ids are randomly relabeled afterwards —
+    the worst case for locality, which a reordering pass
+    (core/reorder.py bfs_order) should be able to recover.
+    ``src_skew`` > 0 additionally skews WHICH community member is
+    picked (u**(1+src_skew) mapping), modelling hub sources.  Same
+    lognormal in-degree sequence as :func:`random_csr`.  Not
+    symmetric — timing use only."""
+    assert num_edges >= num_nodes
+    rng = np.random.RandomState(seed)
+    deg = _lognormal_degree_sequence(num_nodes, num_edges, rng)
+    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_ptr[1:])
+    dst_all = np.repeat(np.arange(num_nodes, dtype=np.int64), deg)
+    com_of = dst_all // community_rows
+    com_lo = com_of * community_rows
+    com_hi = np.minimum(com_lo + community_rows, num_nodes)
+    u = rng.rand(num_edges)
+    if src_skew > 0.0:
+        u = u ** (1.0 + src_skew)
+    local = com_lo + np.floor(u * (com_hi - com_lo)).astype(np.int64)
+    anywhere = rng.randint(0, num_nodes, size=num_edges)
+    intra = rng.rand(num_edges) < intra_frac
+    col = np.where(intra, local, anywhere)
+    if shuffle:
+        relabel = rng.permutation(num_nodes).astype(np.int64)
+        col = relabel[col]
+        # destinations relabel too: re-sort edges by new dst
+        new_dst = relabel[dst_all]
+        order = np.argsort(new_dst, kind="stable")
+        col = col[order]
+        new_deg = np.bincount(new_dst, minlength=num_nodes)
+        row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+        np.cumsum(new_deg, out=row_ptr[1:])
+    del anywhere, local, u, com_of, com_lo, com_hi, dst_all
+    return Graph(row_ptr=row_ptr, col_idx=col.astype(np.int32))
 
 
 def synthetic_graph(num_nodes: int, avg_degree: int, seed: int = 0,

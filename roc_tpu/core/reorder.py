@@ -81,6 +81,29 @@ def bfs_order(graph: Graph) -> np.ndarray:
     return out
 
 
+def apply_graph_order(graph: Graph, perm: np.ndarray) -> Graph:
+    """CSR with vertices relabeled so ``new_id = rank(old_id)``
+    (``perm[new_id] == old_id``); per-row neighbor lists re-sorted
+    ascending, preserving the loaders' monotone-CSR convention."""
+    V = graph.num_nodes
+    perm = np.asarray(perm, dtype=np.int64)
+    assert perm.shape == (V,)
+    rank = np.empty(V, dtype=np.int64)
+    rank[perm] = np.arange(V, dtype=np.int64)
+    deg = np.diff(graph.row_ptr)
+    new_deg = deg[perm]
+    new_row_ptr = np.zeros(V + 1, dtype=np.int64)
+    np.cumsum(new_deg, out=new_row_ptr[1:])
+    # vectorized edge relabel: sort all edges by (new dst, new src) —
+    # one lexsort instead of a V-iteration Python loop
+    old_dst = np.repeat(np.arange(V, dtype=np.int64), deg)
+    new_dst = rank[old_dst]
+    new_src = rank[graph.col_idx.astype(np.int64)]
+    order = np.lexsort((new_src, new_dst))
+    new_col = new_src[order].astype(np.int32)
+    return Graph(row_ptr=new_row_ptr, col_idx=new_col)
+
+
 def apply_vertex_order(dataset: Dataset,
                        perm: np.ndarray) -> Tuple[Dataset, np.ndarray]:
     """Dataset with vertices relabeled so ``new_id = rank(old_id)``.
@@ -90,28 +113,8 @@ def apply_vertex_order(dataset: Dataset,
     original corresponds to row ``i`` of the result, so original-order
     logits are ``new_logits[inv]`` with ``inv = argsort(perm)``...
     i.e. ``orig_logits = new_logits[rank]`` where ``rank[old] = new``.
-    Per-row neighbor lists are re-sorted ascending, preserving the
-    loaders' monotone-CSR convention.
     """
-    g = dataset.graph
-    V = g.num_nodes
-    perm = np.asarray(perm, dtype=np.int64)
-    assert perm.shape == (V,)
-    rank = np.empty(V, dtype=np.int64)
-    rank[perm] = np.arange(V, dtype=np.int64)
-
-    deg = np.diff(g.row_ptr)
-    new_deg = deg[perm]
-    new_row_ptr = np.zeros(V + 1, dtype=np.int64)
-    np.cumsum(new_deg, out=new_row_ptr[1:])
-    # vectorized edge relabel: sort all edges by (new dst, new src) —
-    # one lexsort instead of a V-iteration Python loop
-    old_dst = np.repeat(np.arange(V, dtype=np.int64), deg)
-    new_dst = rank[old_dst]
-    new_src = rank[g.col_idx.astype(np.int64)]
-    order = np.lexsort((new_src, new_dst))
-    new_col = new_src[order].astype(np.int32)
-    new_graph = Graph(row_ptr=new_row_ptr, col_idx=new_col)
+    new_graph = apply_graph_order(dataset.graph, perm)
     return Dataset(
         graph=new_graph,
         features=np.ascontiguousarray(dataset.features[perm]),
@@ -126,6 +129,8 @@ def cross_section_pairs(graph: Graph, section_rows: int) -> int:
     sectioned layout's padding driver (each pair costs >= one width-8
     sub-row).  The quantity :func:`bfs_order` exists to reduce."""
     V = graph.num_nodes
+    if graph.col_idx.size == 0:
+        return 0
     dst = np.repeat(np.arange(V, dtype=np.int64), np.diff(graph.row_ptr))
     sec = graph.col_idx.astype(np.int64) // section_rows
     return int(np.unique(dst * (sec.max() + 1) + sec).shape[0])

@@ -74,6 +74,22 @@ def _load() -> Optional[ctypes.CDLL]:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
         return None
+    # ABI gate: the argtypes below describe THIS source tree's C
+    # signatures; a stale or pinned .so from before an ABI bump would
+    # read a pointer slot as an int (SIGSEGV or silent garbage), so
+    # mismatches fall back to the numpy paths instead of loading.
+    _ABI_VERSION = 2
+    try:
+        lib.roc_abi_version.restype = ctypes.c_int
+        got = int(lib.roc_abi_version())
+    except AttributeError:
+        got = 1  # predates the version export
+    if got != _ABI_VERSION:
+        import sys
+        print(f"# librocio.so ABI v{got} != expected v{_ABI_VERSION}; "
+              f"ignoring {_LIB_PATH} (rebuild with make -C native)",
+              file=sys.stderr)
+        return None
     # Full argtypes: int64_t params must not fall back to the 32-bit
     # c_int default (graphs with > 2^31 edges are in scope for the
     # streaming tier).
@@ -101,10 +117,11 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.roc_ell_widths.restype = c.c_int
     lib.roc_ell_widths.argtypes = [i64p, i64, c.c_int32, i32p]
     lib.roc_sectioned_counts.restype = c.c_int
-    lib.roc_sectioned_counts.argtypes = [i64p, i32p, i64, i64, i64, i64p]
+    lib.roc_sectioned_counts.argtypes = [i64p, i32p, i64, i64, i64, i64,
+                                         i64p]
     lib.roc_sectioned_fill.restype = c.c_int
-    lib.roc_sectioned_fill.argtypes = [i64p, i32p, i64, i64, i64, i64p,
-                                       i64p, i32p, i32p]
+    lib.roc_sectioned_fill.argtypes = [i64p, i32p, i64, i64, i64, i64,
+                                       i64p, i64p, i32p, i32p]
     _lib = lib
     return _lib
 
@@ -226,9 +243,9 @@ def ell_widths(row_ptr: np.ndarray, min_width: int = 8) -> np.ndarray:
 
 def sectioned_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
                      num_rows: int, section_rows: int,
-                     n_sec: int) -> np.ndarray:
-    """Per-section width-8 sub-row totals (core/ell.py sectioned prep,
-    counts pass)."""
+                     n_sec: int, sub_w: int = 8) -> np.ndarray:
+    """Per-section width-``sub_w`` sub-row totals (core/ell.py
+    sectioned prep, counts pass)."""
     lib = _load()
     assert lib is not None
     row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
@@ -236,7 +253,7 @@ def sectioned_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
     out = np.empty(n_sec, dtype=np.int64)
     rc = lib.roc_sectioned_counts(_i64p(row_ptr), _i32p(col_idx),
                                   num_rows, section_rows, n_sec,
-                                  _i64p(out))
+                                  sub_w, _i64p(out))
     if rc != 0:
         raise ValueError(f"roc_sectioned_counts failed: {rc}")
     return out
@@ -244,10 +261,11 @@ def sectioned_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
 
 def sectioned_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
                    num_rows: int, section_rows: int,
-                   sec_sizes: np.ndarray,
-                   slots: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Fill pass: (idx_flat [sum(slots), 8], sub_dst_flat [sum(slots)])
-    with per-section regions laid out consecutively in section order."""
+                   sec_sizes: np.ndarray, slots: np.ndarray,
+                   sub_w: int = 8) -> Tuple[np.ndarray, np.ndarray]:
+    """Fill pass: (idx_flat [sum(slots), sub_w], sub_dst_flat
+    [sum(slots)]) with per-section regions laid out consecutively in
+    section order."""
     lib = _load()
     assert lib is not None
     row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
@@ -255,12 +273,12 @@ def sectioned_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
     sec_sizes = np.ascontiguousarray(sec_sizes, dtype=np.int64)
     slots = np.ascontiguousarray(slots, dtype=np.int64)
     total = int(slots.sum())
-    idx_flat = np.empty((total, 8), dtype=np.int32)
+    idx_flat = np.empty((total, sub_w), dtype=np.int32)
     sub_dst = np.empty(total, dtype=np.int32)
     rc = lib.roc_sectioned_fill(
         _i64p(row_ptr), _i32p(col_idx), num_rows, section_rows,
-        slots.shape[0], _i64p(sec_sizes), _i64p(slots), _i32p(idx_flat),
-        _i32p(sub_dst))
+        slots.shape[0], sub_w, _i64p(sec_sizes), _i64p(slots),
+        _i32p(idx_flat), _i32p(sub_dst))
     if rc != 0:
         raise ValueError(f"roc_sectioned_fill failed: {rc}")
     return idx_flat, sub_dst

@@ -245,6 +245,33 @@ def aggregate_ell_sect(feats: jax.Array, sect_idx, sect_sub_dst,
     return out[:num_rows]
 
 
+def aggregate_ell_sect_split(feats: jax.Array, sect_idx, sect_sub_dst,
+                             sect_meta, num_rows: int) -> jax.Array:
+    """:func:`aggregate_ell_sect` with the ``[N, W]`` block gather
+    replaced by W independent ``[N]``-index row gathers summed as they
+    go — a deliberately different XLA gather lowering raced against
+    the block form in benchmarks/micro_agg.py (the block gather
+    materializes the ``[N, W, F]`` transient before its width
+    reduction; the split form keeps a single ``[N, F]`` accumulator)."""
+    F = feats.shape[1]
+    out = jnp.zeros((num_rows + 1, F), dtype=feats.dtype)
+    zero = jnp.zeros((1, F), dtype=feats.dtype)
+    for (st, sz), tbl, sdst in zip(sect_meta, sect_idx, sect_sub_dst):
+        xsec = jnp.concatenate(
+            [lax.slice(feats, (st, 0), (st + sz, F)), zero], axis=0)
+        W = tbl.shape[-1]
+
+        def body(o, ch, xsec=xsec, W=W):
+            idx_ch, dst_ch = ch
+            part = xsec[idx_ch[:, 0]]
+            for j in range(1, W):
+                part = part + xsec[idx_ch[:, j]]
+            return o.at[dst_ch].add(part, indices_are_sorted=True), None
+
+        out, _ = lax.scan(body, out, (tbl, sdst))
+    return out[:num_rows]
+
+
 def aggregate_ell_max(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,
                       num_rows: int,
                       budget_elems: int = 1 << 24) -> jax.Array:

@@ -161,10 +161,17 @@ def resolve_attention_impl(model, config: TrainConfig) -> TrainConfig:
             "needed per row); use halo='gather'")
     if config.aggr_impl in ("ell", "pallas"):
         return config
-    if config.verbose:
-        import sys
-        print(f"# aggr_impl={config.aggr_impl!r} -> 'ell' "
-              f"({why} model needs the ELL tables)", file=sys.stderr)
+    if why == "MAX/MIN aggregation" and config.aggr_impl == "segment":
+        # _max_fwd has a real segment path (jax.ops.segment_max) — an
+        # explicitly requested 'segment' must not be silently
+        # overridden (ADVICE r3); only the chunked-sum impls
+        # (blocked/scan/pallas_csr/sectioned) lack a MAX form
+        return config
+    # echo unconditionally: this changes user-selected behavior, so it
+    # must never be silent (ADVICE r3)
+    import sys
+    print(f"# aggr_impl={config.aggr_impl!r} -> 'ell' "
+          f"({why} model needs the ELL tables)", file=sys.stderr)
     import dataclasses
     return dataclasses.replace(config, aggr_impl="ell")
 

@@ -99,3 +99,22 @@ def test_spmm_pallas_interpret_small():
                               jnp.asarray(dst), V, chunk=64)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_resolve_auto_impl_generation_keyed():
+    """The sectioned window is keyed on device_kind: calibrated kinds
+    use their measured bounds, unknown kinds fall back to v5e values
+    (loudly, once) instead of silently mis-picking (VERDICT r3)."""
+    from roc_tpu.core import ell
+    assert ell.resolve_auto_impl(233_000,
+                                 device_kind="TPU v5 lite") == "sectioned"
+    assert ell.resolve_auto_impl(50_000,
+                                 device_kind="TPU v5 lite") == "ell"
+    assert ell.resolve_auto_impl(2_450_000,
+                                 device_kind="TPU v5 lite") == "ell"
+    # unknown generation: same defaults, plus a one-time echo
+    assert ell.resolve_auto_impl(233_000, device_kind="TPU v9") == \
+        ell.resolve_auto_impl(233_000, device_kind="TPU v5 lite")
+    assert "TPU v9" in ell._UNCALIBRATED_WARNED
+    assert ell.sectioned_bounds("TPU v5 lite") == \
+        (ell.SECTION_ROWS_DEFAULT, ell.SECTIONED_MAX_ROWS)

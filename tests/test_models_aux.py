@@ -240,3 +240,27 @@ def test_ell_max_budget_segmenting_exact(dataset):
     got = np.asarray(aggregate_ell_max(full, idx, pos, g.num_nodes,
                                        budget_elems=64))
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+
+def test_explicit_segment_survives_max_model_resolution(dataset):
+    """resolve_attention_impl must not override an explicitly requested
+    aggr_impl='segment' for MAX/MIN models — _max_fwd has a real
+    segment path (jax.ops.segment_max); only the chunked-sum impls are
+    rerouted (ADVICE r3)."""
+    from roc_tpu.train.trainer import resolve_attention_impl
+    model = build_sage([dataset.in_dim, 8, dataset.num_classes],
+                       dropout_rate=0.0, aggregator="pool")
+    cfg = resolve_attention_impl(
+        model, TrainConfig(aggr_impl="segment", verbose=False))
+    assert cfg.aggr_impl == "segment"
+    # the chunked-sum impls still reroute (they have no MAX form) and
+    # the override is echoed even with verbose=False
+    cfg = resolve_attention_impl(
+        model, TrainConfig(aggr_impl="sectioned", verbose=False))
+    assert cfg.aggr_impl == "ell"
+    # and the segment path actually trains end to end
+    t = Trainer(model, dataset,
+                TrainConfig(aggr_impl="segment", verbose=False,
+                            eval_every=1 << 30))
+    assert t.config.aggr_impl == "segment"
+    t.train(epochs=2)

@@ -296,3 +296,71 @@ def test_sectioned_end_to_end_training():
         np.testing.assert_allclose(np.asarray(params["segment"][k]),
                                    np.asarray(params["sectioned"][k]),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("sub_w", [8, 16, 32])
+def test_sectioned_width_variants_match_segment(sub_w):
+    """Width-parameterized sub-rows (VERDICT r4 gather levers): any
+    sub_w must be exact vs segment-sum, native and numpy builders
+    agreeing."""
+    import jax.numpy as jnp
+    from roc_tpu.core.graph import add_self_edges, synthetic_graph
+    from roc_tpu.core.ell import sectioned_from_graph
+    from roc_tpu.core.partition import padded_edge_list
+    from roc_tpu.ops.aggregate import (aggregate_ell_sect,
+                                       aggregate_segment)
+    g = add_self_edges(synthetic_graph(500, 9, seed=7, power_law=True))
+    F = 12
+    feats = np.random.RandomState(1).rand(g.num_nodes + 1, F).astype(
+        np.float32)
+    feats[-1] = 0
+    x = jnp.asarray(feats)
+    src, dst = padded_edge_list(g, multiple=64)
+    want = aggregate_segment(x, jnp.asarray(src), jnp.asarray(dst),
+                             g.num_nodes)
+    sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes,
+                                section_rows=128, seg_rows=64,
+                                sub_w=sub_w)
+    assert sect.idx[0].shape[-1] == sub_w
+    sidx, sdst, meta = sect.as_jax()
+    got = aggregate_ell_sect(x, sidx, sdst, meta, g.num_nodes)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_sectioned_uint16_and_split_gather_match():
+    """uint16 section-local indices and the split-gather lowering are
+    numerics-identical to the block-gather int32 form."""
+    import jax.numpy as jnp
+    from roc_tpu.core.graph import add_self_edges, synthetic_graph
+    from roc_tpu.core.ell import sectioned_from_graph
+    from roc_tpu.ops.aggregate import (aggregate_ell_sect,
+                                       aggregate_ell_sect_split)
+    g = add_self_edges(synthetic_graph(400, 7, seed=3, power_law=True))
+    F = 9
+    feats = np.random.RandomState(2).rand(g.num_nodes + 1, F).astype(
+        np.float32)
+    feats[-1] = 0
+    x = jnp.asarray(feats)
+    sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes,
+                                section_rows=128, seg_rows=64)
+    sidx, sdst, meta = sect.as_jax()
+    want = np.asarray(aggregate_ell_sect(x, sidx, sdst, meta,
+                                         g.num_nodes))
+    u16 = sect.with_idx_dtype(np.uint16)
+    assert all(a.dtype == np.uint16 for a in u16.idx)
+    uidx, udst, umeta = u16.as_jax()
+    got16 = np.asarray(aggregate_ell_sect(x, uidx, udst, umeta,
+                                          g.num_nodes))
+    np.testing.assert_array_equal(got16, want)
+    gots = np.asarray(aggregate_ell_sect_split(x, sidx, sdst, meta,
+                                               g.num_nodes))
+    np.testing.assert_allclose(gots, want, rtol=1e-5, atol=1e-6)
+    # a section size past the dtype's range must refuse loudly
+    import pytest as _pytest
+    big = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes,
+                               section_rows=4096, seg_rows=64)
+    if max(big.sec_sizes) <= 255:
+        _pytest.skip("graph too small to overflow uint8 sections")
+    with _pytest.raises(ValueError, match="does not fit"):
+        big.with_idx_dtype(np.uint8)

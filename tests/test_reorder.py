@@ -116,3 +116,11 @@ def test_bfs_reduces_cross_section_pairs_on_community_graph():
     new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph))
     after = cross_section_pairs(new_ds.graph, sec)
     assert after * 2 <= before, (before, after)
+
+
+def test_cross_section_pairs_empty_graph():
+    """Zero-edge graph: 0 pairs, not a ValueError from an empty-array
+    reduction (ADVICE r3)."""
+    g = Graph(row_ptr=np.zeros(6, dtype=np.int64),
+              col_idx=np.zeros(0, dtype=np.int32))
+    assert cross_section_pairs(g, 4) == 0
