@@ -571,8 +571,9 @@ def sectioned_from_padded_parts(part_row_ptr: np.ndarray,
                                 seg_rows: int = 131_072,
                                 sub_w: int = 8) -> SectionedEll:
     """Uniform stacked per-part sectioned tables for the SPMD step:
-    ``idx[s]`` is ``[P, n_chunks_s, seg_rows, 8]`` and ``sub_dst[s]``
-    ``[P, n_chunks_s, seg_rows]`` — same static shapes on every device.
+    ``idx[s]`` is ``[P, n_chunks_s, seg_rows, sub_w]`` and
+    ``sub_dst[s]`` ``[P, n_chunks_s, seg_rows]`` — same static shapes
+    on every device.
     ``seg_rows`` shrinks to fit small graphs; per-section chunk counts
     are the max over partitions (metadata pass + plan), so partitions
     with fewer edges carry padding chunks that gather the section's

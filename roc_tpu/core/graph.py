@@ -449,29 +449,33 @@ def random_csr(num_nodes: int, num_edges: int, seed: int = 0,
         deg = _lognormal_degree_sequence(num_nodes, num_edges, rng)
     else:
         raw = np.ones(num_nodes) + rng.rand(num_nodes) * 0.1
-        extra = num_edges - num_nodes
-        deg = 1 + np.floor(raw / raw.sum() * extra).astype(np.int64)
-        short = num_edges - int(deg.sum())
-        if short > 0:
-            np.add.at(deg, rng.randint(0, num_nodes, size=short), 1)
+        deg = _degree_sequence(raw, num_edges, rng)
     row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
     np.cumsum(deg, out=row_ptr[1:])
     col_idx = rng.randint(0, num_nodes, size=num_edges, dtype=np.int64)
     return Graph(row_ptr=row_ptr, col_idx=col_idx.astype(np.int32))
 
 
-def _lognormal_degree_sequence(num_nodes: int, num_edges: int,
-                               rng) -> np.ndarray:
-    """In-degree sequence summing to ``num_edges`` with every degree
-    >= 1 (self-edge convention), lognormal-skewed like real social
-    graphs — shared by the benchmark-scale generators."""
-    raw = rng.lognormal(mean=0.0, sigma=1.25, size=num_nodes)
+def _degree_sequence(raw: np.ndarray, num_edges: int,
+                     rng) -> np.ndarray:
+    """Degree sequence proportional to ``raw`` summing to
+    ``num_edges`` with every degree >= 1 (self-edge convention);
+    rounding remainder distributed over random vertices."""
+    num_nodes = raw.shape[0]
     extra = num_edges - num_nodes
     deg = 1 + np.floor(raw / raw.sum() * extra).astype(np.int64)
     short = num_edges - int(deg.sum())
     if short > 0:
         np.add.at(deg, rng.randint(0, num_nodes, size=short), 1)
     return deg
+
+
+def _lognormal_degree_sequence(num_nodes: int, num_edges: int,
+                               rng) -> np.ndarray:
+    """In-degree sequence lognormal-skewed like real social graphs —
+    shared by the benchmark-scale generators."""
+    raw = rng.lognormal(mean=0.0, sigma=1.25, size=num_nodes)
+    return _degree_sequence(raw, num_edges, rng)
 
 
 def planted_community_csr(num_nodes: int, num_edges: int,
