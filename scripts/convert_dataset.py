@@ -225,10 +225,59 @@ def synthetic_cora(seed: int = 7) -> Dataset:
                    num_classes=C, name="cora-synth")
 
 
+# ---------------------------------------------------------------- karate
+
+# Zachary's karate club (W. W. Zachary, "An Information Flow Model for
+# Conflict and Fission in Small Groups", J. Anthropological Research
+# 33(4):452-473, 1977): 34 members, 78 friendship edges, and the
+# club's REAL post-fission faction split — the smallest real public
+# graph dataset, vendored verbatim (public-domain observational data
+# shipped by every network-analysis toolkit).  0-indexed; node 0 is
+# the instructor ("Mr. Hi"), node 33 the club officer.
+_KARATE_EDGES = (
+    (0, 1), (0, 2), (0, 3), (0, 4), (0, 5), (0, 6), (0, 7), (0, 8),
+    (0, 10), (0, 11), (0, 12), (0, 13), (0, 17), (0, 19), (0, 21),
+    (0, 31), (1, 2), (1, 3), (1, 7), (1, 13), (1, 17), (1, 19),
+    (1, 21), (1, 30), (2, 3), (2, 7), (2, 8), (2, 9), (2, 13),
+    (2, 27), (2, 28), (2, 32), (3, 7), (3, 12), (3, 13), (4, 6),
+    (4, 10), (5, 6), (5, 10), (5, 16), (6, 16), (8, 30), (8, 32),
+    (8, 33), (9, 33), (13, 33), (14, 32), (14, 33), (15, 32),
+    (15, 33), (18, 32), (18, 33), (19, 33), (20, 32), (20, 33),
+    (22, 32), (22, 33), (23, 25), (23, 27), (23, 29), (23, 32),
+    (23, 33), (24, 25), (24, 27), (24, 31), (25, 31), (26, 29),
+    (26, 33), (27, 33), (28, 31), (28, 33), (29, 32), (29, 33),
+    (30, 32), (30, 33), (31, 32), (31, 33), (32, 33))
+
+# the documented post-split membership (Zachary's "club" attribute):
+# these 17 members joined the officer's club, the rest followed Mr. Hi
+_KARATE_OFFICER = frozenset(
+    (9, 14, 15, 18, 20, 22, 23, 24, 25, 26, 27, 28, 29, 30, 31, 32, 33))
+
+
+def karate_club() -> Dataset:
+    """The real karate club as a semi-supervised 2-class node
+    classification task (the classic GCN demo setup): identity
+    features, only the two faction LEADERS labeled for training, a
+    2-node val split, the remaining 30 members held out as test —
+    predicting the real fission from the topology alone."""
+    V = 34
+    e = np.asarray(_KARATE_EDGES, dtype=np.int64)
+    g = add_self_edges(from_edge_list(e[:, 0], e[:, 1], V,
+                                      symmetrize=True))
+    labels = np.fromiter((1 if v in _KARATE_OFFICER else 0
+                          for v in range(V)), dtype=np.int32, count=V)
+    feats = np.eye(V, dtype=np.float32)
+    mask = np.full(V, MASK_TEST, dtype=np.int32)
+    mask[[0, 33]] = MASK_TRAIN
+    mask[[1, 32]] = MASK_VAL
+    return Dataset(graph=g, features=feats, labels=labels, mask=mask,
+                   num_classes=2, name="karate")
+
+
 # ---------------------------------------------------------------- main
 
 CONVERTERS = ("cora", "citeseer", "pubmed", "reddit", "ogbn-arxiv",
-              "ogbn-products", "cora-synth")
+              "ogbn-products", "cora-synth", "karate")
 
 
 def main(argv=None) -> int:
@@ -250,6 +299,8 @@ def main(argv=None) -> int:
         ds = convert_dgl_reddit(args.raw_dir)
     elif args.dataset.startswith("ogbn-"):
         ds = convert_ogbn(args.dataset, args.raw_dir)
+    elif args.dataset == "karate":
+        ds = karate_club()
     else:
         ds = synthetic_cora()
 

@@ -190,3 +190,52 @@ def test_cora_accuracy_gate(dtype_mode):
     m = tr.evaluate()
     assert m["test_acc"] >= 0.85, m
     assert m["val_acc"] >= 0.85, m
+
+
+def test_karate_club_is_the_real_graph():
+    """The vendored Zachary karate club must be the canonical dataset:
+    34 members, 78 undirected friendships, the documented 17/17
+    faction split, leaders on opposite sides."""
+    from convert_dataset import karate_club
+    ds = karate_club()
+    assert ds.graph.num_nodes == 34
+    # 78 undirected edges -> 156 arcs + 34 self edges
+    assert ds.graph.num_edges == 2 * 78 + 34
+    assert ds.graph.is_symmetric() and ds.graph.has_all_self_edges()
+    assert int(ds.labels.sum()) == 17 and ds.labels.shape == (34,)
+    assert ds.labels[0] == 0 and ds.labels[33] == 1
+    assert (ds.mask == MASK_TRAIN).sum() == 2
+    assert (ds.mask == MASK_TEST).sum() == 30
+    # well-known structural facts of the real graph: the two leaders
+    # are the highest-degree members
+    deg = np.diff(ds.graph.row_ptr)
+    top2 = set(np.argsort(-deg)[:2].tolist())
+    assert top2 == {0, 33}, deg
+
+
+def test_karate_real_data_cli_convergence_gate(tmp_path, capsys):
+    """A REAL (non-synthetic) graph through the full product path:
+    convert CLI -> reference on-disk layout -> train CLI -> accuracy
+    floor (VERDICT r3 next-round #5).  The GCN must recover the real
+    club fission from 2 labeled leaders at >= 80% test accuracy
+    (typical converged value: >= 90%)."""
+    out = os.path.join(tmp_path, "d", "karate")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_SCRIPTS, "convert_dataset.py"),
+         "--dataset", "karate", "--out", out],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(out + ".add_self_edge.lux")
+    from roc_tpu.train import cli
+    rc = cli.main(["--cpu", "--no-compile-cache", "-file", out,
+                   "-layers", "34-16-2", "-lr", "0.02", "-decay",
+                   "5e-4", "-dropout", "0.0", "-e", "150",
+                   "--eval-every", "150", "--impl", "ell"])
+    assert rc == 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("[INFER]")]
+    assert lines, "no INFER output"
+    import re
+    accs = re.findall(r"test_accuracy:\s*([0-9.]+)%", lines[-1])
+    assert accs, lines[-1]
+    assert float(accs[0]) >= 80.0, lines[-1]
