@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Compile-size probe for the attention paths at ogbn-products scale.
+
+BASELINE.md config 7 (GAT, V=2.45M, E=126M) could not land on one chip
+in r3: the per-width bucket path (ops/attention.py gat_aggregate_ell)
+Python-unrolls one checkpointed scan per large width bucket, autodiff
+doubles each, and the resulting HLO pushed remote compile past 40 min.
+This probe LOWERS (traces, no backend compile — runs anywhere) the
+differentiated aggregation for both layouts at the real shapes and
+reports StableHLO module size — the controlled evidence that the
+uniform flat8 layout (gat_aggregate_flat8) removes the blowup.
+
+Usage: python benchmarks/compile_probe.py [--nodes N] [--edges E]
+       [--dim F] [--heads K]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def bucket_shapes(deg: np.ndarray, min_width: int = 8):
+    """(R, W) per bucket from the degree sequence — the shapes
+    ell_from_graph would build, without materializing any tables."""
+    from roc_tpu.core.ell import row_widths
+    w = row_widths(deg, min_width)
+    out = []
+    for wv, c in zip(*np.unique(w[w > 0], return_counts=True)):
+        out.append((int(c), int(wv)))
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=2_449_029)
+    ap.add_argument("--edges", type=int, default=126_000_000)
+    ap.add_argument("--dim", type=int, default=256)
+    ap.add_argument("--heads", type=int, default=1)
+    ap.add_argument("--seg-rows", type=int, default=8192)
+    args = ap.parse_args()
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # lowering only
+    import jax.numpy as jnp
+    from roc_tpu.ops.attention import (gat_aggregate_ell,
+                                       gat_aggregate_flat8)
+
+    V, F, K = args.nodes, args.dim, args.heads
+    rng = np.random.RandomState(0)
+    from roc_tpu.core.graph import _lognormal_degree_sequence
+    deg = _lognormal_degree_sequence(V, args.edges, rng)
+
+    S = jax.ShapeDtypeStruct
+    full = S((V + 1, F), jnp.float32)
+    s_full = S((V + 1, K), jnp.float32)
+    d_local = S((V + 1, K), jnp.float32)
+
+    def lower(tag, fn, *extra):
+        t0 = time.time()
+        lowered = jax.jit(jax.grad(
+            lambda f, s, d, *a: jnp.sum(fn(f, s, d, *a) ** 2),
+            argnums=(0, 1, 2))).lower(full, s_full, d_local, *extra)
+        txt = lowered.as_text()
+        print(f"{tag:10s} HLO {len(txt)/1e6:8.2f} MB "
+              f"{txt.count(chr(10)):9d} lines   "
+              f"(lowered in {time.time()-t0:.1f}s)")
+        return len(txt)
+
+    # bucket path: shapes exactly as ell_from_graph would plan them
+    shapes = bucket_shapes(deg)
+    print(f"# V={V} E={args.edges} F={F} K={K}; "
+          f"{len(shapes)} width buckets "
+          f"(max width {max(w for _, w in shapes)})")
+    idx = tuple(S((r, w), jnp.int32) for r, w in shapes)
+    rid = tuple(S((r,), jnp.int32) for r, _ in shapes)
+    pos = S((V,), jnp.int32)
+    b = lower("bucket", lambda f, s, d, i, ri, p:
+              gat_aggregate_ell(f, s, d, i, ri, p, V), idx, rid, pos)
+
+    # flat8 path: one uniform [chunks, seg, 8] table
+    n_sub = int((-(-deg // 8)).sum())
+    chunks = -(-n_sub // args.seg_rows)
+    f8i = S((chunks, args.seg_rows, 8), jnp.int32)
+    f8d = S((chunks, args.seg_rows), jnp.int32)
+    f = lower("flat8", lambda fu, s, d, i8, d8:
+              gat_aggregate_flat8(fu, s, d, i8, d8, V), f8i, f8d)
+    print(f"# flat8 table: {chunks} chunks x {args.seg_rows} x 8 "
+          f"({n_sub/1e6:.1f}M sub-rows); HLO ratio bucket/flat8 = "
+          f"{b / f:.1f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,13 @@ class GraphContext:
     sect_idx: Tuple[jax.Array, ...] = ()
     sect_sub_dst: Tuple[jax.Array, ...] = ()
     sect_meta: Tuple[Tuple[int, int], ...] = ()
+    # Uniform width-8 attention layout (aggr_impl == "attn_flat8"):
+    # one [n_chunks, seg_rows, 8] global-id table + [n_chunks,
+    # seg_rows] output rows — the large-graph GAT path whose compile
+    # size is degree-distribution-independent (ops/attention.py
+    # gat_aggregate_flat8)
+    flat8_idx: Optional[jax.Array] = None
+    flat8_dst: Optional[jax.Array] = None
     # halo exchange mode: "gather" = one-shot all_gather of the full
     # feature matrix (the reference's whole-region requirement);
     # "ring" = ppermute rotation overlapping per-shard aggregation
@@ -183,13 +190,18 @@ class GraphContext:
                 "attention is not supported with halo='ring' (the ring "
                 "accumulator is additive; the edge softmax needs the "
                 "whole neighborhood); use halo='gather'")
-        if self.aggr_impl not in ("ell", "pallas") or not self.ell_idx:
+        flat8 = self.aggr_impl == "attn_flat8" and \
+            self.flat8_idx is not None
+        if not flat8 and (self.aggr_impl not in ("ell", "pallas")
+                          or not self.ell_idx):
             raise NotImplementedError(
-                f"attention needs the ELL tables (aggr_impl='ell'), "
-                f"got {self.aggr_impl!r}; sectioned splits a row's "
+                f"attention needs the ELL tables (aggr_impl='ell') or "
+                f"the flat8 layout (aggr_impl='attn_flat8'), got "
+                f"{self.aggr_impl!r}; sectioned splits a row's "
                 "neighbors across sections and cannot host the edge "
                 "softmax")
-        from ..ops.attention import gat_aggregate_ell
+        from ..ops.attention import (gat_aggregate_ell,
+                                     gat_aggregate_flat8)
         if a_src.ndim == 1:                  # single-head vectors
             a_src = a_src[None, :]
             a_dst = a_dst[None, :]
@@ -204,6 +216,11 @@ class GraphContext:
                        a_dst.astype(x.dtype))           # [num_rows, K]
         d_local = jnp.concatenate(
             [d, jnp.zeros((1, K), dtype=d.dtype)])
+        if flat8:
+            return gat_aggregate_flat8(full, s_full, d_local,
+                                       self.flat8_idx, self.flat8_dst,
+                                       self.num_rows,
+                                       neg_slope=neg_slope)
         return gat_aggregate_ell(full, s_full, d_local, self.ell_idx,
                                  self.ell_row_id, self.ell_row_pos,
                                  self.num_rows, neg_slope=neg_slope)
@@ -250,7 +267,7 @@ class GraphContext:
 def _gctx_flatten(g: GraphContext):
     children = (g.edge_src, g.edge_dst, g.in_degree, g.ell_idx,
                 g.ell_row_pos, g.ring_idx, g.sect_idx, g.sect_sub_dst,
-                g.ell_row_id)
+                g.ell_row_id, g.flat8_idx, g.flat8_dst)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
            g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
            g.sect_meta)
@@ -261,7 +278,8 @@ def _gctx_unflatten(aux, children):
     (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
      symmetric, halo, axis_name, sect_meta) = aux
     (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
-     sect_idx, sect_sub_dst, ell_row_id) = children
+     sect_idx, sect_sub_dst, ell_row_id, flat8_idx,
+     flat8_dst) = children
     return GraphContext(
         edge_src=edge_src, edge_dst=edge_dst, in_degree=in_degree,
         num_rows=num_rows, gathered_rows=gathered_rows,
@@ -270,7 +288,8 @@ def _gctx_unflatten(aux, children):
         ell_idx=ell_idx, ell_row_pos=ell_row_pos, halo=halo,
         ring_idx=ring_idx, axis_name=axis_name, sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst, sect_meta=sect_meta,
-        ell_row_id=ell_row_id)
+        ell_row_id=ell_row_id, flat8_idx=flat8_idx,
+        flat8_dst=flat8_dst)
 
 
 # GraphContext is a pytree so the graph tables travel as jit ARGUMENTS.

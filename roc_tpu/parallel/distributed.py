@@ -246,7 +246,15 @@ class DistributedTrainer:
                 aggr_impl=resolve_auto_impl(
                     v, out_rows=-(-v // num_parts)))
         from ..train.trainer import resolve_attention_impl
+        # no dataset passed: the distributed attention path keeps the
+        # per-width ELL tables (shard_dataset builds no flat8 layout;
+        # the compile-size boundary is a single-chip concern — the
+        # products-scale GAT config runs one chip, BASELINE.md #7)
         config = resolve_attention_impl(model, config)
+        if config.aggr_impl == "attn_flat8":
+            raise NotImplementedError(
+                "aggr_impl='attn_flat8' is single-device; distributed "
+                "attention uses aggr_impl='ell'")
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0

@@ -252,3 +252,156 @@ def test_gat_rejects_sectioned_tables():
     with pytest.raises(NotImplementedError, match="ELL"):
         gctx.gat_attention(jnp.zeros((4, 2)), jnp.zeros(2),
                            jnp.zeros(2))
+
+
+# ---------------------------------------------------------------- flat8
+
+def _flat8_tables(g, seg_rows=64):
+    from roc_tpu.core.ell import sectioned_from_graph
+    sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes,
+                                src_rows=g.num_nodes,
+                                section_rows=g.num_nodes,
+                                seg_rows=seg_rows)
+    assert len(sect.idx) == 1
+    return jnp.asarray(sect.idx[0]), jnp.asarray(sect.sub_dst[0])
+
+
+def test_flat8_matches_dense_reference(dataset):
+    """The uniform width-8 attention layout (the large-graph compile
+    path) == the dense O(V^2) computation, with several scan chunks
+    forced via a small seg_rows."""
+    from roc_tpu.ops.attention import gat_aggregate_flat8
+    g = dataset.graph
+    V, F = g.num_nodes, 8
+    rng = np.random.RandomState(0)
+    h = rng.randn(V, F).astype(np.float32)
+    a_src = rng.randn(F).astype(np.float32) * 0.3
+    a_dst = rng.randn(F).astype(np.float32) * 0.3
+    f8i, f8d = _flat8_tables(g, seg_rows=64)
+    assert f8i.shape[0] > 1, "need multiple chunks to test the scan"
+    full = jnp.concatenate(
+        [jnp.asarray(h), jnp.zeros((1, F), jnp.float32)])
+    s_full = (full @ jnp.asarray(a_src))[:, None]
+    d_local = jnp.concatenate(
+        [jnp.asarray(h @ a_dst), jnp.zeros((1,), jnp.float32)])[:, None]
+    out = gat_aggregate_flat8(full, s_full, d_local, f8i, f8d, V)
+    ref = dense_gat_reference(_adj_from_graph(g), h, a_src, a_dst)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4,
+                               atol=2e-5)
+
+
+def test_flat8_multihead_matches_bucket_path(dataset):
+    """flat8 == the bucket path on multi-head inputs (same numerics,
+    different reduction structure), and its gradients match too."""
+    from roc_tpu.ops.attention import (gat_aggregate_ell,
+                                       gat_aggregate_flat8)
+    g = dataset.graph
+    V, K, dh = g.num_nodes, 4, 5
+    F = K * dh
+    rng = np.random.RandomState(3)
+    h = rng.randn(V, F).astype(np.float32)
+    a_src = rng.randn(K, dh).astype(np.float32) * 0.3
+    a_dst = rng.randn(K, dh).astype(np.float32) * 0.3
+    table = ell_from_graph(g.row_ptr, g.col_idx, V)
+    idx = tuple(jnp.asarray(a[0]) for a in table.idx)
+    rid = tuple(jnp.asarray(a[0]) for a in table.row_id)
+    pos = jnp.asarray(table.row_pos[0])
+    f8i, f8d = _flat8_tables(g, seg_rows=64)
+
+    def prep(hh):
+        full = jnp.concatenate(
+            [hh, jnp.zeros((1, F), jnp.float32)])
+        fr = full.reshape(full.shape[0], K, dh)
+        s = jnp.einsum("gkd,kd->gk", fr, jnp.asarray(a_src))
+        d = jnp.einsum("vkd,kd->vk", hh.reshape(V, K, dh),
+                       jnp.asarray(a_dst))
+        dl = jnp.concatenate([d, jnp.zeros((1, K), jnp.float32)])
+        return full, s, dl
+
+    def via_ell(hh):
+        full, s, dl = prep(hh)
+        return gat_aggregate_ell(full, s, dl, idx, rid, pos, V)
+
+    def via_flat8(hh):
+        full, s, dl = prep(hh)
+        return gat_aggregate_flat8(full, s, dl, f8i, f8d, V)
+
+    hj = jnp.asarray(h)
+    np.testing.assert_allclose(np.asarray(via_flat8(hj)),
+                               np.asarray(via_ell(hj)),
+                               rtol=2e-4, atol=2e-5)
+    g_ell = jax.grad(lambda x: jnp.sum(via_ell(x) ** 2))(hj)
+    g_f8 = jax.grad(lambda x: jnp.sum(via_flat8(x) ** 2))(hj)
+    np.testing.assert_allclose(np.asarray(g_f8), np.asarray(g_ell),
+                               rtol=2e-3, atol=2e-4)
+
+
+def test_flat8_zero_degree_rows_are_zero():
+    from roc_tpu.core.graph import from_edge_list
+    from roc_tpu.ops.attention import gat_aggregate_flat8
+    g = from_edge_list(np.array([0, 1]), np.array([1, 0]), 3)
+    f8i, f8d = _flat8_tables(g, seg_rows=8)
+    h = jnp.asarray(np.random.RandomState(0).randn(3, 4),
+                    dtype=jnp.float32)
+    full = jnp.concatenate([h, jnp.zeros((1, 4), jnp.float32)])
+    s_full = (jnp.ones((4,), jnp.float32) @ full.T)[:, None]
+    d_local = jnp.zeros((4, 1), jnp.float32)
+    out = gat_aggregate_flat8(full, s_full, d_local, f8i, f8d, 3)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_array_equal(np.asarray(out)[2], 0.0)
+
+
+def test_flat8_end_to_end_and_resolver(dataset):
+    """aggr_impl='attn_flat8' trains a GAT end to end to the same
+    params as 'ell' (dropout 0 => identical RNG-free paths), and the
+    resolver routes big-E attention configs to it automatically."""
+    from roc_tpu.train.trainer import (ATTN_FLAT8_MIN_EDGES,
+                                       resolve_attention_impl)
+    params = {}
+    for impl in ("ell", "attn_flat8"):
+        model = build_gat([dataset.in_dim, 8, dataset.num_classes],
+                          dropout_rate=0.0)
+        cfg = TrainConfig(learning_rate=0.02, aggr_impl=impl,
+                          verbose=False, eval_every=1 << 30)
+        tr = Trainer(model, dataset, cfg)
+        tr.train(epochs=3)
+        params[impl] = tr.params
+    for k in params["ell"]:
+        np.testing.assert_allclose(np.asarray(params["ell"][k]),
+                                   np.asarray(params["attn_flat8"][k]),
+                                   rtol=2e-3, atol=2e-4)
+
+    model = build_gat([dataset.in_dim, 8, dataset.num_classes])
+    # small graph: stays on the bucket path
+    cfg = resolve_attention_impl(
+        model, TrainConfig(aggr_impl="auto", verbose=False), dataset)
+    assert cfg.aggr_impl == "ell"
+    # big-E graph: routed to flat8 (threshold patched to the fixture)
+    import roc_tpu.train.trainer as trmod
+    orig = trmod.ATTN_FLAT8_MIN_EDGES
+    try:
+        trmod.ATTN_FLAT8_MIN_EDGES = dataset.graph.num_edges
+        cfg = resolve_attention_impl(
+            model, TrainConfig(aggr_impl="auto", verbose=False),
+            dataset)
+        assert cfg.aggr_impl == "attn_flat8"
+    finally:
+        trmod.ATTN_FLAT8_MIN_EDGES = orig
+    # MAX/MIN models must refuse the attention-only layout
+    from roc_tpu.models.sage import build_sage
+    pool = build_sage([dataset.in_dim, 8, dataset.num_classes],
+                      aggregator="pool")
+    with pytest.raises(NotImplementedError, match="attention-only"):
+        resolve_attention_impl(
+            pool, TrainConfig(aggr_impl="attn_flat8"), dataset)
+
+
+def test_attn_flat8_rejected_for_sum_models(dataset):
+    """A sum-only model with aggr_impl='attn_flat8' fails at resolve
+    time, before any table build."""
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import resolve_attention_impl
+    gcn = build_gcn([dataset.in_dim, 8, dataset.num_classes])
+    with pytest.raises(NotImplementedError, match="attention-only"):
+        resolve_attention_impl(
+            gcn, TrainConfig(aggr_impl="attn_flat8"), dataset)
