@@ -93,6 +93,14 @@ class TrainConfig:
     features: str = "hbm"
     memory: str = "manual"
     hbm_bytes: Optional[int] = None
+    # Sectioned-layout tuning (core/ell.py SectionedEll; raced by
+    # benchmarks/micro_agg.py sectw:/sectu16 specs):
+    # - sect_sub_w: neighbors per sub-row (each (row, section) pair
+    #   pads to a multiple of it).
+    # - sect_u16: uint16 section-local index tables (halves index
+    #   bytes; caps section_rows at 65,535 so the dummy id fits).
+    sect_sub_w: int = 8
+    sect_u16: bool = False
 
 
 def resolve_dtypes(name: str):
@@ -246,9 +254,13 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
 
 def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        chunk: int = 512,
-                       symmetric: Optional[bool] = None) -> GraphContext:
+                       symmetric: Optional[bool] = None,
+                       sect_sub_w: int = 8,
+                       sect_u16: bool = False) -> GraphContext:
     """Single-device GraphContext: edges padded to the chunk multiple,
-    dummy source id == num_nodes (the appended zero row)."""
+    dummy source id == num_nodes (the appended zero row).
+    ``sect_sub_w``/``sect_u16`` tune the sectioned layout
+    (TrainConfig fields of the same names)."""
     g = dataset.graph
     if aggr_impl == "auto":
         # data-driven split: sectioned wins in its measured node-count
@@ -279,8 +291,15 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         ell_row_pos = jnp.asarray(table.row_pos[0])
         ell_row_id = tuple(jnp.asarray(a[0]) for a in table.row_id)
     elif aggr_impl == "sectioned":
-        from ..core.ell import sectioned_from_graph
-        sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
+        from ..core.ell import (SECTION_ROWS_DEFAULT,
+                                sectioned_from_graph)
+        sec_rows = (min(SECTION_ROWS_DEFAULT, 65_535) if sect_u16
+                    else SECTION_ROWS_DEFAULT)
+        sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes,
+                                    section_rows=sec_rows,
+                                    sub_w=sect_sub_w)
+        if sect_u16:
+            sect = sect.with_idx_dtype(np.uint16)
         sect_idx, sect_sub_dst, sect_meta = sect.as_jax()
     elif aggr_impl == "attn_flat8":
         # large-graph attention: ONE section spanning all sources
@@ -329,7 +348,9 @@ class Trainer:
         self.epoch = 0
         self.gctx = make_graph_context(dataset, config.aggr_impl,
                                        config.chunk,
-                                       symmetric=config.symmetric)
+                                       symmetric=config.symmetric,
+                                       sect_sub_w=config.sect_sub_w,
+                                       sect_u16=config.sect_u16)
         self.labels = jnp.asarray(dataset.labels)
         self.mask = jnp.asarray(dataset.mask)
         key = jax.random.PRNGKey(config.seed)
