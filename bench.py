@@ -115,6 +115,10 @@ def build_parser():
                     help="global wall-clock budget (s); must stay under "
                          "the driver's own timeout so the final JSON "
                          "line always gets printed")
+    ap.add_argument("--promote-max-age-h", type=float, default=24.0,
+                    help="max age of a bench_stages.jsonl record "
+                         "eligible for in_round_stage promotion when "
+                         "every live stage fails")
     ap.add_argument("--probe-retries", type=int, default=8,
                     help="max extra probe attempts; attempts are "
                          "spread ~3.5 min apart across the whole "
@@ -182,8 +186,41 @@ def _errstr(e: BaseException, limit: int = 300) -> str:
 # the single-claim TPU tunnel: crashed bench children, ad-hoc probes,
 # tpu_watch loops (each watch attempt queues a claim for up to 180 s
 # and a killed claim holder can wedge the relay for everyone after it).
-_STALE_CMD_PATTERNS = ("bench.py", "tpu_watch", "micro_agg",
-                       "model_zoo", "__graft_entry__")
+# Patterns are THIS repo's absolute script paths — `bench.py` of some
+# unrelated project, or an editor with the name on its command line,
+# must never match (round-4 advisor finding).
+_STALE_CMD_PATTERNS = tuple(os.path.join(_HERE, rel) for rel in (
+    "bench.py",
+    "scripts/tpu_watch",
+    "benchmarks/micro_agg.py",
+    "benchmarks/model_zoo.py",
+    "benchmarks/calibrate.py",
+    "benchmarks/compile_probe.py",
+    "__graft_entry__.py",
+))
+
+
+def _ppid(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return int(f.read().rsplit(")", 1)[1].split()[1])
+    except (OSError, ValueError, IndexError):
+        return -1
+
+
+def _orphaned(pid: int) -> bool:
+    """The launching shell/session is gone: reparented to init — or to
+    a subreaper that adopts lost children (tmux server, systemd user
+    instance), which keeps ppid != 1 forever for the same situation."""
+    ppid = _ppid(pid)
+    if ppid == 1:
+        return True
+    try:
+        with open(f"/proc/{ppid}/comm") as f:
+            comm = f.read().strip()
+    except OSError:
+        return True  # parent vanished between reads
+    return comm in ("tmux: server", "systemd", "init")
 
 
 def _ancestors_and_self() -> set:
@@ -191,11 +228,7 @@ def _ancestors_and_self() -> set:
     pid = os.getpid()
     while pid > 1 and pid not in pids:
         pids.add(pid)
-        try:
-            with open(f"/proc/{pid}/stat") as f:
-                pid = int(f.read().rsplit(")", 1)[1].split()[1])
-        except (OSError, ValueError, IndexError):
-            break
+        pid = _ppid(pid)
     return pids
 
 
@@ -212,11 +245,15 @@ def _pid_age_s(pid: int) -> float:
 def _reap_stale_tpu_processes(grace: float = None) -> list:
     """SIGTERM (then SIGKILL) stale processes that could hold the TPU
     tunnel claim, so the probe never queues behind this session's own
-    corpses.  Matches known claim-holding command patterns plus
-    anonymous ``python -`` probes writing to tpu_watch logs; processes
-    younger than ``_STALE_MIN_AGE_S`` or explicitly ``--cpu`` are
-    spared (a just-launched deliberate run is not a corpse — the stale
-    failure mode is watchers/corpses from EARLIER sessions).  Returns
+    corpses.  Matches only THIS repo's absolute script paths (plus
+    anonymous ``python -`` probes whose stdout points at this repo's
+    tpu_watch logs), and requires REAL staleness evidence before
+    killing: the process must be orphaned (reparented to init or a
+    subreaper — its launching shell/session is gone) AND older than
+    ``_STALE_MIN_AGE_S``.  A
+    concurrent legitimate bench launched from a live shell keeps its
+    shell as parent and is spared, however long it has run; ``--cpu``
+    runs never hold a claim and are spared unconditionally.  Returns
     ``[{pid, cmd}]`` for the stage record."""
     if grace is None:
         grace = _TERM_GRACE  # same claim-unwind budget as stage children
@@ -247,14 +284,41 @@ def _reap_stale_tpu_processes(grace: float = None) -> list:
         if head not in ("python", "python3", "sh", "bash", "dash",
                         "timeout"):
             continue
-        stale = any(p in cmd for p in _STALE_CMD_PATTERNS)
-        if not stale and head in ("python", "python3", "timeout"):
-            # ad-hoc watch probes are bare ``python -`` heredocs; their
-            # stdout points at the watch log
+        def _matches(p: str) -> bool:
+            if p in cmd:
+                return True
+            # `cd /root/repo && python bench.py` leaves a RELATIVE
+            # path in cmdline — resolve argv tokens against the
+            # process's own cwd so those corpses still match, without
+            # ever matching another repo's same-named script
             try:
-                stale = "tpu_watch" in os.readlink(f"/proc/{pid}/fd/1")
+                cwd = os.readlink(f"/proc/{pid}/cwd")
             except OSError:
-                stale = False
+                return False
+            return any(os.path.normpath(
+                os.path.join(cwd, tok)).startswith(p)
+                for tok in cmd.split() if not tok.startswith("-"))
+
+        is_watch = _matches(os.path.join(_HERE, "scripts/tpu_watch"))
+        if not is_watch and head in ("python", "python3", "timeout"):
+            # ad-hoc watch probes are bare ``python -`` heredocs whose
+            # stdout points at a tpu_watch log (default /tmp, or one
+            # under this repo)
+            try:
+                link = os.readlink(f"/proc/{pid}/fd/1")
+                is_watch = "tpu_watch" in os.path.basename(link)
+            except OSError:
+                pass
+        is_meas = not is_watch and any(
+            _matches(p) for p in _STALE_CMD_PATTERNS)
+        # Watch loops are reaped on age alone: they re-queue a 180 s
+        # tunnel claim forever and are NEVER a legitimate concurrent
+        # measurement, even when their launching shell is still alive
+        # (the r03 starvation mode).  Measurement runs additionally
+        # need real staleness evidence — init-orphaned (their session
+        # is gone) — so a long-running deliberate bench from a live
+        # shell is always spared (round-4 advisor).
+        stale = (is_watch or (is_meas and _orphaned(pid)))
         if stale and _pid_age_s(pid) >= _STALE_MIN_AGE_S:
             victims.append({"pid": pid, "cmd": cmd[:160]})
     for v in victims:
@@ -311,6 +375,120 @@ def _read_probe_progress() -> list:
             return [line.rstrip("\n") for line in f][-8:]
     except OSError:
         return []
+
+
+# -------------------------------------------------- relay health check
+
+def _relay_health(port: int = None, timeout: float = 2.0) -> dict:
+    """Cheap TCP pre-check of the axon relay's loopback endpoint so a
+    dead relay yields a DISTINCT error from a held claim (VERDICT r4
+    #2: 'claiming backend' timeouts were indistinguishable from a
+    relay that was not even listening).  Diagnostic only — the probe
+    still runs either way (a refused remote-compile port does not
+    always imply the claim leg is down)."""
+    import socket
+    if port is None:
+        port = int(os.environ.get("ROC_TPU_RELAY_PORT", "8113"))
+    t0 = time.time()
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=timeout):
+            state = "listening"
+    except ConnectionRefusedError:
+        state = "refused"
+    except (socket.timeout, OSError) as e:
+        state = f"unreachable: {type(e).__name__}"
+    return {"port": port, "state": state,
+            "elapsed_s": round(time.time() - t0, 2)}
+
+
+def _same_platform_class(a, b) -> bool:
+    """'tpu' and 'axon' are the same chip reached two ways (the relay
+    reports either name depending on the claim path); cpu vs on-chip
+    is the mismatch the guard exists for."""
+    on_chip = {"tpu", "axon"}
+    return a == b or (a in on_chip and b in on_chip)
+
+
+def _baseline_compare_fields(entry, platform, epoch_ms: float) -> dict:
+    """The ONE place a measured epoch is compared against a recorded
+    baseline (live headline and in-round promotion both use it): a
+    platform mismatch is labeled, never silently scored."""
+    if entry is None:
+        return {"baseline": "unrecorded"}
+    if not _same_platform_class(entry.get("platform"), platform):
+        return {"baseline": f"platform_mismatch: baseline is "
+                            f"{entry.get('platform')}, this run is "
+                            f"{platform}"}
+    if entry.get("epoch_ms") not in (None, epoch_ms):
+        return {"vs_baseline": round(float(entry["epoch_ms"]) / epoch_ms,
+                                     3),
+                "baseline_ms": entry["epoch_ms"],
+                "baseline_recorded": entry.get("recorded", "?"),
+                "baseline_dtype": entry.get("dtype"),
+                "baseline_impl": entry.get("impl")}
+    return {"baseline": "recorded_now"}
+
+
+# ------------------------------------- in-round stage record promotion
+
+def _promote_stage_record(args, stage_summary: dict, errs: dict):
+    """When every live stage failed (relay wedged/claimed at snapshot
+    time), promote the freshest on-chip GCN stage record from this
+    round's ``bench_stages.jsonl`` into the headline line, marked
+    ``"provenance": "in_round_stage"`` so the number is attributable
+    but clearly not from this invocation (VERDICT r4 #2: BENCH_r01-r04
+    all null while 36 successful on-chip stage records sat in the
+    artifact).  Prefers ``full`` over ``small`` and a dtype matching
+    ``--dtype``; returns ``None`` when no on-chip record exists.
+
+    The stage log is append-only across rounds, so records older than
+    ``--promote-max-age-h`` are ignored: a tunnel that stays dead for
+    a whole round yields an honest null, not yesterday's number
+    replayed with a fresh face."""
+    try:
+        with open(_STAGES_PATH) as f:
+            recs = [json.loads(line) for line in f if line.strip()]
+    except (OSError, ValueError):
+        return None
+
+    def fresh(rec) -> bool:
+        try:
+            t = time.mktime(time.strptime(rec["t"][:19],
+                                          "%Y-%m-%dT%H:%M:%S"))
+        except (KeyError, ValueError):
+            return False
+        return (time.time() - t) <= args.promote_max_age_h * 3600.0
+
+    for stage_name, metric in (("full", METRIC_FULL),
+                               ("small", METRIC_SMALL)):
+        cands = [r for r in recs
+                 if r.get("ok") and r.get("stage") == stage_name
+                 and r.get("result", {}).get("platform")
+                 in ("tpu", "axon")
+                 and r.get("result", {}).get("epoch_ms") is not None
+                 and fresh(r)]
+        if not cands:
+            continue
+        matched = [r for r in cands
+                   if r["result"].get("dtype") == args.dtype]
+        rec = (matched or cands)[-1]
+        r = rec["result"]
+        epoch_ms = r["epoch_ms"]
+        line = {"metric": metric, "value": epoch_ms, "unit": "ms",
+                "vs_baseline": 1.0, "stage": stage_name,
+                "dtype": r.get("dtype"), "impl": r.get("impl"),
+                "provenance": "in_round_stage",
+                "provenance_recorded": rec.get("t"),
+                "live_errors": errs, "stages": stage_summary}
+        line.update(_baseline_compare_fields(
+            _load_baselines().get(metric), r.get("platform"), epoch_ms))
+        if line.get("baseline") == "recorded_now":
+            # promotion never writes baselines; equal values just mean
+            # the promoted record IS the recorded one
+            line["baseline"] = "equals_baseline"
+        return line
+    return None
 
 
 # ---------------------------------------------------------------- children
@@ -633,6 +811,14 @@ def parent(args, argv) -> int:
                            "reaped": reaped})
             print(f"# reaped {len(reaped)} stale TPU process(es): "
                   f"{[v['pid'] for v in reaped]}", file=sys.stderr)
+        # relay-health pre-check: a dead relay and a held claim look
+        # identical from inside the probe ('claiming backend' hang);
+        # this tells them apart in the artifact
+        health = _relay_health()
+        _append_stage({"stage": "relay_check", "t": _now_iso(),
+                       **health})
+        print(f"# relay tcp 127.0.0.1:{health['port']}: "
+              f"{health['state']}", file=sys.stderr)
 
     for name in wanted:
         timeout, min_budget = stage_cfg[name]
@@ -743,34 +929,31 @@ def parent(args, argv) -> int:
         if rec and rec.get("ok"):
             r = rec["result"]
             epoch_ms = r["epoch_ms"]
-            db = _load_baselines()
-            entry = db.get(metric)
             line = {"metric": metric, "value": epoch_ms, "unit": "ms",
                     "vs_baseline": 1.0, "stage": name,
                     "dtype": r.get("dtype"), "impl": r.get("impl"),
                     "stages": stage_summary}
-            if entry and entry.get("platform") != r.get("platform"):
-                # a CPU run must not claim a speedup over a TPU
-                # baseline (or vice versa)
-                line["baseline"] = (f"platform_mismatch: baseline is "
-                                    f"{entry.get('platform')}, this "
-                                    f"run is {r.get('platform')}")
-            elif entry and entry.get("epoch_ms") != epoch_ms:
-                line["vs_baseline"] = round(
-                    float(entry["epoch_ms"]) / epoch_ms, 3)
-                line["baseline_ms"] = entry["epoch_ms"]
-                line["baseline_recorded"] = entry.get("recorded", "?")
-                line["baseline_dtype"] = entry.get("dtype")
-                line["baseline_impl"] = entry.get("impl")
-            elif entry:
-                line["baseline"] = "recorded_now"
-            else:
-                line["baseline"] = "unrecorded"
+            line.update(_baseline_compare_fields(
+                _load_baselines().get(metric), r.get("platform"),
+                epoch_ms))
             print(json.dumps(line))
             return 0
-    # no GCN stage completed — report what did
+    # no GCN stage completed — promote the freshest in-round on-chip
+    # record rather than handing the driver a fifth null (the value is
+    # real and attributable; "provenance" says it is not from this
+    # invocation).  --cpu runs keep the null path: their failures are
+    # local bugs, not tunnel weather.
     errs = {n: results[n].get("error") for n in results
             if not results[n].get("ok")}
+    # promotion is strictly a tunnel-weather path: only when a GCN
+    # stage was WANTED and attempted/skipped-but-failed.  A micro-only
+    # or probe-only run never borrows an old headline number.
+    gcn_failed = any(n in errs for n in ("small", "full"))
+    if not args.cpu and gcn_failed:
+        promo = _promote_stage_record(args, stage_summary, errs)
+        if promo is not None:
+            print(json.dumps(promo))
+            return 0
     print(json.dumps({"metric": METRIC_FULL, "value": None, "unit": "ms",
                       "vs_baseline": None, "stage": None,
                       "stages": stage_summary, "error": errs}))

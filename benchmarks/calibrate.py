@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Sectioned-window calibration harness: one command per generation.
+
+``resolve_auto_impl`` picks between the whole-table ELL gather and the
+sectioned carry-scan from a generation-keyed window
+(``core/ell.py SECTIONED_BOUNDS_BY_KIND``).  That window is a MEASURED
+property of a chip generation — on v5e the crossover was found by hand
+(BASELINE.md "ell vs sectioned across graph size").  This harness
+automates the sweep so an uncalibrated generation becomes a calibrated
+one with one command (VERDICT r4 weak #4):
+
+    python benchmarks/calibrate.py            # on the chip to calibrate
+    python benchmarks/calibrate.py --cpu      # rehearsal, not recorded
+
+Protocol: at each V point (default 233k / 500k / 1M — bracketing the
+v5e crossover) build a random CSR at CONSTANT average degree
+(``--degree``, default 60) so every point measures the same density
+regime — the ell-vs-sectioned winner depends on density, and a sweep
+that thins out as V grows would calibrate a window for a workload mix
+nobody runs.  Time one F=256 aggregation per impl (median of
+``--iters``) and place the upper out_rows bound at the geometric mean
+between the largest V where ``sectioned`` wins and the smallest V
+where ``ell`` wins back.  The lower bound stays
+``SECTION_ROWS_DEFAULT`` (below one section's rows the layouts
+coincide and the sectioned overhead can only lose).  Degree is stored
+in the provenance row; calibrate at your own workload's density with
+explicit ``V:E`` points if it differs a lot.
+
+The measured row is merged into ``benchmarks/calibration.json``
+(override: ``ROC_TPU_CALIBRATION``), which ``sectioned_bounds`` reads
+over the builtin table — no code edit, no restart.  Raw point timings
+are stored alongside the row as provenance.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+
+
+def build_parser():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--points", type=str,
+                    default="233000,500000,1000000",
+                    help="comma list of sweep points: bare V (edges = "
+                         "V * --degree) or explicit V:E")
+    ap.add_argument("--degree", type=int, default=60,
+                    help="average degree for bare-V points (constant "
+                         "density across the sweep)")
+    ap.add_argument("--feat", type=int, default=256)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--dtype", type=str, default="bfloat16",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--cpu", action="store_true",
+                    help="CPU backend rehearsal; result is printed but "
+                         "NOT recorded (the window is a chip property)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the sweep plan and exit (no backend)")
+    return ap
+
+
+def measure_point(V: int, E: int, F: int, iters: int, dtype_str: str
+                  ) -> dict:
+    import jax
+    import jax.numpy as jnp
+    from roc_tpu.core.ell import ell_from_graph, sectioned_from_graph
+    from roc_tpu.core.graph import random_csr
+    from roc_tpu.ops.aggregate import aggregate_ell, aggregate_ell_sect
+    from roc_tpu.utils.profiling import sync
+
+    g = random_csr(V, E, seed=0)
+    feats_np = np.random.RandomState(0).rand(V + 1, F).astype(np.float32)
+    feats_np[-1] = 0
+    feats = jnp.asarray(feats_np, dtype=jnp.dtype(dtype_str))
+
+    def bench(fn):
+        sync(fn())
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            sync(fn())
+            times.append((time.perf_counter() - t0) * 1e3)
+        return float(np.median(times))
+
+    table = ell_from_graph(g.row_ptr, g.col_idx, V)
+    idx = tuple(jnp.asarray(a[0]) for a in table.idx)
+    pos = jnp.asarray(table.row_pos[0])
+    f_ell = jax.jit(lambda x: aggregate_ell(x, idx, pos, V))
+    ell_ms = bench(lambda: f_ell(feats))
+
+    sect = sectioned_from_graph(g.row_ptr, g.col_idx, V)
+    sidx, sdst, meta = sect.as_jax()
+    f_sect = jax.jit(lambda x, i, d: aggregate_ell_sect(x, i, d, meta, V))
+    sect_ms = bench(lambda: f_sect(feats, sidx, sdst))
+    return {"V": V, "E": E, "ell_ms": round(ell_ms, 1),
+            "sectioned_ms": round(sect_ms, 1),
+            "winner": "sectioned" if sect_ms < ell_ms else "ell"}
+
+
+def bounds_from_points(points: list, lo: int) -> tuple:
+    """Upper bound from the win->loss crossover in an ascending-V
+    sweep: geometric mean of the bracketing Vs; all-win extrapolates
+    2x past the sweep, all-loss collapses the window to ``lo``."""
+    wins = [p["V"] for p in points if p["winner"] == "sectioned"]
+    losses = [p["V"] for p in points if p["winner"] == "ell"
+              and p["V"] > lo]
+    if not wins:
+        return lo, lo  # empty window: auto always picks ell
+    hi_wins = max(wins)
+    later_losses = [v for v in losses if v > hi_wins]
+    if not later_losses:
+        return lo, int(hi_wins * 2)
+    return lo, int(np.sqrt(hi_wins * min(later_losses)))
+
+
+def main() -> int:
+    args = build_parser().parse_args()
+    points = []
+    for spec in args.points.split(","):
+        if ":" in spec:
+            v, e = spec.split(":")
+            points.append((int(v), int(e)))
+        else:
+            v = int(spec)
+            points.append((v, v * args.degree))
+    points.sort()
+    if args.dry_run:
+        print(json.dumps({"plan": points}))
+        return 0
+
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from roc_tpu.core.ell import SECTION_ROWS_DEFAULT, calibration_path
+    from roc_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache()
+    dev = jax.devices()[0]
+    kind = dev.device_kind
+    print(f"# calibrating {kind!r} ({dev.platform}), F={args.feat} "
+          f"{args.dtype}, {len(points)} points", file=sys.stderr)
+
+    measured = []
+    for V, E in points:
+        t0 = time.time()
+        rec = measure_point(V, E, args.feat, args.iters, args.dtype)
+        measured.append(rec)
+        print(f"# V={V:>9,} E={E:>12,}: ell {rec['ell_ms']:>8.1f} ms  "
+              f"sectioned {rec['sectioned_ms']:>8.1f} ms  -> "
+              f"{rec['winner']}  ({time.time()-t0:.0f}s)",
+              file=sys.stderr)
+
+    lo, hi = bounds_from_points(measured, SECTION_ROWS_DEFAULT)
+    row = {"lo": lo, "hi": hi,
+           "recorded": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+           "feat": args.feat, "dtype": args.dtype,
+           "degree": args.degree,
+           "points": measured,
+           "provenance": "benchmarks/calibrate.py"}
+    out = {"device_kind": kind, "lo": lo, "hi": hi,
+           "recorded": args.cpu is False}
+    if args.cpu:
+        print(f"# --cpu rehearsal: row NOT recorded", file=sys.stderr)
+    else:
+        path = calibration_path()
+        try:
+            with open(path) as f:
+                db = json.load(f)
+        except (OSError, ValueError):
+            db = {}
+        db[kind] = row
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(db, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        print(f"# recorded {kind!r}: (lo={lo}, hi={hi}) -> {path}",
+              file=sys.stderr)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -150,16 +150,27 @@ def main():
             continue
         if impl in ("sectw", "sectu16", "sectsplit"):
             # sectioned-layout variants (VERDICT r4 gather levers):
-            #   sectw:W     sub-row width W instead of 8
-            #   sectu16     uint16 section-local indices (section_rows
-            #               65,535 so the dummy id fits)
-            #   sectsplit   W independent [N]-index gathers instead of
-            #               the [N, W] block gather
+            #   sectw:W      sub-row width W instead of 8
+            #   sectu16[:W]  uint16 section-local indices (section_rows
+            #                65,535 so the dummy id fits), sub-row
+            #                width W (default 8)
+            #   sectsplit[:W] W independent [N]-index gathers instead
+            #                of the [N, W] block gather
+            # The :W suffix means sub-row width for ALL three variants
+            # (round-4 advisor: sectu16:16 used to silently bench width
+            # 8 under a width-16 label).
             from roc_tpu.core.ell import (SECTION_ROWS_DEFAULT,
                                           sectioned_from_graph)
             from roc_tpu.ops.aggregate import (aggregate_ell_sect,
                                                aggregate_ell_sect_split)
-            sub_w = chunk if impl == "sectw" and ":" in spec else 8
+            if impl == "sectw" and ":" not in spec:
+                # a bare 'sectw' measures the default width-8 config —
+                # identical to 'sectioned' — and would land a mislabeled
+                # row in the sweep artifact
+                print(f"{spec:16s} REJECTED: 'sectw' needs an explicit "
+                      f"width — use sectw:W (sectw:8 == default)")
+                continue
+            sub_w = chunk if ":" in spec else 8
             sec_rows = (65_535 if impl == "sectu16"
                         else SECTION_ROWS_DEFAULT)
             t0 = time.time()

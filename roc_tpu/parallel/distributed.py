@@ -143,12 +143,16 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   mesh: Mesh, dtype=jnp.float32,
                   aggr_impl: str = "segment",
                   halo: str = "gather",
-                  put=None, section_rows: Optional[int] = None
+                  put=None, section_rows: Optional[int] = None,
+                  sect_sub_w: int = 8, sect_u16: bool = False
                   ) -> ShardedData:
     """Build + upload the stacked per-part arrays.  ``put`` overrides
     the upload (default: replicated-process ``device_put`` with the
     parts sharding); parallel/multihost.py passes a local-shards-only
-    uploader for multi-host runs."""
+    uploader for multi-host runs.  ``sect_sub_w``/``sect_u16`` tune the
+    sectioned layout exactly like the single-device path
+    (train/trainer.py build_graph_context) — user-selected config is
+    never silently dropped."""
     sh = NamedSharding(mesh, P("parts"))
     if put is None:
         put = lambda x: jax.device_put(x, sh)
@@ -190,11 +194,18 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         elif aggr_impl == "sectioned":
             from ..core.ell import (SECTION_ROWS_DEFAULT,
                                     sectioned_from_padded_parts)
+            if section_rows is None:
+                # u16 section-local ids need the dummy id to fit
+                # (same rule as the single-device path)
+                section_rows = (min(SECTION_ROWS_DEFAULT, 65_535)
+                                if sect_u16 else SECTION_ROWS_DEFAULT)
             sect = sectioned_from_padded_parts(
                 pg.part_row_ptr, col_padded, pg.real_nodes,
                 pg.part_nodes,
                 src_rows=pg.num_parts * pg.part_nodes,
-                section_rows=section_rows or SECTION_ROWS_DEFAULT)
+                section_rows=section_rows, sub_w=sect_sub_w)
+            if sect_u16:
+                sect = sect.with_idx_dtype(np.uint16)
             sect_idx = tuple(put(a) for a in sect.idx)
             sect_sub_dst = tuple(put(a) for a in sect.sub_dst)
             sect_meta = tuple(zip(sect.sec_starts, sect.sec_sizes))
@@ -218,13 +229,43 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     )
 
 
+def put_replicated(tree, mesh: Mesh):
+    """Replicate a host pytree across every device of ``mesh``.
+
+    Single-process this is a plain ``device_put``; multi-process it
+    assembles each global array from this process's addressable shards
+    (``device_put`` cannot place onto non-addressable devices) — the
+    bootstrap analog of the reference broadcasting initial weights to
+    every GPU (``gnn.cc:78-91`` model build + Legion region mapping).
+    """
+    sh = NamedSharding(mesh, P())
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sh)
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(x.shape, sh,
+                                            lambda idx: x[idx])
+    return jax.tree_util.tree_map(put, tree)
+
+
 class DistributedTrainer:
     """The reference epoch loop (``gnn.cc:99-111``) run SPMD over the
-    partition mesh."""
+    partition mesh.
+
+    ``data`` injects pre-built sharded tables — the multi-host entry
+    point: each process runs ``multihost.shard_dataset_local`` (only
+    its own partitions' rows) and passes the result here; the default
+    is the single-controller ``shard_dataset`` build.  The caller must
+    build ``data`` with the same ``aggr_impl``/``halo`` the config
+    resolves to, and should pass the ``pg`` it built the data from
+    (otherwise the identical O(E) partitioning runs a second time)."""
 
     def __init__(self, model: Model, dataset: Dataset, num_parts: int,
                  config: TrainConfig = TrainConfig(),
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 data: Optional[ShardedData] = None,
+                 pg=None):
         self.model = model
         from ..train.trainer import apply_memory_autopilot
         config = apply_memory_autopilot(model, dataset, config,
@@ -260,13 +301,44 @@ class DistributedTrainer:
         self.epoch = 0
         self.symmetric = resolve_symmetric(dataset, config.symmetric)
         self.mesh = mesh if mesh is not None else make_mesh(num_parts)
-        self.pg = partition_graph(
+        if pg is not None and pg.num_parts != num_parts:
+            raise ValueError(f"injected pg has {pg.num_parts} parts, "
+                             f"trainer was asked for {num_parts}")
+        self.pg = pg if pg is not None else partition_graph(
             dataset.graph, num_parts,
             node_multiple=8, edge_multiple=config.chunk)
-        self.data = shard_dataset(dataset, self.pg, self.mesh,
-                                  dtype=self.compute,
-                                  aggr_impl=config.aggr_impl,
-                                  halo=config.halo)
+        self.data = data if data is not None else shard_dataset(
+            dataset, self.pg, self.mesh,
+            dtype=self.compute,
+            aggr_impl=config.aggr_impl,
+            halo=config.halo,
+            sect_sub_w=config.sect_sub_w,
+            sect_u16=config.sect_u16)
+        if data is not None:
+            # the autopilot / auto-resolution above may have settled on
+            # a different halo/aggr_impl than the caller built tables
+            # for — fail HERE with the mismatch, not mid-step with an
+            # opaque shape error
+            if config.halo == "ring" and not self.data.ring_idx:
+                raise ValueError(
+                    "injected data has no ring tables but the resolved "
+                    "config wants halo='ring' (build it with "
+                    "shard_dataset_local(..., halo='ring') or pass "
+                    "memory/halo explicitly)")
+            if config.halo != "ring":
+                if config.aggr_impl == "sectioned" \
+                        and not self.data.sect_idx:
+                    raise ValueError(
+                        "injected data has no sectioned tables but the "
+                        "resolved aggr_impl is 'sectioned' — build it "
+                        "with aggr_impl='sectioned'")
+                if config.aggr_impl in ("ell", "pallas") \
+                        and not self.data.ell_idx:
+                    raise ValueError(
+                        f"injected data has no ELL tables but the "
+                        f"resolved aggr_impl is "
+                        f"{config.aggr_impl!r} — build it with "
+                        f"aggr_impl='ell'")
         if config.halo == "ring" and config.verbose:
             # startup echo like the reference's config print
             # (gnn.cc:48-60): make the SPMD padding cost visible, and
@@ -279,10 +351,10 @@ class DistributedTrainer:
                   f"drive the aggregation)", file=sys.stderr)
         key = jax.random.PRNGKey(config.seed)
         self.key, init_key = jax.random.split(key)
-        repl = NamedSharding(self.mesh, P())
-        self.params = jax.device_put(
-            model.init_params(init_key, dtype=config.dtype), repl)
-        self.opt_state = jax.device_put(adam_init(self.params), repl)
+        host_params = model.init_params(init_key, dtype=config.dtype)
+        self.params = put_replicated(host_params, self.mesh)
+        self.opt_state = put_replicated(adam_init(host_params),
+                                        self.mesh)
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
         self._train_step = self._build_train_step()
         self._eval_step = self._build_eval_step()

@@ -152,7 +152,8 @@ def _allreduce_part_stats(mesh: Mesh, local: List[int],
 def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                         aggr_impl: str = "segment",
                         halo: str = "gather",
-                        section_rows: Optional[int] = None):
+                        section_rows: Optional[int] = None,
+                        sect_sub_w: int = 8, sect_u16: bool = False):
     """Multi-host version of ``distributed.shard_dataset``: each process
     BUILDS and uploads only its own partitions' shards — row-sliced
     loads via :class:`roc_tpu.core.source.DataSource`, per-partition
@@ -294,23 +295,32 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
         from ..core.ell import (SECTION_ROWS_DEFAULT, clean_part_ptr,
                                 section_sub_counts, sectioned_from_graph,
                                 sectioned_plan)
-        sec_rows = section_rows or SECTION_ROWS_DEFAULT
+        if section_rows is None:
+            # u16 section-local ids need the dummy id to fit — same
+            # rule as the single-device and shard_dataset paths
+            section_rows = (min(SECTION_ROWS_DEFAULT, 65_535)
+                            if sect_u16 else SECTION_ROWS_DEFAULT)
+        sec_rows = section_rows
+        idx_np_dtype = np.uint16 if sect_u16 else np.int32
         src_rows = P * pn
         ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
                                   pn) for p in local}
         cnts = {p: section_sub_counts(
             ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows,
-            sec_rows) for p in local}
+            sec_rows, sub_w=sect_sub_w) for p in local}
         counts_max = _allreduce_part_vec_max(mesh, local, cnts)
         seg, plan = sectioned_plan(counts_max)
         sects = {p: sectioned_from_graph(
             ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows=src_rows,
             section_rows=sec_rows, seg_rows=seg, chunks_plan=plan,
-            counts=cnts[p]) for p in local}
+            counts=cnts[p], sub_w=sect_sub_w) for p in local}
+        if sect_u16:
+            sects = {p: s.with_idx_dtype(np.uint16)
+                     for p, s in sects.items()}
         first = sects[local[0]]
         sect_idx = tuple(
             put_parts(lambda p, s=s: sects[p].idx[s],
-                      (plan[s], seg, 8), np.int32)
+                      (plan[s], seg, sect_sub_w), idx_np_dtype)
             for s in range(len(first.idx)))
         sect_sub_dst = tuple(
             put_parts(lambda p, s=s: sects[p].sub_dst[s],

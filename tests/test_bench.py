@@ -86,3 +86,77 @@ def test_random_label_accuracy_is_labeled(tmp_path):
     assert small.get("labels") == "synthetic_random"
     assert "train_acc" not in small  # only the labeled keys remain
     assert "random_label_train_acc" in small
+
+
+def test_promotes_in_round_stage_record_when_all_stages_fail(tmp_path):
+    """When the relay cannot be claimed at snapshot time, the freshest
+    on-chip GCN stage record from bench_stages.jsonl is promoted into
+    the headline line with provenance="in_round_stage" — BENCH must
+    never be null while real on-chip records exist (VERDICT r4 #2)."""
+    import time as _time
+    now = _time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    rec = {"stage": "full", "t": now, "ok": True,
+           "result": {"platform": "tpu", "device_kind": "TPU v5 lite",
+                      "V": 232965, "E": 114848857,
+                      "layers": "602-256-41", "impl": "sectioned",
+                      "dtype": "mixed", "epoch_ms": 2359.25}}
+    base = {"full_graph_gcn_reddit_scale_epoch_time": {
+        "platform": "tpu", "dtype": "float32", "impl": "ell",
+        "epoch_ms": 7920.78, "recorded": "2026-07-29T21:07:04+0000"}}
+    (tmp_path / "bench_stages.jsonl").write_text(json.dumps(rec) + "\n")
+    (tmp_path / "measured_baselines.json").write_text(json.dumps(base))
+    # no --cpu: promotion is a tunnel-weather path; deadline too small
+    # for any stage so nothing ever touches a backend
+    r = _run(["--deadline", "1"], art_dir=str(tmp_path), timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = _last_json(r.stdout)
+    assert line["value"] == 2359.25
+    assert line["provenance"] == "in_round_stage"
+    assert line["vs_baseline"] == pytest.approx(7920.78 / 2359.25, rel=1e-3)
+    assert line["live_errors"]  # the real failure is still on record
+
+
+def test_cpu_run_never_promotes(tmp_path):
+    """--cpu failures are local bugs, not tunnel weather: the null
+    contract line must survive even with promotable records on disk."""
+    rec = {"stage": "full", "t": "2026-07-30T05:08:58+0000", "ok": True,
+           "result": {"platform": "tpu", "epoch_ms": 2359.25,
+                      "dtype": "mixed"}}
+    (tmp_path / "bench_stages.jsonl").write_text(json.dumps(rec) + "\n")
+    r = _run(["--cpu", "--stages", "small", "--deadline", "30"],
+             art_dir=str(tmp_path))
+    line = _last_json(r.stdout)
+    assert line["value"] is None
+
+
+def test_micro_only_run_never_promotes(tmp_path):
+    """Promotion must not fire for runs that never wanted a GCN stage:
+    a probe-failed micro-only run keeps the null contract line even
+    with promotable records on disk."""
+    rec = {"stage": "full", "t": "2026-07-30T05:08:58+0000", "ok": True,
+           "result": {"platform": "tpu", "epoch_ms": 2359.25,
+                      "dtype": "mixed"}}
+    (tmp_path / "bench_stages.jsonl").write_text(json.dumps(rec) + "\n")
+    r = _run(["--stages", "micro", "--deadline", "1"],
+             art_dir=str(tmp_path), timeout=120)
+    line = _last_json(r.stdout)
+    assert line["value"] is None
+    assert "provenance" not in line
+
+
+def test_stale_record_not_promoted(tmp_path):
+    """The stage log is append-only across rounds: records past the
+    promotion age window yield an honest null, never a replay of an
+    old round's number."""
+    rec = {"stage": "full", "t": "2026-07-01T05:08:58+0000", "ok": True,
+           "result": {"platform": "tpu", "epoch_ms": 2359.25,
+                      "dtype": "mixed"}}
+    (tmp_path / "bench_stages.jsonl").write_text(json.dumps(rec) + "\n")
+    r = _run(["--deadline", "1"], art_dir=str(tmp_path), timeout=120)
+    line = _last_json(r.stdout)
+    assert line["value"] is None
+    # ...unless the caller widens the window explicitly
+    r = _run(["--deadline", "1", "--promote-max-age-h", "100000"],
+             art_dir=str(tmp_path), timeout=120)
+    line = _last_json(r.stdout)
+    assert line["value"] == 2359.25

