@@ -209,18 +209,22 @@ def _ppid(pid: int) -> int:
 
 
 def _orphaned(pid: int) -> bool:
-    """The launching shell/session is gone: reparented to init — or to
-    a subreaper that adopts lost children (tmux server, systemd user
-    instance), which keeps ppid != 1 forever for the same situation."""
+    """The launching session is gone: reparented to init, or the
+    parent vanished mid-check.  Deliberately NOT extended to
+    comm-based subreaper heuristics (tmux server / systemd): `tmux
+    new-window 'python bench.py'` runs as a DIRECT live child of the
+    tmux server, so killing on that evidence would reap legitimate
+    measurements.  A corpse adopted by a subreaper is the accepted
+    gap — watch loops (the r03 starvation class) are reaped on age
+    alone regardless of parentage."""
     ppid = _ppid(pid)
     if ppid == 1:
         return True
     try:
-        with open(f"/proc/{ppid}/comm") as f:
-            comm = f.read().strip()
+        os.stat(f"/proc/{ppid}")
     except OSError:
         return True  # parent vanished between reads
-    return comm in ("tmux: server", "systemd", "init")
+    return False
 
 
 def _ancestors_and_self() -> set:

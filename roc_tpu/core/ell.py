@@ -347,6 +347,15 @@ SECTIONED_BOUNDS_BY_KIND = {
 _UNCALIBRATED_WARNED: set = set()
 
 
+def default_section_rows(sect_u16: bool = False) -> int:
+    """Default section size for the sectioned layout; uint16
+    section-local ids need the dummy id (== section size) to fit in
+    the dtype.  The ONE place for that rule — the single-device,
+    shard_dataset, and shard_dataset_local builders all call it."""
+    return min(SECTION_ROWS_DEFAULT, 65_535) if sect_u16 \
+        else SECTION_ROWS_DEFAULT
+
+
 def calibration_path() -> str:
     """Location of the measured-bounds JSON (calibrate.py writes it,
     sectioned_bounds reads it)."""

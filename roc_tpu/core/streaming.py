@@ -127,6 +127,108 @@ def _block_scatter_add(out, block, src_local, dst):
 _block_scatter_add_jit = jax.jit(_block_scatter_add, donate_argnums=(0,))
 
 
+@dataclass
+class _TilePlan:
+    """Edges of one (dst block, src block) adjacency tile."""
+    src_lo: int
+    src_local: np.ndarray   # int32 [E_t] source ids relative to src_lo
+    dst_local: np.ndarray   # int32 [E_t] dest ids relative to the dst
+    #                         block start (sorted)
+
+
+def build_tile_plans(graph: Graph, block_rows: int):
+    """dst-block -> list of per-src-block edge tiles (host-side, once).
+    The fully-out-of-core grouping: BOTH operands of each tile fit in
+    one block, so neither the feature matrix nor the output ever has to
+    be device-resident whole."""
+    dst_all = graph.edge_dst()
+    src_all = graph.col_idx
+    if not src_all.size:
+        return {}
+    db = dst_all // block_rows
+    sb = src_all // block_rows
+    order = np.lexsort((sb, db))
+    dst_s, src_s, db_s, sb_s = (dst_all[order], src_all[order],
+                                db[order], sb[order])
+    # tile boundaries in the lexsorted edge list
+    key = db_s.astype(np.int64) * (sb.max() + 1 if sb.size else 1) + sb_s
+    cut = np.flatnonzero(np.diff(key)) + 1
+    starts = np.concatenate([[0], cut])
+    ends = np.concatenate([cut, [key.shape[0]]])
+    tiles: dict = {}
+    for lo_e, hi_e in zip(starts, ends):
+        d, s = int(db_s[lo_e]), int(sb_s[lo_e])
+        sl = (src_s[lo_e:hi_e] - s * block_rows).astype(np.int32)
+        dl = (dst_s[lo_e:hi_e] - d * block_rows).astype(np.int32)
+        o = np.argsort(dl, kind="stable")
+        tiles.setdefault(d, []).append(_TilePlan(
+            src_lo=s * block_rows, src_local=sl[o], dst_local=dl[o]))
+    return tiles
+
+
+def aggregate_to_host(graph: Graph, feats_host: np.ndarray,
+                      block_rows: int = 65536,
+                      edge_chunk: int = 1 << 20,
+                      tiles=None) -> np.ndarray:
+    """Fully out-of-core CSR sum-aggregation: both the feature matrix
+    AND the result live in host RAM; the device holds one destination
+    accumulator block + one source feature block + an edge-chunk
+    transient.  This is the complete form of the reference's
+    stage-compute-writeback residency design (``types.cu:22-32``,
+    ``load_task.cu:365-374``): *every* [V, F] tensor is host-resident.
+    :class:`StreamingAggregator` (device-resident output) is the
+    faster tier when the output fits."""
+    V = graph.num_nodes
+    F = feats_host.shape[1]
+    if tiles is None:
+        tiles = build_tile_plans(graph, block_rows)
+    out = np.zeros((V, F), dtype=np.float32)
+    for d in sorted(tiles):
+        d_lo = d * block_rows
+        rows = min(block_rows, V - d_lo)
+        acc = jnp.zeros((rows, F), dtype=jnp.float32)
+        for t in tiles[d]:
+            block = jax.device_put(np.ascontiguousarray(
+                feats_host[t.src_lo:t.src_lo + block_rows])
+            ).astype(jnp.float32)
+            for e0 in range(0, t.src_local.shape[0], edge_chunk):
+                sl = jnp.asarray(t.src_local[e0:e0 + edge_chunk])
+                dl = jnp.asarray(t.dst_local[e0:e0 + edge_chunk])
+                acc = _block_scatter_add_jit(acc, block, sl, dl)
+        out[d_lo:d_lo + rows] = np.asarray(acc)
+    return out
+
+
+def stream_prefix_to_host(graph: Graph, prefix_ops,
+                          feats_host: np.ndarray,
+                          block_rows: int = 65536) -> np.ndarray:
+    """Evaluate a parameter-free norm/aggregation prefix (the op list
+    returned by ``Model.streamable_agg_head``) with every [V, F]
+    intermediate host-resident: ``indegree_norm`` is a host row
+    scaling, ``scatter_gather`` (SUM/AVG) runs through
+    :func:`aggregate_to_host`.  Returns fp32; runs ONCE per training
+    session — this is the SGC-style precompute (A_hat^k X), after which
+    epochs touch only the streamed head."""
+    from ..models.builder import AGGR_AVG, AGGR_SUM
+    x = np.asarray(feats_host, dtype=np.float32)
+    deg = np.asarray(graph.in_degree, dtype=np.float32)
+    inv_sqrt = np.where(deg > 0, 1.0 / np.sqrt(np.maximum(deg, 1.0)),
+                        0.0).astype(np.float32)[:, None]
+    tiles = None
+    for op in prefix_ops:
+        if op.kind == "indegree_norm":
+            x = x * inv_sqrt
+        elif op.kind == "scatter_gather":
+            if tiles is None:
+                tiles = build_tile_plans(graph, block_rows)
+            x = aggregate_to_host(graph, x, block_rows, tiles=tiles)
+            if op.attrs.get("aggr", AGGR_SUM) == AGGR_AVG:
+                x = x / np.maximum(deg, 1.0)[:, None]
+        else:  # pragma: no cover - guarded by streamable_agg_head
+            raise NotImplementedError(op.kind)
+    return x
+
+
 class StreamedHead:
     """First model layer (``dropout -> linear``) computed from
     host-resident features, with the matching streamed weight gradient.

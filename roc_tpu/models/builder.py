@@ -473,6 +473,62 @@ class Model:
                          if self._loss_op is not None else None)
         return ops[1].attrs["rate"], ops[2].param, tail
 
+    def streamable_agg_head(self):
+        """``(prefix_ops, dropout_rate, linear_param, tail_model)``
+        when the op list starts with a PARAMETER-FREE norm/aggregation
+        chain from the input — ``(indegree_norm | scatter_gather
+        SUM/AVG)+`` — followed by the ``dropout -> linear`` head
+        pattern, with nothing later consuming the pre-head tensors.
+
+        This is the SGC-family shape (aggregation applied to raw
+        features, models/sgc.py): the prefix has no parameters, so the
+        host tier evaluates it ONCE fully out-of-core
+        (core/streaming.py stream_prefix_to_host — the reference's
+        everything-host-resident ZC design, ``types.cu:22-32``) and
+        every epoch then streams only the dropout/linear head.
+        Returns None when there is no aggregation prefix (plain
+        ``streamable_head`` covers that) or the shape doesn't match."""
+        ops = self._ops
+        i = 1
+        while i < len(ops) and ops[i].inputs == (i - 1,) and (
+                ops[i].kind == "indegree_norm"
+                or (ops[i].kind == "scatter_gather"
+                    and ops[i].attrs.get("aggr", AGGR_SUM)
+                    in (AGGR_SUM, AGGR_AVG))):
+            i += 1
+        if i == 1 or not any(op.kind == "scatter_gather"
+                             for op in ops[1:i]):
+            return None
+        if i + 1 >= len(ops):
+            return None
+        if not (ops[i].kind == "dropout" and ops[i].inputs == (i - 1,)):
+            return None
+        if not (ops[i + 1].kind == "linear"
+                and ops[i + 1].inputs == (i,)):
+            return None
+        if ops[i + 1].attrs.get("activation",
+                                AC_MODE_NONE) != AC_MODE_NONE:
+            return None
+        head_out = i + 1
+        for op in ops[head_out + 1:]:
+            if any(j < head_out for j in op.inputs):
+                return None
+        # loss ON the head output is fine (classic SGC: the head linear
+        # IS the classifier) — the tail degenerates to loss-on-input
+        if self._loss_op is not None and self._loss_op < head_out:
+            return None
+        tail = Model(in_dim=ops[head_out].dim)
+        for op in ops[head_out + 1:]:
+            tail._ops.append(_Op(
+                op.kind,
+                tuple(0 if j == head_out else j - head_out
+                      for j in op.inputs),
+                op.dim, op.param, dict(op.attrs)))
+        tail._loss_op = (self._loss_op - head_out
+                         if self._loss_op is not None else None)
+        return (list(ops[1:i]), ops[i].attrs["rate"],
+                ops[i + 1].param, tail)
+
     # ---- params ----
 
     def init_params(self, key: jax.Array,

@@ -1,0 +1,44 @@
+"""SGC model family: Simple Graph Convolution (Wu et al., ICML'19).
+
+``logits = softmax(S^k X W)`` with ``S = D^-1/2 A D^-1/2`` (self edges
+pre-added, the same symmetric normalization as the reference's GCN
+stack, ``gnn.cc:78-91``) — all k aggregation hops applied to the RAW
+features, then one linear classifier.  The reference has no such
+model; SGC completes the zoo with the family whose shape makes the
+full out-of-core tier exact: the aggregation prefix has no parameters,
+so under ``TrainConfig(features='host')`` the trainer evaluates
+``S^k X`` ONCE with every [V, F] tensor host-resident
+(``core/streaming.py stream_prefix_to_host`` — the complete analog of
+the reference's zero-copy residency design, ``types.cu:22-32``) and
+each epoch streams only the dropout/linear head.
+
+``layers`` follows the CLI convention: ``layers[0]`` is the input
+feature dim, ``layers[-1]`` the class count; intermediate entries add
+ReLU-separated linear layers after the propagation (the "SGC + MLP"
+variant — classic SGC is ``layers=[F, C]``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .builder import Model
+from ..ops.dense import AC_MODE_NONE
+
+
+def build_sgc(layers: Sequence[int], k: int = 2,
+              dropout_rate: float = 0.0) -> Model:
+    model = Model(in_dim=layers[0])
+    t = model.input()
+    for _ in range(k):
+        t = model.indegree_norm(t)
+        t = model.scatter_gather(t)
+        t = model.indegree_norm(t)
+    n = len(layers)
+    for i in range(1, n):
+        t = model.dropout(t, dropout_rate)
+        t = model.linear(t, layers[i], AC_MODE_NONE)
+        if i != n - 1:
+            t = model.relu(t)
+    model.softmax_cross_entropy(t)
+    return model

@@ -1,0 +1,209 @@
+"""Block-dense MXU aggregation: tiled-adjacency SpMM for community graphs.
+
+The measured sectioned/ELL gather is ROW-RATE bound on v5e (~7 ns per
+edge, width-insensitive below F=256 — BASELINE.md "where the epoch
+goes"), i.e. the chip's gather unit, not HBM bytes, sets the 98%-of-
+epoch aggregation cost.  The MXU escape hatch (VERDICT r4 #1): tile
+the adjacency over the vertex id space into ``[128, 128]`` blocks and
+aggregate every sufficiently-filled block as one bf16 batched matmul
+
+    out[dst_tile] += A_tile @ x[src_tile]        (A_tile: [128, 128])
+
+leaving the scattered residual edges to the sectioned gather.  Per
+dense block the cost is pure bandwidth — A (uint8, cast on device) +
+one source tile read + one fp32 output-tile update, ~0.2 us at F=256 —
+so a block pays off past roughly
+
+    fill* ~ 0.2us / 7ns ~ 30..64 edges per 128x128 block (<0.4% fill)
+
+while a uniform-random graph at Reddit scale puts only
+``E * 128^2 / V^2 ~ 35`` edges in a block (and spreads A over V^2/128^2
+tiles, whose reads then dominate).  The path therefore targets graphs
+with COMMUNITY structure exposed by the vertex order (real Reddit is
+community-generated; ``core/reorder.py`` / the planted-community
+generator's oracle order model the ordering quality) — ``plan_blocks``
+reports the occupancy stats that decide it, and
+``benchmarks/micro_agg.py --impls bdense`` races it.
+
+Reference cost model being attacked: the one-thread-per-edge atomic
+CSR kernel ``/root/reference/scattergather_kernel.cu:20-76``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+BLOCK = 128          # MXU-native tile edge
+_CHUNK_BLOCKS = 256  # blocks per scan step: bounds the [C,128,F] transient
+
+
+@dataclass
+class BlockPlan:
+    """Host-built dense-tile layout + residual CSR (static per graph).
+
+    a_blocks: uint8 [nblk, 128, 128] edge multiplicities (the planted
+      generators emit duplicate edges; segment-sum semantics require
+      counts, not 0/1).
+    src_blk/dst_blk: int32 [nblk] tile ids, sorted by dst_blk (the
+      output scatter-add sees sorted indices).
+    res_row_ptr/res_col: the residual dst-major CSR (edges in blocks
+      under ``min_fill`` + multiplicities over 255), aggregated by the
+      caller through the sectioned/ELL path.
+    """
+    num_rows: int
+    vpad: int
+    a_blocks: np.ndarray
+    src_blk: np.ndarray
+    dst_blk: np.ndarray
+    res_row_ptr: np.ndarray
+    res_col: np.ndarray
+    dense_edges: int
+    total_edges: int
+
+    @property
+    def n_blocks(self) -> int:
+        return int(self.a_blocks.shape[0])
+
+    def occupancy(self) -> dict:
+        """The stats that decide whether this path can win (recorded
+        with every race row)."""
+        nb = self.n_blocks
+        return {
+            "n_blocks": nb,
+            "dense_edges": int(self.dense_edges),
+            "dense_frac": round(self.dense_edges
+                                / max(self.total_edges, 1), 4),
+            "mean_fill": round(self.dense_edges / max(nb, 1), 1),
+            "a_bytes": int(nb) * BLOCK * BLOCK,
+        }
+
+
+def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
+                num_rows: int, min_fill: int = 64) -> BlockPlan:
+    """Tile the dst-major CSR into [128, 128] blocks; blocks with at
+    least ``min_fill`` edges go dense, the rest stay residual CSR."""
+    row_ptr = np.asarray(row_ptr, dtype=np.int64)
+    col_idx = np.asarray(col_idx, dtype=np.int64)
+    E = col_idx.shape[0]
+    vpad = -(-num_rows // BLOCK) * BLOCK
+    deg = np.diff(row_ptr)
+    dst_all = np.repeat(np.arange(num_rows, dtype=np.int64), deg)
+    key = (dst_all // BLOCK) * (vpad // BLOCK) + col_idx // BLOCK
+    order = np.argsort(key, kind="stable")
+    key_s = key[order]
+    blocks, starts, counts = np.unique(key_s, return_index=True,
+                                       return_counts=True)
+    dense_sel = counts >= min_fill
+    dense_blocks = blocks[dense_sel]
+    nblk = int(dense_blocks.shape[0])
+    a = np.zeros((nblk, BLOCK, BLOCK), dtype=np.uint8)
+    if nblk:
+        pos = np.searchsorted(dense_blocks, key_s)
+        pos_c = np.minimum(pos, nblk - 1)
+        in_dense = dense_blocks[pos_c] == key_s
+    else:
+        in_dense = np.zeros(E, dtype=bool)
+    e_sel = order[in_dense]
+    if nblk:
+        flat = (pos_c[in_dense] * BLOCK * BLOCK
+                + (dst_all[e_sel] % BLOCK) * BLOCK
+                + (col_idx[e_sel] % BLOCK))
+        # uint8 multiplicity with saturation: overflowing edges (deep
+        # duplicates past 255) fall back to the residual CSR so the
+        # semantics stay exact
+        cnt = np.bincount(flat, minlength=nblk * BLOCK * BLOCK)
+        over = cnt > 255
+        a.reshape(-1)[:] = np.minimum(cnt, 255).astype(np.uint8)
+        dense_edges = int(np.minimum(cnt, 255).sum())
+        overflow_edges = int((cnt - np.minimum(cnt, 255)).sum())
+    else:
+        dense_edges = 0
+        overflow_edges = 0
+        over = np.zeros(0, dtype=bool)
+    # residual = all edges not counted densely
+    res_mask = np.ones(E, dtype=bool)
+    res_mask[e_sel] = False
+    if overflow_edges:
+        # keep the overflow multiplicities: re-add edges whose flat
+        # slot saturated (rare pathological duplicates)
+        over_slots = np.flatnonzero(over)
+        slot_excess = (cnt[over_slots] - 255).astype(np.int64)
+        # mark the LAST `excess` duplicate edges of each slot residual
+        flat_order = np.argsort(flat, kind="stable")
+        flat_sorted = flat[flat_order]
+        s0 = np.searchsorted(flat_sorted, over_slots, side="left")
+        s1 = np.searchsorted(flat_sorted, over_slots, side="right")
+        for lo, hi, ex in zip(s0, s1, slot_excess):
+            res_mask[e_sel[flat_order[hi - ex:hi]]] = True
+    res_dst = dst_all[res_mask]
+    res_col = col_idx[res_mask]
+    res_deg = np.bincount(res_dst, minlength=num_rows)
+    res_ptr = np.zeros(num_rows + 1, dtype=np.int64)
+    np.cumsum(res_deg, out=res_ptr[1:])
+    # residual edges arrive dst-sorted already (dst_all is sorted)
+    return BlockPlan(
+        num_rows=num_rows, vpad=vpad,
+        a_blocks=a,
+        src_blk=(dense_blocks % (vpad // BLOCK)).astype(np.int32),
+        dst_blk=(dense_blocks // (vpad // BLOCK)).astype(np.int32),
+        res_row_ptr=res_ptr, res_col=res_col.astype(np.int32),
+        dense_edges=dense_edges, total_edges=E)
+
+
+def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
+                          src_blk: jax.Array, dst_blk: jax.Array,
+                          num_rows: int, vpad: int,
+                          out_dtype=jnp.float32,
+                          chunk_blocks: int = _CHUNK_BLOCKS
+                          ) -> jax.Array:
+    """Dense-tile partial aggregation (the residual CSR is the
+    caller's, via the sectioned/ELL path on the SAME x).
+
+    x: [num_rows(+1), F] features (trailing rows ignored).
+    Returns [num_rows, F] in ``out_dtype`` — fp32 accumulation over
+    tiles (a hub tile receives many sequential adds).
+    """
+    F = x.shape[1]
+    nblk = a_blocks.shape[0]
+    n_tiles = vpad // BLOCK
+    xt = jnp.zeros((vpad, F), dtype=x.dtype).at[:num_rows].set(
+        x[:num_rows]).reshape(n_tiles, BLOCK, F)
+    # pad the block list to a chunk multiple; padding scatters zero
+    # tiles into a dummy output tile
+    chunks = max(1, -(-nblk // chunk_blocks))
+    pad = chunks * chunk_blocks - nblk
+    a_p = jnp.concatenate([
+        a_blocks,
+        jnp.zeros((pad, BLOCK, BLOCK), dtype=a_blocks.dtype)]) \
+        if pad else a_blocks
+    s_p = jnp.concatenate([src_blk,
+                           jnp.zeros(pad, dtype=src_blk.dtype)]) \
+        if pad else src_blk
+    d_p = jnp.concatenate([dst_blk,
+                           jnp.full(pad, n_tiles, dtype=dst_blk.dtype)]) \
+        if pad else dst_blk
+    compute = (jnp.bfloat16 if x.dtype in (jnp.bfloat16,)
+               else jnp.float32)
+
+    def body(out, ch):
+        a_u8, s_ids, d_ids = ch
+        gx = xt[s_ids].astype(compute)              # [C, 128, F]
+        y = jnp.einsum("bij,bjf->bif", a_u8.astype(compute), gx,
+                       preferred_element_type=jnp.float32)
+        # several blocks can share a dst tile within one chunk -> NOT
+        # unique; the plan's dst-major sort keeps them sorted
+        return out.at[d_ids].add(y, indices_are_sorted=True), None
+
+    out0 = jnp.zeros((n_tiles + 1, BLOCK, F), dtype=jnp.float32)
+    C = chunk_blocks
+    out, _ = lax.scan(
+        body, out0,
+        (a_p.reshape(chunks, C, BLOCK, BLOCK),
+         s_p.reshape(chunks, C), d_p.reshape(chunks, C)))
+    return out[:n_tiles].reshape(vpad, F)[:num_rows].astype(out_dtype)

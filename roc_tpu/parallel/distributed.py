@@ -130,7 +130,11 @@ class ShardedData:
     ring_idx: Tuple[jax.Array, ...] = ()  # (src, dst) [P, S, pair_edges]
     # sectioned layout (aggr_impl == "sectioned"): per section
     # [P, n_chunks_s, seg_rows, 8] / [P, n_chunks_s, seg_rows], plus
-    # the static (start, size) metadata
+    # the static (start, size) metadata.  For aggr_impl ==
+    # "attn_flat8" the same slots carry the SINGLE-section uniform
+    # width-8 attention tables (ids in gathered coordinates, dummy ==
+    # P*part_nodes; the step body routes them to GraphContext
+    # flat8_idx/flat8_dst)
     sect_idx: Tuple[jax.Array, ...] = ()
     sect_sub_dst: Tuple[jax.Array, ...] = ()
     sect_meta: Tuple[Tuple[int, int], ...] = ()
@@ -175,7 +179,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
     else:
         col_padded = remap_to_padded(pg)
-        if aggr_impl in ("ell", "pallas", "sectioned"):
+        if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8"):
             # table-driven paths never read the flat edge arrays —
             # upload stubs instead of two [P, E_p] tensors
             edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
@@ -192,13 +196,10 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
             ell_row_pos = put(table.row_pos)
             ell_row_id = tuple(put(a) for a in table.row_id)
         elif aggr_impl == "sectioned":
-            from ..core.ell import (SECTION_ROWS_DEFAULT,
+            from ..core.ell import (default_section_rows,
                                     sectioned_from_padded_parts)
             if section_rows is None:
-                # u16 section-local ids need the dummy id to fit
-                # (same rule as the single-device path)
-                section_rows = (min(SECTION_ROWS_DEFAULT, 65_535)
-                                if sect_u16 else SECTION_ROWS_DEFAULT)
+                section_rows = default_section_rows(sect_u16)
             sect = sectioned_from_padded_parts(
                 pg.part_row_ptr, col_padded, pg.real_nodes,
                 pg.part_nodes,
@@ -209,7 +210,21 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
             sect_idx = tuple(put(a) for a in sect.idx)
             sect_sub_dst = tuple(put(a) for a in sect.sub_dst)
             sect_meta = tuple(zip(sect.sec_starts, sect.sec_sizes))
-        if aggr_impl in ("ell", "pallas", "sectioned"):
+        elif aggr_impl == "attn_flat8":
+            # large-graph attention, sharded: per-partition SINGLE-
+            # section tables over gathered coordinates (one uniform
+            # scan shape per device — the same compile-size fix as the
+            # single-chip path, train/trainer.py make_graph_context).
+            # seg_rows 8192 bounds the per-chunk transient like there.
+            from ..core.ell import sectioned_from_padded_parts
+            src_rows = pg.num_parts * pg.part_nodes
+            sect = sectioned_from_padded_parts(
+                pg.part_row_ptr, col_padded, pg.real_nodes,
+                pg.part_nodes, src_rows=src_rows,
+                section_rows=src_rows, seg_rows=8192)
+            sect_idx = tuple(put(a) for a in sect.idx)
+            sect_sub_dst = tuple(put(a) for a in sect.sub_dst)
+        if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8"):
             col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
@@ -287,15 +302,11 @@ class DistributedTrainer:
                 aggr_impl=resolve_auto_impl(
                     v, out_rows=-(-v // num_parts)))
         from ..train.trainer import resolve_attention_impl
-        # no dataset passed: the distributed attention path keeps the
-        # per-width ELL tables (shard_dataset builds no flat8 layout;
-        # the compile-size boundary is a single-chip concern — the
-        # products-scale GAT config runs one chip, BASELINE.md #7)
-        config = resolve_attention_impl(model, config)
-        if config.aggr_impl == "attn_flat8":
-            raise NotImplementedError(
-                "aggr_impl='attn_flat8' is single-device; distributed "
-                "attention uses aggr_impl='ell'")
+        # dataset passed: attention models past ATTN_FLAT8_MIN_EDGES
+        # auto-route to the uniform flat8 layout here too —
+        # multi-chip attention at >=20M edges would otherwise re-hit
+        # the per-width-bucket compile wall (VERDICT r4 weak #3)
+        config = resolve_attention_impl(model, config, dataset)
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0
@@ -304,6 +315,13 @@ class DistributedTrainer:
         if pg is not None and pg.num_parts != num_parts:
             raise ValueError(f"injected pg has {pg.num_parts} parts, "
                              f"trainer was asked for {num_parts}")
+        if data is not None and pg is None:
+            # re-partitioning here could use different padding
+            # multiples than the caller's table build — the tables
+            # would silently stop corresponding to the feats sharding
+            raise ValueError(
+                "pass pg= alongside data= (the SAME PartitionedGraph "
+                "the tables were built from)")
         self.pg = pg if pg is not None else partition_graph(
             dataset.graph, num_parts,
             node_multiple=8, edge_multiple=config.chunk)
@@ -326,12 +344,14 @@ class DistributedTrainer:
                     "shard_dataset_local(..., halo='ring') or pass "
                     "memory/halo explicitly)")
             if config.halo != "ring":
-                if config.aggr_impl == "sectioned" \
+                if config.aggr_impl in ("sectioned", "attn_flat8") \
                         and not self.data.sect_idx:
                     raise ValueError(
-                        "injected data has no sectioned tables but the "
-                        "resolved aggr_impl is 'sectioned' — build it "
-                        "with aggr_impl='sectioned'")
+                        f"injected data has no sectioned/flat8 tables "
+                        f"but the resolved aggr_impl is "
+                        f"{config.aggr_impl!r} — build it with the "
+                        f"same aggr_impl (note: attention models at "
+                        f">=20M edges auto-route to 'attn_flat8')")
                 if config.aggr_impl in ("ell", "pallas") \
                         and not self.data.ell_idx:
                     raise ValueError(
@@ -339,6 +359,20 @@ class DistributedTrainer:
                         f"resolved aggr_impl is "
                         f"{config.aggr_impl!r} — build it with "
                         f"aggr_impl='ell'")
+                if config.aggr_impl in ("segment", "blocked", "scan",
+                                        "pallas_csr") and \
+                        self.data.edge_dst.shape[-1] != \
+                        self.pg.part_edges:
+                    # table-built data carries 1-element edge stubs; a
+                    # flat-edge impl would silently aggregate one fake
+                    # 0->0 edge per part
+                    raise ValueError(
+                        f"injected data carries edge stubs "
+                        f"(shape {tuple(self.data.edge_dst.shape)}) "
+                        f"but the resolved aggr_impl "
+                        f"{config.aggr_impl!r} reads the flat edge "
+                        f"arrays — build the data with the same "
+                        f"aggr_impl")
         if config.halo == "ring" and config.verbose:
             # startup echo like the reference's config print
             # (gnn.cc:48-60): make the SPMD padding cost visible, and
@@ -382,6 +416,27 @@ class DistributedTrainer:
             sect_meta=self.data.sect_meta,
         )
 
+    def _local_gctx(self, edge_src, edge_dst, in_degree, ell_idx,
+                    ell_row_pos, ell_row_id, ring_idx, sect_idx,
+                    sect_sub_dst) -> GraphContext:
+        """Local-block GraphContext for a shard_map body: slice the
+        parts axis off every table.  attn_flat8 carries its single-
+        section tables in the sect slots (ShardedData docstring) and
+        routes them to the flat8 fields the builder reads."""
+        flat8 = self.config.aggr_impl == "attn_flat8"
+        return dc_replace(
+            self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
+            in_degree=in_degree,
+            ell_idx=tuple(a[0] for a in ell_idx),
+            ell_row_pos=ell_row_pos[0],
+            ell_row_id=tuple(a[0] for a in ell_row_id),
+            ring_idx=tuple(a[0] for a in ring_idx),
+            sect_idx=() if flat8 else tuple(a[0] for a in sect_idx),
+            sect_sub_dst=(() if flat8
+                          else tuple(a[0] for a in sect_sub_dst)),
+            flat8_idx=sect_idx[0][0] if flat8 else None,
+            flat8_dst=sect_sub_dst[0][0] if flat8 else None)
+
     def _build_train_step(self):
         mesh = self.mesh
         spec_p = P("parts")
@@ -392,17 +447,10 @@ class DistributedTrainer:
                  ring_idx, sect_idx, sect_sub_dst, key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
-            edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
-                                             in_degree[0])
-            gctx = dc_replace(
-                self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
-                in_degree=in_degree,
-                ell_idx=tuple(a[0] for a in ell_idx),
-                ell_row_pos=ell_row_pos[0],
-                ell_row_id=tuple(a[0] for a in ell_row_id),
-                ring_idx=tuple(a[0] for a in ring_idx),
-                sect_idx=tuple(a[0] for a in sect_idx),
-                sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
+            gctx = self._local_gctx(
+                edge_src[0], edge_dst[0], in_degree[0], ell_idx,
+                ell_row_pos, ell_row_id, ring_idx, sect_idx,
+                sect_sub_dst)
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
@@ -443,17 +491,9 @@ class DistributedTrainer:
         all_gather) both build on this, so the gctx wiring exists in
         ONE place."""
         feats = feats[0]
-        edge_src, edge_dst, in_degree = (edge_src[0], edge_dst[0],
-                                         in_degree[0])
-        gctx = dc_replace(
-            self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
-            in_degree=in_degree,
-            ell_idx=tuple(a[0] for a in ell_idx),
-            ell_row_pos=ell_row_pos[0],
-            ell_row_id=tuple(a[0] for a in ell_row_id),
-            ring_idx=tuple(a[0] for a in ring_idx),
-            sect_idx=tuple(a[0] for a in sect_idx),
-            sect_sub_dst=tuple(a[0] for a in sect_sub_dst))
+        gctx = self._local_gctx(
+            edge_src[0], edge_dst[0], in_degree[0], ell_idx,
+            ell_row_pos, ell_row_id, ring_idx, sect_idx, sect_sub_dst)
         return self.model.apply(cast_floats(params, self.compute),
                                 feats, gctx, key=None, train=False)
 

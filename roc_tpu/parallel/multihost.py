@@ -245,7 +245,7 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     # edge_src field and the ELL table build
     cols = {p: remap_col_to_padded(pg, partition_col(pg, src.col_slice, p))
             for p in local}
-    use_stub = aggr_impl in ("ell", "pallas", "sectioned")
+    use_stub = aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8")
 
     def edge_src_build(p):
         return cols[p]
@@ -288,19 +288,38 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     sect_idx = ()
     sect_sub_dst = ()
     sect_meta = ()
-    if aggr_impl == "sectioned":
+    if aggr_impl == "attn_flat8":
+        # large-graph attention tables, partition-local: ONE section
+        # spanning all gathered sources (same layout shard_dataset
+        # builds; DistributedTrainer routes these to the flat8 gctx
+        # fields), chunk plan agreed via the O(P) collective
+        from ..core.ell import (clean_part_ptr, section_sub_counts,
+                                sectioned_from_graph, sectioned_plan)
+        src_rows = P * pn
+        ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
+                                  pn) for p in local}
+        cnts = {p: section_sub_counts(
+            ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows,
+            src_rows) for p in local}
+        counts_max = _allreduce_part_vec_max(mesh, local, cnts)
+        seg, plan = sectioned_plan(counts_max, seg_rows=8192)
+        sects = {p: sectioned_from_graph(
+            ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows=src_rows,
+            section_rows=src_rows, seg_rows=seg, chunks_plan=plan,
+            counts=cnts[p]) for p in local}
+        sect_idx = (put_parts(lambda p: sects[p].idx[0],
+                              (plan[0], seg, 8), np.int32),)
+        sect_sub_dst = (put_parts(lambda p: sects[p].sub_dst[0],
+                                  (plan[0], seg), np.int32),)
+    elif aggr_impl == "sectioned":
         # uniform chunk plan from an O(P * n_sec) elementwise-max
         # collective over per-part sub-row counts — same agreement
         # pattern as the ring's pair width, never a whole-graph pass
-        from ..core.ell import (SECTION_ROWS_DEFAULT, clean_part_ptr,
+        from ..core.ell import (clean_part_ptr, default_section_rows,
                                 section_sub_counts, sectioned_from_graph,
                                 sectioned_plan)
-        if section_rows is None:
-            # u16 section-local ids need the dummy id to fit — same
-            # rule as the single-device and shard_dataset paths
-            section_rows = (min(SECTION_ROWS_DEFAULT, 65_535)
-                            if sect_u16 else SECTION_ROWS_DEFAULT)
-        sec_rows = section_rows
+        sec_rows = (section_rows if section_rows is not None
+                    else default_section_rows(sect_u16))
         idx_np_dtype = np.uint16 if sect_u16 else np.int32
         src_rows = P * pn
         ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],

@@ -46,12 +46,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("-seed", type=int, default=1)
     ap.add_argument("-verbose", "-v", action="store_true")
     # TPU-era flags
-    ap.add_argument("--model", choices=["gcn", "sage", "gin", "gat"],
+    ap.add_argument("--model",
+                    choices=["gcn", "sage", "gin", "gat", "sgc"],
                     default="gcn")
     ap.add_argument("--heads", type=int, default=1,
                     help="attention heads for --model gat (hidden "
                          "dims must divide by it; output layer stays "
                          "single-head)")
+    ap.add_argument("--hops", type=int, default=2,
+                    help="for --model sgc: propagation depth k "
+                         "(logits = softmax(S^k X W))")
     ap.add_argument("--learn-eps", action="store_true",
                     help="for --model gin: learnable per-layer "
                          "epsilon self-weight (zero-init GIN-0) "
@@ -186,11 +190,14 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
           f"impl={args.impl}", file=sys.stderr)
 
+    from ..models.sgc import build_sgc
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
-             "gat": build_gat}
+             "gat": build_gat, "sgc": build_sgc}
     kwargs = {"heads": args.heads} if args.model == "gat" else {}
     if args.model == "gin" and args.learn_eps:
         kwargs["learn_eps"] = True
+    if args.model == "sgc":
+        kwargs["k"] = args.hops
     model = build[args.model](layers, dropout_rate=args.dropout,
                               **kwargs)
     dt, cdt = resolve_dtypes(args.dtype)

@@ -242,7 +242,8 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
         num_parts=num_parts,
         dtype_bytes=jnp.dtype(compute_dtype_of(config)).itemsize,
         hbm_bytes=config.hbm_bytes,
-        head_streamable=model.streamable_head() is not None,
+        head_streamable=(model.streamable_head() is not None
+                         or model.streamable_agg_head() is not None),
         remat_policy=config.remat_policy)
     if config.verbose:
         print(plan.echo(), file=sys.stderr)
@@ -291,13 +292,11 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         ell_row_pos = jnp.asarray(table.row_pos[0])
         ell_row_id = tuple(jnp.asarray(a[0]) for a in table.row_id)
     elif aggr_impl == "sectioned":
-        from ..core.ell import (SECTION_ROWS_DEFAULT,
-                                sectioned_from_graph)
-        sec_rows = (min(SECTION_ROWS_DEFAULT, 65_535) if sect_u16
-                    else SECTION_ROWS_DEFAULT)
-        sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes,
-                                    section_rows=sec_rows,
-                                    sub_w=sect_sub_w)
+        from ..core.ell import default_section_rows, sectioned_from_graph
+        sect = sectioned_from_graph(
+            g.row_ptr, g.col_idx, g.num_nodes,
+            section_rows=default_section_rows(sect_u16),
+            sub_w=sect_sub_w)
         if sect_u16:
             sect = sect.with_idx_dtype(np.uint16)
         sect_idx, sect_sub_dst, sect_meta = sect.as_jax()
@@ -346,11 +345,6 @@ class Trainer:
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0
-        self.gctx = make_graph_context(dataset, config.aggr_impl,
-                                       config.chunk,
-                                       symmetric=config.symmetric,
-                                       sect_sub_w=config.sect_sub_w,
-                                       sect_u16=config.sect_u16)
         self.labels = jnp.asarray(dataset.labels)
         self.mask = jnp.asarray(dataset.mask)
         key = jax.random.PRNGKey(config.seed)
@@ -364,25 +358,41 @@ class Trainer:
             # host-resident features streamed through the first layer
             # (the reference's ZC tier, types.cu:22-32)
             head = model.streamable_head()
+            prefix_ops = None
             if head is None:
-                raise NotImplementedError(
-                    "features='host' needs a streamable model head "
-                    "(input -> dropout -> linear with no other "
-                    "consumer; Model.streamable_head).  This model's "
-                    "first layer consumes raw features elsewhere — use "
-                    "features='hbm', or partition with --parts/halo="
-                    "'ring' to shrink per-device residency")
-            rate, self._head_param, self._tail_model = head
+                # second shape the tier serves: a parameter-free
+                # aggregation prefix (SGC family) evaluated ONCE fully
+                # out-of-core, then the same streamed dropout/linear
+                agg = model.streamable_agg_head()
+                if agg is None:
+                    raise NotImplementedError(
+                        "features='host' needs a streamable model head "
+                        "(input -> dropout -> linear, Model."
+                        "streamable_head) or an aggregation-prefix "
+                        "head (norm/aggregate chain -> dropout -> "
+                        "linear, Model.streamable_agg_head).  This "
+                        "model's first layer consumes raw features "
+                        "elsewhere — use features='hbm', or partition "
+                        "with --parts/halo='ring' to shrink per-device "
+                        "residency")
+                (prefix_ops, rate, self._head_param,
+                 self._tail_model) = agg
+            else:
+                rate, self._head_param, self._tail_model = head
             from ..core.streaming import StreamedHead
             self._head = StreamedHead(rate)
+            feats_np = np.asarray(dataset.features)
+            if prefix_ops is not None:
+                from ..core.streaming import stream_prefix_to_host
+                feats_np = stream_prefix_to_host(
+                    dataset.graph, prefix_ops, feats_np)
             # host copy in the COMPUTE dtype (ml_dtypes bf16 under
             # mixed): device_put then ships 2-byte blocks — the
             # host-link transfer is this tier's dominant per-epoch
             # cost, so staging fp32 and casting on device would
             # forfeit half the mode's bandwidth win
             self.feats_host = np.ascontiguousarray(
-                np.asarray(dataset.features).astype(
-                    jnp.dtype(self.compute), copy=False))
+                feats_np.astype(jnp.dtype(self.compute), copy=False))
             self.feats = None
             self._tail_grad = jax.jit(self._tail_grad_impl)
             self._tail_eval = jax.jit(self._tail_eval_impl)
@@ -391,6 +401,29 @@ class Trainer:
         else:
             self.feats = jnp.asarray(dataset.features,
                                      dtype=self.compute)
+        if self._head is not None and not any(
+                op.kind in ("scatter_gather", "gat")
+                for op in self._tail_model._ops):
+            # the model's whole graph part ran in the host-side
+            # precompute (SGC): don't build O(E) tables nobody reads
+            from ..models.builder import GraphContext
+            g = dataset.graph
+            self.gctx = GraphContext(
+                edge_src=jnp.zeros(1, jnp.int32),
+                edge_dst=jnp.zeros(1, jnp.int32),
+                in_degree=jnp.asarray(g.in_degree),
+                num_rows=g.num_nodes, gathered_rows=g.num_nodes,
+                aggr_impl="segment", chunk=config.chunk,
+                # only the scatter_gather VJP reads symmetric, and this
+                # branch is taken only when the tail has none — a
+                # constant avoids check_symmetric's O(E log E) sort
+                symmetric=True)
+        else:
+            self.gctx = make_graph_context(dataset, config.aggr_impl,
+                                           config.chunk,
+                                           symmetric=config.symmetric,
+                                           sect_sub_w=config.sect_sub_w,
+                                           sect_u16=config.sect_u16)
         # Dataset tensors are jitted *arguments*, not closure captures:
         # capturing them would embed a second copy of the feature matrix
         # as an executable constant and recompile per Trainer instance

@@ -1,0 +1,98 @@
+"""Block-dense MXU aggregation (ops/blockdense.py): plan + kernel
+correctness against the segment-sum reference, occupancy accounting,
+and the residual split's exactness."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from roc_tpu.core.graph import planted_community_csr, random_csr
+from roc_tpu.ops.aggregate import aggregate_segment
+from roc_tpu.ops.blockdense import (BLOCK, aggregate_block_dense,
+                                    plan_blocks)
+
+
+def _reference(g, x):
+    deg = np.diff(g.row_ptr)
+    dst = np.repeat(np.arange(g.num_nodes, dtype=np.int64), deg)
+    src, dstj = jnp.asarray(g.col_idx), jnp.asarray(dst)
+    xp = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)])
+    return np.asarray(aggregate_segment(xp, src, dstj, g.num_nodes))
+
+
+def _dense_plus_residual(g, x, plan):
+    out = np.asarray(aggregate_block_dense(
+        x, jnp.asarray(plan.a_blocks), jnp.asarray(plan.src_blk),
+        jnp.asarray(plan.dst_blk), g.num_nodes, plan.vpad,
+        chunk_blocks=4))
+    # residual through the plain segment path
+    res_deg = np.diff(plan.res_row_ptr)
+    rdst = np.repeat(np.arange(g.num_nodes, dtype=np.int64), res_deg)
+    if rdst.size:
+        xp = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)])
+        out = out + np.asarray(aggregate_segment(
+            xp, jnp.asarray(plan.res_col), jnp.asarray(rdst),
+            g.num_nodes))
+    return out
+
+
+@pytest.mark.parametrize("min_fill", [1, 8, 10**9])
+def test_block_dense_plus_residual_matches_reference(min_fill):
+    """dense tiles + residual CSR == the plain segment sum, at every
+    split point (all-dense, mixed, all-residual)."""
+    g = planted_community_csr(500, 6000, community_rows=BLOCK,
+                              shuffle=False, seed=3)
+    plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
+                       min_fill=min_fill)
+    assert plan.dense_edges + plan.res_col.shape[0] == g.num_edges
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(g.num_nodes, 24).astype(np.float32))
+    got = _dense_plus_residual(g, x, plan)
+    np.testing.assert_allclose(got, _reference(g, x), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_plan_occupancy_reflects_structure():
+    """Oracle-ordered community graph concentrates edges into few
+    blocks; uniform random at the same V/E does not — the stat that
+    decides whether the MXU path can win."""
+    V, E = 2048, 60_000
+    comm = planted_community_csr(V, E, community_rows=512,
+                                 intra_frac=0.9, shuffle=False, seed=1)
+    unif = random_csr(V, E, seed=1)
+    po = plan_blocks(comm.row_ptr, comm.col_idx, V, min_fill=64)
+    pu = plan_blocks(unif.row_ptr, unif.col_idx, V, min_fill=64)
+    occ_o, occ_u = po.occupancy(), pu.occupancy()
+    assert occ_o["dense_frac"] > 0.5
+    # community order CONCENTRATES: fewer blocks, much higher fill
+    assert occ_o["mean_fill"] > 2 * occ_u["mean_fill"]
+    assert occ_o["n_blocks"] < occ_u["n_blocks"]
+    # at large V a uniform graph scatters below any useful fill
+    # (E * 128^2 / V^2 ~ 4 edges/block here)
+    big = random_csr(20_000, 100_000, seed=2)
+    pb = plan_blocks(big.row_ptr, big.col_idx, 20_000, min_fill=64)
+    assert pb.occupancy()["dense_frac"] < 0.05
+
+
+def test_duplicate_saturation_stays_exact():
+    """Edges past uint8 multiplicity overflow to the residual CSR —
+    total semantics stay exact."""
+    # 400 copies of the same edge (0 <- 1) + a spread of others
+    row_ptr = np.array([0, 400, 401, 402], dtype=np.int64)
+    col_idx = np.array([1] * 400 + [2, 0], dtype=np.int64)
+    from roc_tpu.core.graph import Graph
+    g = Graph(row_ptr=row_ptr, col_idx=col_idx.astype(np.int32))
+    plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes, min_fill=1)
+    assert plan.res_col.shape[0] == 400 - 255  # saturated tail
+    x = jnp.asarray(np.eye(3, 5, dtype=np.float32))
+    got = _dense_plus_residual(g, x, plan)
+    np.testing.assert_allclose(got, _reference(g, x), rtol=1e-5)
+
+
+def test_empty_dense_plan():
+    g = random_csr(300, 900, seed=0)
+    plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
+                       min_fill=10**9)
+    assert plan.n_blocks == 0
+    assert plan.res_col.shape[0] == g.num_edges

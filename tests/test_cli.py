@@ -124,3 +124,10 @@ def test_gat_mixed_distributed(capsys):
                "--eval-every", "2"])
     assert rc == 0
     assert "[INFER]" in capsys.readouterr().out
+
+
+def test_cli_sgc_model_trains():
+    """--model sgc --hops: the SGC family end-to-end through the CLI."""
+    rc = _run(["--model", "sgc", "--hops", "2", "-layers", "12-4",
+               "-e", "3", "-lr", "0.2"])
+    assert rc == 0

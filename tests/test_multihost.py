@@ -227,3 +227,50 @@ def test_local_sectioned_honors_sub_w_and_u16():
     for a, b in zip(loc.sect_sub_dst, glo.sect_sub_dst):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert loc.sect_meta == glo.sect_meta
+
+
+def test_local_flat8_matches_global_and_trains():
+    """shard_dataset_local's attn_flat8 tables must equal
+    shard_dataset's, and the injected-data path must run the GAT
+    through them (the multi-host large-attention entry point)."""
+    from roc_tpu.models.gat import build_gat
+    from roc_tpu.parallel.distributed import (DistributedTrainer,
+                                              shard_dataset)
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=11)
+    pg = partition_graph(ds.graph, 4, node_multiple=8, edge_multiple=64)
+    mesh = mh.make_parts_mesh(4)
+    loc = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="attn_flat8")
+    glo = shard_dataset(ds, pg, mesh, aggr_impl="attn_flat8")
+    assert len(loc.sect_idx) == 1 == len(glo.sect_idx)
+    np.testing.assert_array_equal(np.asarray(loc.sect_idx[0]),
+                                  np.asarray(glo.sect_idx[0]))
+    np.testing.assert_array_equal(np.asarray(loc.sect_sub_dst[0]),
+                                  np.asarray(glo.sect_sub_dst[0]))
+    # the flat edge arrays are stubs, not [P, E_p] uploads
+    assert loc.edge_src.shape[-1] == 1
+    cfg = TrainConfig(epochs=2, verbose=False, aggr_impl="attn_flat8",
+                      dropout_rate=0.0, eval_every=1 << 30)
+    tr = DistributedTrainer(build_gat([12, 8, 3], dropout_rate=0.0),
+                            ds, 4, cfg, mesh=mesh, data=loc, pg=pg)
+    tr.train(epochs=2)
+    assert np.isfinite(tr.evaluate()["train_loss"])
+
+
+def test_injected_data_without_flat8_tables_fails_fast():
+    """Resolved attn_flat8 + injected data lacking the tables must be
+    a construction-time ValueError, not a mid-trace IndexError."""
+    from roc_tpu.models.gat import build_gat
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=11)
+    pg = partition_graph(ds.graph, 4, node_multiple=8, edge_multiple=64)
+    mesh = mh.make_parts_mesh(4)
+    ell_data = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="ell")
+    cfg = TrainConfig(verbose=False, aggr_impl="attn_flat8",
+                      dropout_rate=0.0)
+    with pytest.raises(ValueError, match="flat8"):
+        DistributedTrainer(build_gat([12, 8, 3], dropout_rate=0.0),
+                           ds, 4, cfg, mesh=mesh, data=ell_data, pg=pg)
