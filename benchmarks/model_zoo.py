@@ -34,6 +34,12 @@ CONFIGS = {
               layers=(128, 256, 40)),
     "4": dict(model="gcn", nodes=2_449_029, edges=126_000_000,
               layers=(100, 256, 47)),
+    # 5: GIN's default MLP hidden changed in round 5 (output layer:
+    # 47 -> 256; a class-count-wide biasless ReLU bottleneck could die
+    # per-class — models/gin.py).  The recorded 6,023 ms mixed record
+    # predates the widening and needs a re-measure; the extra
+    # [2.45M, 256] activation may also move the fits-in-HBM boundary
+    # (the autopilot will say).
     "5": dict(model="gin", nodes=2_449_029, edges=126_000_000,
               layers=(100, 256, 47)),
     # 6: GAT at ogbn-arxiv shape — the attention family (beyond the
@@ -43,13 +49,14 @@ CONFIGS = {
     "6": dict(model="gat", nodes=169_343, edges=4_600_000,
               layers=(128, 256, 40)),
     # 7: GAT at the products/Amazon-2M shape — the attention capability
-    # bound on one chip.  Status (v5e, 2026-07-30): does NOT land.
-    # Without the scan-body remat in ops/attention.py the backward
-    # residuals OOM at compile (18.5 GiB stacked gathers — that remat
-    # is now in); with --dtype mixed --remat the program then exceeds
-    # practical compile time through the remote-compile tunnel (>40
-    # min, killed).  Config 6 (arxiv shape) is the measured attention
-    # config; this entry documents the boundary honestly.
+    # bound on one chip.  History (v5e, 2026-07-30): the per-width
+    # bucket path OOMed its backward residuals (fixed by the scan-body
+    # remat in ops/attention.py), then exceeded practical remote
+    # compile time (>40 min — one checkpointed scan per width bucket,
+    # doubled by autodiff).  The uniform flat8 layout exists for
+    # exactly this config (HLO 4849 -> 511 lines, compile_probe.py);
+    # with impl left at 'auto' the trainer now routes E=126M attention
+    # to 'attn_flat8'.  On-chip epoch time pending a tunnel window.
     "7": dict(model="gat", nodes=2_449_029, edges=126_000_000,
               layers=(100, 256, 47)),
 }
@@ -82,8 +89,12 @@ def run(cfg_key: str, epochs: int, impl: str,
         if heads < 1 or any(d % heads for d in layers[1:-1]):
             raise SystemExit(
                 f"--heads {heads} invalid for hidden dims {layers[1:-1]}")
-    if impl == "auto":
-        # record the kernel that actually runs, not the CLI alias
+    if impl == "auto" and c["model"] != "gat":
+        # record the kernel that actually runs, not the CLI alias.
+        # GAT configs keep 'auto': the TRAINER's resolver owns the
+        # attention routing (ell below ATTN_FLAT8_MIN_EDGES, the
+        # uniform flat8 layout above it — it needs the dataset, which
+        # this early resolution doesn't have)
         from roc_tpu.core.ell import resolve_auto_impl
         impl = resolve_auto_impl(c["nodes"])
     dev = jax.devices()[0]

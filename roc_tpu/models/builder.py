@@ -463,15 +463,26 @@ class Model:
                 return None
         if self._loss_op is not None and self._loss_op < 3:
             return None
-        tail = Model(in_dim=ops[2].dim)
-        for op in ops[3:]:
+        tail = self._split_tail(2)
+        return ops[1].attrs["rate"], ops[2].param, tail
+
+    def _split_tail(self, head_out: int) -> "Model":
+        """Tail model over ops past ``head_out`` (the streamed head's
+        output tensor): the head output becomes the tail's input 0,
+        later indices shift down, the loss marker shifts with them.
+        Shared by streamable_head and streamable_agg_head — the remap
+        must never drift between them."""
+        ops = self._ops
+        tail = Model(in_dim=ops[head_out].dim)
+        for op in ops[head_out + 1:]:
             tail._ops.append(_Op(
                 op.kind,
-                tuple(0 if i == 2 else i - 2 for i in op.inputs),
+                tuple(0 if i == head_out else i - head_out
+                      for i in op.inputs),
                 op.dim, op.param, dict(op.attrs)))
-        tail._loss_op = (self._loss_op - 2
+        tail._loss_op = (self._loss_op - head_out
                          if self._loss_op is not None else None)
-        return ops[1].attrs["rate"], ops[2].param, tail
+        return tail
 
     def streamable_agg_head(self):
         """``(prefix_ops, dropout_rate, linear_param, tail_model)``
@@ -517,17 +528,8 @@ class Model:
         # IS the classifier) — the tail degenerates to loss-on-input
         if self._loss_op is not None and self._loss_op < head_out:
             return None
-        tail = Model(in_dim=ops[head_out].dim)
-        for op in ops[head_out + 1:]:
-            tail._ops.append(_Op(
-                op.kind,
-                tuple(0 if j == head_out else j - head_out
-                      for j in op.inputs),
-                op.dim, op.param, dict(op.attrs)))
-        tail._loss_op = (self._loss_op - head_out
-                         if self._loss_op is not None else None)
         return (list(ops[1:i]), ops[i].attrs["rate"],
-                ops[i + 1].param, tail)
+                ops[i + 1].param, self._split_tail(head_out))
 
     # ---- params ----
 

@@ -25,11 +25,16 @@ from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU
 
 def build_gin(layers: Sequence[int], dropout_rate: float = 0.5,
               mlp_hidden: int = 0, learn_eps: bool = False) -> Model:
-    """``mlp_hidden`` == 0 uses the layer's own width for the MLP's
-    hidden dim.  ``learn_eps`` swaps the fixed self-contribution for
-    the paper's learnable epsilon: on self-edged graphs
-    (1+eps)x + sum_{u != v} x_u == agg + eps*x, so the layer becomes
-    ``scale_add(agg, x)`` with a zero-init scalar (GIN-0 start)."""
+    """``mlp_hidden`` == 0 sizes each MLP's hidden dim as
+    ``max(in, out)`` of its layer — NEVER the bare class count: a
+    ReLU hidden of width ``num_classes`` (3 on the test fixtures) is a
+    biasless bottleneck that can die for a whole class region and
+    never recover (observed: exact-zero logits for every node of one
+    class, train acc pinned across lr/epochs).  ``learn_eps`` swaps
+    the fixed self-contribution for the paper's learnable epsilon: on
+    self-edged graphs (1+eps)x + sum_{u != v} x_u == agg + eps*x, so
+    the layer becomes ``scale_add(agg, x)`` with a zero-init scalar
+    (GIN-0 start)."""
     model = Model(in_dim=layers[0])
     t = model.input()
     n = len(layers)
@@ -40,7 +45,7 @@ def build_gin(layers: Sequence[int], dropout_rate: float = 0.5,
             t = model.scale_add(agg, t)
         else:
             t = model.add(t, agg)
-        hidden = mlp_hidden or layers[i]
+        hidden = mlp_hidden or max(layers[i], layers[i - 1])
         t = model.linear(t, hidden, AC_MODE_RELU)
         t = model.linear(t, layers[i], AC_MODE_NONE)
         if i != n - 1:

@@ -85,9 +85,17 @@ class BlockPlan:
 
 
 def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
-                num_rows: int, min_fill: int = 64) -> BlockPlan:
+                num_rows: int, min_fill: int = 64,
+                a_budget_bytes: Optional[int] = 2 << 30) -> BlockPlan:
     """Tile the dst-major CSR into [128, 128] blocks; blocks with at
-    least ``min_fill`` edges go dense, the rest stay residual CSR."""
+    least ``min_fill`` edges go dense, the rest stay residual CSR.
+
+    ``a_budget_bytes`` caps the total uint8 A-table size (16 KiB per
+    block): when more blocks qualify than fit the budget, the DENSEST
+    are kept — fill, not count, is what amortizes the per-block cost,
+    and an unbounded plan is unusable anyway (at Reddit scale with
+    65k-row communities ~930k blocks qualify = a 15 GiB A-table that
+    no 16 GiB chip can hold).  ``None`` disables the cap."""
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     col_idx = np.asarray(col_idx, dtype=np.int64)
     E = col_idx.shape[0]
@@ -100,6 +108,15 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
     blocks, starts, counts = np.unique(key_s, return_index=True,
                                        return_counts=True)
     dense_sel = counts >= min_fill
+    if a_budget_bytes is not None:
+        max_blocks = int(a_budget_bytes // (BLOCK * BLOCK))
+        if int(dense_sel.sum()) > max_blocks:
+            # keep the densest blocks up to the budget
+            cand = np.flatnonzero(dense_sel)
+            keep = cand[np.argsort(-counts[cand],
+                                   kind="stable")[:max_blocks]]
+            dense_sel = np.zeros_like(dense_sel)
+            dense_sel[keep] = True
     dense_blocks = blocks[dense_sel]
     nblk = int(dense_blocks.shape[0])
     a = np.zeros((nblk, BLOCK, BLOCK), dtype=np.uint8)
@@ -114,32 +131,31 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
         flat = (pos_c[in_dense] * BLOCK * BLOCK
                 + (dst_all[e_sel] % BLOCK) * BLOCK
                 + (col_idx[e_sel] % BLOCK))
+        # occupied-slot counting stays O(E_dense), never O(slots):
+        # a global bincount over nblk*16384 slots is ~17 GiB of
+        # transient int64 at the default A budget (round-5 advisor)
+        flat_order = np.argsort(flat, kind="stable")
+        flat_sorted = flat[flat_order]
+        slots, counts_s = np.unique(flat_sorted, return_counts=True)
         # uint8 multiplicity with saturation: overflowing edges (deep
         # duplicates past 255) fall back to the residual CSR so the
         # semantics stay exact
-        cnt = np.bincount(flat, minlength=nblk * BLOCK * BLOCK)
-        over = cnt > 255
-        a.reshape(-1)[:] = np.minimum(cnt, 255).astype(np.uint8)
-        dense_edges = int(np.minimum(cnt, 255).sum())
-        overflow_edges = int((cnt - np.minimum(cnt, 255)).sum())
+        kept = np.minimum(counts_s, 255)
+        a.reshape(-1)[slots] = kept.astype(np.uint8)
+        dense_edges = int(kept.sum())
+        overflow_edges = int((counts_s - kept).sum())
     else:
         dense_edges = 0
         overflow_edges = 0
-        over = np.zeros(0, dtype=bool)
     # residual = all edges not counted densely
     res_mask = np.ones(E, dtype=bool)
     res_mask[e_sel] = False
     if overflow_edges:
-        # keep the overflow multiplicities: re-add edges whose flat
-        # slot saturated (rare pathological duplicates)
-        over_slots = np.flatnonzero(over)
-        slot_excess = (cnt[over_slots] - 255).astype(np.int64)
-        # mark the LAST `excess` duplicate edges of each slot residual
-        flat_order = np.argsort(flat, kind="stable")
-        flat_sorted = flat[flat_order]
-        s0 = np.searchsorted(flat_sorted, over_slots, side="left")
-        s1 = np.searchsorted(flat_sorted, over_slots, side="right")
-        for lo, hi, ex in zip(s0, s1, slot_excess):
+        # mark the LAST `excess` duplicates of each saturated slot
+        # residual (rare pathological multi-edges)
+        over = counts_s > 255
+        s1 = np.searchsorted(flat_sorted, slots[over], side="right")
+        for hi, ex in zip(s1, (counts_s[over] - 255)):
             res_mask[e_sel[flat_order[hi - ex:hi]]] = True
     res_dst = dst_all[res_mask]
     res_col = col_idx[res_mask]

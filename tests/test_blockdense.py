@@ -96,3 +96,24 @@ def test_empty_dense_plan():
                        min_fill=10**9)
     assert plan.n_blocks == 0
     assert plan.res_col.shape[0] == g.num_edges
+
+
+def test_a_budget_keeps_densest_blocks():
+    """The A-table byte budget keeps the DENSEST qualifying blocks and
+    exactness survives (the dropped blocks fall to the residual)."""
+    g = planted_community_csr(600, 9000, community_rows=BLOCK,
+                              shuffle=False, seed=5)
+    full = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes, min_fill=1,
+                       a_budget_bytes=None)
+    budget = 2 * BLOCK * BLOCK  # room for exactly two blocks
+    capped = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes, min_fill=1,
+                         a_budget_bytes=budget)
+    assert capped.n_blocks == 2 < full.n_blocks
+    # the two kept blocks are the densest ones
+    per_block_full = full.a_blocks.reshape(full.n_blocks, -1).sum(1)
+    kept = np.sort(capped.a_blocks.reshape(2, -1).sum(1))
+    assert (kept == np.sort(per_block_full)[-2:]).all()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(g.num_nodes, 8).astype(np.float32))
+    np.testing.assert_allclose(_dense_plus_residual(g, x, capped),
+                               _reference(g, x), rtol=1e-4, atol=1e-4)
