@@ -46,7 +46,7 @@ extern "C" {
 // library whose version does not match (a stale/pinned .so called with
 // new argtypes would read a pointer as an int — SIGSEGV or garbage).
 // v2: sub_w parameter inserted into roc_sectioned_counts/_fill.
-int roc_abi_version(void) { return 2; }
+int roc_abi_version(void) { return 3; }
 
 // ---------------------------------------------------------------------------
 // .lux binary format: u32 num_nodes, u64 num_edges, num_nodes x u64
@@ -560,6 +560,106 @@ int roc_sectioned_fill(const int64_t* row_ptr, const int32_t* col,
     }
   }
   return kOk;
+}
+
+// ---------------------------------------------------------------------------
+// Block-dense tile planning (ops/blockdense.py plan_blocks): the
+// occupied-tile census and the A-table/residual fill as O(E) CSR
+// walks.  The numpy pipeline (argsort + unique over E keys) takes
+// ~15 minutes at Reddit scale — far too slow to fit a bench window —
+// these passes take seconds.  Same two-pass caller-allocates shape as
+// the sectioned prep above.
+// ---------------------------------------------------------------------------
+
+// (key, count) per occupied [block x block] tile, key ascending
+// (key = dst_tile * n_tiles + src_tile).  Counts include every edge
+// of the tile (saturation is the fill pass's business).  Writes at
+// most `cap` rows; returns the TOTAL occupied-tile count (a result
+// > cap means the output is truncated and the caller must retry with
+// more room), or kErrValue for out-of-range columns.
+int64_t roc_block_counts(const int64_t* row_ptr, const int32_t* col,
+                         int64_t num_rows, int64_t block,
+                         int64_t* keys, int64_t* counts, int64_t cap) {
+  if (block <= 0) return kErrValue;
+  int64_t n_tiles = (num_rows + block - 1) / block;
+  std::vector<int64_t> cnt(static_cast<size_t>(n_tiles), 0);
+  std::vector<int64_t> touched;
+  int64_t nnz = 0;
+  for (int64_t t = 0; t < n_tiles; ++t) {
+    int64_t lo = t * block;
+    int64_t hi = std::min(num_rows, lo + block);
+    touched.clear();
+    for (int64_t v = lo; v < hi; ++v) {
+      for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
+        int64_t s = col[e] / block;
+        if (col[e] < 0 || s >= n_tiles) return kErrValue;
+        if (cnt[static_cast<size_t>(s)]++ == 0) touched.push_back(s);
+      }
+    }
+    std::sort(touched.begin(), touched.end());
+    for (int64_t s : touched) {
+      if (nnz < cap) {
+        keys[nnz] = t * n_tiles + s;
+        counts[nnz] = cnt[static_cast<size_t>(s)];
+      }
+      ++nnz;
+      cnt[static_cast<size_t>(s)] = 0;
+    }
+  }
+  return nnz;
+}
+
+// Fill pass: dense_keys is the planner's ASCENDING selection of tile
+// keys; `a` is the zeroed uint8 [nblk * block * block] multiplicity
+// table.  Edges in selected tiles increment their slot (saturating at
+// 255 — overflow duplicates spill to the residual, keeping the
+// semantics exact); everything else lands in the residual dst-major
+// CSR (res_ptr [num_rows + 1], res_col capacity res_cap, original
+// per-row edge order preserved).  Returns the residual edge count, or
+// kErrValue on out-of-range columns / capacity overflow.
+int64_t roc_block_fill(const int64_t* row_ptr, const int32_t* col,
+                       int64_t num_rows, int64_t block,
+                       const int64_t* dense_keys, int64_t nblk,
+                       uint8_t* a, int64_t* res_ptr, int32_t* res_col,
+                       int64_t res_cap) {
+  if (block <= 0) return kErrValue;
+  int64_t n_tiles = (num_rows + block - 1) / block;
+  std::vector<int64_t> blk_of(static_cast<size_t>(n_tiles), -1);
+  int64_t res_n = 0;
+  int64_t k_lo = 0;
+  for (int64_t t = 0; t < n_tiles; ++t) {
+    int64_t k_hi = k_lo;
+    while (k_hi < nblk && dense_keys[k_hi] < (t + 1) * n_tiles) ++k_hi;
+    for (int64_t i = k_lo; i < k_hi; ++i) {
+      blk_of[static_cast<size_t>(dense_keys[i] % n_tiles)] = i;
+    }
+    int64_t lo = t * block;
+    int64_t hi = std::min(num_rows, lo + block);
+    for (int64_t v = lo; v < hi; ++v) {
+      res_ptr[v] = res_n;
+      for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
+        int64_t s = col[e] / block;
+        if (col[e] < 0 || s >= n_tiles) return kErrValue;
+        int64_t b = blk_of[static_cast<size_t>(s)];
+        if (b >= 0) {
+          uint8_t* slot = a + (b * block + (v - lo)) * block
+                            + (col[e] - s * block);
+          if (*slot < 255) {
+            ++*slot;
+            continue;
+          }
+        }
+        if (res_n >= res_cap) return kErrValue;
+        res_col[res_n++] = col[e];
+      }
+    }
+    for (int64_t i = k_lo; i < k_hi; ++i) {
+      blk_of[static_cast<size_t>(dense_keys[i] % n_tiles)] = -1;
+    }
+    k_lo = k_hi;
+  }
+  res_ptr[num_rows] = res_n;
+  return res_n;
 }
 
 }  // extern "C"

@@ -78,7 +78,7 @@ def _load() -> Optional[ctypes.CDLL]:
     # signatures; a stale or pinned .so from before an ABI bump would
     # read a pointer slot as an int (SIGSEGV or silent garbage), so
     # mismatches fall back to the numpy paths instead of loading.
-    _ABI_VERSION = 2
+    _ABI_VERSION = 3
     try:
         lib.roc_abi_version.restype = ctypes.c_int
         got = int(lib.roc_abi_version())
@@ -122,6 +122,13 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.roc_sectioned_fill.restype = c.c_int
     lib.roc_sectioned_fill.argtypes = [i64p, i32p, i64, i64, i64, i64,
                                        i64p, i64p, i32p, i32p]
+    u8p = c.POINTER(c.c_uint8)
+    lib.roc_block_counts.restype = c.c_int64
+    lib.roc_block_counts.argtypes = [i64p, i32p, i64, i64, i64p, i64p,
+                                     i64]
+    lib.roc_block_fill.restype = c.c_int64
+    lib.roc_block_fill.argtypes = [i64p, i32p, i64, i64, i64p, i64,
+                                   u8p, i64p, i32p, i64]
     _lib = lib
     return _lib
 
@@ -282,3 +289,52 @@ def sectioned_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
     if rc != 0:
         raise ValueError(f"roc_sectioned_fill failed: {rc}")
     return idx_flat, sub_dst
+
+
+def block_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
+                 num_rows: int, block: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """(keys, counts) per occupied [block x block] adjacency tile,
+    key-ascending (ops/blockdense.py plan_blocks, census pass)."""
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
+    n_tiles = -(-num_rows // block)
+    cap = int(min(n_tiles * n_tiles, col_idx.shape[0], 1 << 27))
+    while True:
+        keys = np.empty(cap, dtype=np.int64)
+        counts = np.empty(cap, dtype=np.int64)
+        nnz = int(lib.roc_block_counts(
+            _i64p(row_ptr), _i32p(col_idx), num_rows, block,
+            _i64p(keys), _i64p(counts), cap))
+        if nnz < 0:
+            raise ValueError(f"roc_block_counts failed: {nnz}")
+        if nnz <= cap:
+            return keys[:nnz].copy(), counts[:nnz].copy()
+        cap = nnz
+
+
+def block_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
+               num_rows: int, block: int, dense_keys: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(a_blocks uint8 [nblk, block, block], res_row_ptr, res_col):
+    fill the selected tiles' multiplicity tables, spill the rest (and
+    saturated duplicates) to a residual dst-major CSR."""
+    lib = _load()
+    assert lib is not None
+    row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
+    col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
+    dense_keys = np.ascontiguousarray(dense_keys, dtype=np.int64)
+    nblk = dense_keys.shape[0]
+    a = np.zeros((nblk, block, block), dtype=np.uint8)
+    res_ptr = np.empty(num_rows + 1, dtype=np.int64)
+    res_col = np.empty(col_idx.shape[0], dtype=np.int32)
+    rc = int(lib.roc_block_fill(
+        _i64p(row_ptr), _i32p(col_idx), num_rows, block,
+        _i64p(dense_keys), nblk,
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        _i64p(res_ptr), _i32p(res_col), res_col.shape[0]))
+    if rc < 0:
+        raise ValueError(f"roc_block_fill failed: {rc}")
+    return a, res_ptr, res_col[:rc].copy()

@@ -163,3 +163,38 @@ def test_sectioned_native_rejects_out_of_range_cols():
         native.sectioned_fill(row_ptr, col_bad, 1, 64,
                               np.array([64], dtype=np.int64),
                               np.array([8], dtype=np.int64))
+
+
+def test_block_plan_native_matches_numpy():
+    """Native census+fill must produce a byte-identical BlockPlan to
+    the numpy pipeline (dense tables, key order, residual CSR,
+    saturation behavior)."""
+    if not native.available():
+        pytest.skip("librocio not built")
+    import roc_tpu.native as native_mod
+    from roc_tpu.core.graph import Graph, planted_community_csr
+    from roc_tpu.ops import blockdense as bd
+
+    g = planted_community_csr(700, 10_000, community_rows=128,
+                              intra_frac=0.85, shuffle=False, seed=9)
+    # inject heavy duplicates to exercise the saturation path
+    row_ptr = np.concatenate([[0], g.row_ptr[1:] + 300])
+    col = np.concatenate([np.full(300, 5, dtype=np.int32), g.col_idx])
+    g2 = Graph(row_ptr=row_ptr.astype(np.int64), col_idx=col)
+    for min_fill, budget in ((8, None), (16, 3 * 128 * 128), (1, None)):
+        pn = bd.plan_blocks(g2.row_ptr, g2.col_idx, g2.num_nodes,
+                            min_fill=min_fill, a_budget_bytes=budget)
+        avail = native_mod.available
+        native_mod.available = lambda: False
+        try:
+            pp = bd.plan_blocks(g2.row_ptr, g2.col_idx, g2.num_nodes,
+                                min_fill=min_fill,
+                                a_budget_bytes=budget)
+        finally:
+            native_mod.available = avail
+        np.testing.assert_array_equal(pn.a_blocks, pp.a_blocks)
+        np.testing.assert_array_equal(pn.src_blk, pp.src_blk)
+        np.testing.assert_array_equal(pn.dst_blk, pp.dst_blk)
+        np.testing.assert_array_equal(pn.res_row_ptr, pp.res_row_ptr)
+        np.testing.assert_array_equal(pn.res_col, pp.res_col)
+        assert pn.dense_edges == pp.dense_edges
