@@ -115,10 +115,15 @@ def build_parser():
                     help="global wall-clock budget (s); must stay under "
                          "the driver's own timeout so the final JSON "
                          "line always gets printed")
-    ap.add_argument("--promote-max-age-h", type=float, default=24.0,
+    ap.add_argument("--promote-max-age-h", type=float, default=48.0,
                     help="max age of a bench_stages.jsonl record "
                          "eligible for in_round_stage promotion when "
-                         "every live stage fails")
+                         "every live stage fails; 48h spans one "
+                         "build-round cadence (a record from the "
+                         "previous session is attributable — its "
+                         "timestamp rides along as "
+                         "provenance_recorded — while a week-old one "
+                         "masks a persistently dead tunnel)")
     ap.add_argument("--probe-retries", type=int, default=8,
                     help="max extra probe attempts; attempts are "
                          "spread ~3.5 min apart across the whole "
@@ -447,9 +452,11 @@ def _promote_stage_record(args, stage_summary: dict, errs: dict):
     ``--dtype``; returns ``None`` when no on-chip record exists.
 
     The stage log is append-only across rounds, so records older than
-    ``--promote-max-age-h`` are ignored: a tunnel that stays dead for
-    a whole round yields an honest null, not yesterday's number
-    replayed with a fresh face."""
+    ``--promote-max-age-h`` (default 48h ~ one build-round cadence)
+    are ignored: a record from this or the previous session is
+    promotable — its age rides along as ``provenance_recorded`` — but
+    a tunnel dead for longer than a round yields an honest null
+    instead of replaying an ancient number."""
     try:
         with open(_STAGES_PATH) as f:
             recs = [json.loads(line) for line in f if line.strip()]

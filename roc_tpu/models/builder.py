@@ -101,6 +101,15 @@ class GraphContext:
     # gat_aggregate_flat8)
     flat8_idx: Optional[jax.Array] = None
     flat8_dst: Optional[jax.Array] = None
+    # Block-dense MXU layout (aggr_impl == "bdense"): dense [128,128]
+    # adjacency tiles as uint8 multiplicity tables + tile ids, with
+    # the residual (scattered) edges in the sect_* sectioned tables
+    # (ops/blockdense.py; wins on community graphs whose vertex order
+    # concentrates edges — see plan_blocks.occupancy)
+    bd_a: Optional[jax.Array] = None
+    bd_src: Optional[jax.Array] = None
+    bd_dst: Optional[jax.Array] = None
+    bd_vpad: int = 0
     # halo exchange mode: "gather" = one-shot all_gather of the full
     # feature matrix (the reference's whole-region requirement);
     # "ring" = ppermute rotation overlapping per-shard aggregation
@@ -128,6 +137,23 @@ class GraphContext:
             return aggregate_ell_sect(full, self.sect_idx,
                                       self.sect_sub_dst, self.sect_meta,
                                       self.num_rows)
+        if self.aggr_impl == "bdense":
+            from ..ops.blockdense import aggregate_block_dense
+            out = None
+            if self.bd_a is not None:
+                out = aggregate_block_dense(
+                    full, self.bd_a, self.bd_src, self.bd_dst,
+                    self.num_rows, self.bd_vpad,
+                    out_dtype=full.dtype)
+            if self.sect_idx:
+                res = aggregate_ell_sect(
+                    full, self.sect_idx, self.sect_sub_dst,
+                    self.sect_meta, self.num_rows)
+                out = res if out is None else out + res
+            if out is None:  # zero-edge graph
+                out = jnp.zeros((self.num_rows, full.shape[1]),
+                                dtype=full.dtype)
+            return out
         if self.aggr_impl == "pallas":
             from ..kernels.ell_spmm import ell_aggregate_pallas
             return ell_aggregate_pallas(full, self.ell_idx,
@@ -246,7 +272,7 @@ class GraphContext:
                                     self.ell_row_pos, self.num_rows)
         else:
             if self.aggr_impl in ("blocked", "scan", "pallas_csr",
-                                  "sectioned"):
+                                  "sectioned", "bdense"):
                 # guard every chunked-sum impl, not just 'blocked':
                 # falling through to the segment path would materialize
                 # the full [E, F] per-edge matrix — an OOM on exactly
@@ -267,19 +293,20 @@ class GraphContext:
 def _gctx_flatten(g: GraphContext):
     children = (g.edge_src, g.edge_dst, g.in_degree, g.ell_idx,
                 g.ell_row_pos, g.ring_idx, g.sect_idx, g.sect_sub_dst,
-                g.ell_row_id, g.flat8_idx, g.flat8_dst)
+                g.ell_row_id, g.flat8_idx, g.flat8_dst, g.bd_a,
+                g.bd_src, g.bd_dst)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
            g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
-           g.sect_meta)
+           g.sect_meta, g.bd_vpad)
     return children, aux
 
 
 def _gctx_unflatten(aux, children):
     (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
-     symmetric, halo, axis_name, sect_meta) = aux
+     symmetric, halo, axis_name, sect_meta, bd_vpad) = aux
     (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
      sect_idx, sect_sub_dst, ell_row_id, flat8_idx,
-     flat8_dst) = children
+     flat8_dst, bd_a, bd_src, bd_dst) = children
     return GraphContext(
         edge_src=edge_src, edge_dst=edge_dst, in_degree=in_degree,
         num_rows=num_rows, gathered_rows=gathered_rows,
@@ -289,7 +316,8 @@ def _gctx_unflatten(aux, children):
         ring_idx=ring_idx, axis_name=axis_name, sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst, sect_meta=sect_meta,
         ell_row_id=ell_row_id, flat8_idx=flat8_idx,
-        flat8_dst=flat8_dst)
+        flat8_dst=flat8_dst, bd_a=bd_a, bd_src=bd_src, bd_dst=bd_dst,
+        bd_vpad=bd_vpad)
 
 
 # GraphContext is a pytree so the graph tables travel as jit ARGUMENTS.

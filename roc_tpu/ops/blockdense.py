@@ -222,7 +222,9 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
     xt = jnp.zeros((vpad, F), dtype=x.dtype).at[:num_rows].set(
         x[:num_rows]).reshape(n_tiles, BLOCK, F)
     # pad the block list to a chunk multiple; padding scatters zero
-    # tiles into a dummy output tile
+    # tiles into a dummy output tile.  Small plans shrink the chunk so
+    # padding never exceeds one chunk's worth of zero work.
+    chunk_blocks = max(1, min(chunk_blocks, nblk))
     chunks = max(1, -(-nblk // chunk_blocks))
     pad = chunks * chunk_blocks - nblk
     a_p = jnp.concatenate([

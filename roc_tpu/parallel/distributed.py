@@ -307,6 +307,11 @@ class DistributedTrainer:
         # multi-chip attention at >=20M edges would otherwise re-hit
         # the per-width-bucket compile wall (VERDICT r4 weak #3)
         config = resolve_attention_impl(model, config, dataset)
+        if config.aggr_impl == "bdense":
+            raise NotImplementedError(
+                "aggr_impl='bdense' is single-device (dense tiles over "
+                "the global id space; a per-partition tile build is "
+                "future work) — use 'sectioned' or 'ell' distributed")
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0

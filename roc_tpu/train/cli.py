@@ -65,7 +65,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "reference's numMachines*numGPUs)")
     ap.add_argument("--impl", default="auto",
                     choices=["auto", "segment", "blocked", "scan", "ell",
-                             "sectioned", "pallas"],
+                             "sectioned", "pallas", "bdense"],
                     help="aggregation backend; auto = 'sectioned' (the "
                          "source-sectioned fast-gather layout, measured "
                          "2.3x over 'ell' at Reddit scale) for graphs "

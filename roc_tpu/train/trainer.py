@@ -101,6 +101,9 @@ class TrainConfig:
     #   bytes; caps section_rows at 65,535 so the dummy id fits).
     sect_sub_w: int = 8
     sect_u16: bool = False
+    # - bdense_min_fill: edges per [128,128] tile below which the tile
+    #   stays in the sectioned residual (aggr_impl='bdense')
+    bdense_min_fill: int = 64
 
 
 def resolve_dtypes(name: str):
@@ -257,11 +260,15 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        chunk: int = 512,
                        symmetric: Optional[bool] = None,
                        sect_sub_w: int = 8,
-                       sect_u16: bool = False) -> GraphContext:
+                       sect_u16: bool = False,
+                       bdense_min_fill: int = 64,
+                       verbose: bool = False) -> GraphContext:
     """Single-device GraphContext: edges padded to the chunk multiple,
     dummy source id == num_nodes (the appended zero row).
-    ``sect_sub_w``/``sect_u16`` tune the sectioned layout
-    (TrainConfig fields of the same names)."""
+    ``sect_sub_w``/``sect_u16`` tune the sectioned layout and
+    ``bdense_min_fill`` the block-dense split (TrainConfig fields of
+    the same names); ``verbose`` gates the informational echoes (the
+    impl-override ones stay unconditional)."""
     g = dataset.graph
     if aggr_impl == "auto":
         # data-driven split: sectioned wins in its measured node-count
@@ -275,7 +282,10 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     sect_sub_dst: tuple = ()
     sect_meta: tuple = ()
     flat8_idx = flat8_dst = None
-    if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8"):
+    bd_a = bd_src = bd_dst = None
+    bd_vpad = 0
+    if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
+                     "bdense"):
         # these paths never read the flat edge arrays — don't upload
         # two [E] int32 tensors (~920 MB at Reddit scale) they'd ignore
         edge_src = np.zeros(1, dtype=np.int32)
@@ -300,6 +310,46 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         if sect_u16:
             sect = sect.with_idx_dtype(np.uint16)
         sect_idx, sect_sub_dst, sect_meta = sect.as_jax()
+    elif aggr_impl == "bdense":
+        # block-dense MXU aggregation: dense [128,128] adjacency tiles
+        # as uint8 multiplicity tables, scattered residual through the
+        # sectioned gather (ops/blockdense.py — wins when the vertex
+        # order concentrates edges into tiles; the occupancy echo
+        # makes a mis-fit choice visible)
+        from ..core.ell import default_section_rows, sectioned_from_graph
+        from ..ops.blockdense import plan_blocks
+        import sys as _sys
+        plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
+                           min_fill=bdense_min_fill)
+        occ = plan.occupancy()
+        if plan.n_blocks:
+            if verbose:
+                print(f"# bdense plan: {occ['n_blocks']} blocks, "
+                      f"fill {occ['mean_fill']}, dense "
+                      f"{occ['dense_frac']:.0%} (residual "
+                      f"{1 - occ['dense_frac']:.0%} via sectioned)",
+                      file=_sys.stderr)
+            bd_a = jnp.asarray(plan.a_blocks)
+            bd_src = jnp.asarray(plan.src_blk)
+            bd_dst = jnp.asarray(plan.dst_blk)
+            bd_vpad = plan.vpad
+        else:
+            # no tile qualifies: running the zero-block kernel every
+            # step would be pure overhead — this changes the effective
+            # execution path, so it echoes unconditionally
+            print(f"# bdense: no [128,128] tile reaches min_fill="
+                  f"{bdense_min_fill} on this graph/order — running "
+                  f"the sectioned residual only", file=_sys.stderr)
+        if plan.res_col.shape[0]:
+            # same tuning knobs as the 'sectioned' branch — bdense's
+            # residual must not silently drop user-selected config
+            sect = sectioned_from_graph(
+                plan.res_row_ptr, plan.res_col, g.num_nodes,
+                section_rows=default_section_rows(sect_u16),
+                sub_w=sect_sub_w)
+            if sect_u16:
+                sect = sect.with_idx_dtype(np.uint16)
+            sect_idx, sect_sub_dst, sect_meta = sect.as_jax()
     elif aggr_impl == "attn_flat8":
         # large-graph attention: ONE section spanning all sources
         # (global ids, dummy == num_nodes == the appended zero row),
@@ -331,6 +381,10 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         sect_meta=sect_meta,
         flat8_idx=flat8_idx,
         flat8_dst=flat8_dst,
+        bd_a=bd_a,
+        bd_src=bd_src,
+        bd_dst=bd_dst,
+        bd_vpad=bd_vpad,
     )
 
 
@@ -419,11 +473,13 @@ class Trainer:
                 # constant avoids check_symmetric's O(E log E) sort
                 symmetric=True)
         else:
-            self.gctx = make_graph_context(dataset, config.aggr_impl,
-                                           config.chunk,
-                                           symmetric=config.symmetric,
-                                           sect_sub_w=config.sect_sub_w,
-                                           sect_u16=config.sect_u16)
+            self.gctx = make_graph_context(
+                dataset, config.aggr_impl, config.chunk,
+                symmetric=config.symmetric,
+                sect_sub_w=config.sect_sub_w,
+                sect_u16=config.sect_u16,
+                bdense_min_fill=config.bdense_min_fill,
+                verbose=config.verbose)
         # Dataset tensors are jitted *arguments*, not closure captures:
         # capturing them would embed a second copy of the feature matrix
         # as an executable constant and recompile per Trainer instance
