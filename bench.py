@@ -173,14 +173,18 @@ def _now_iso() -> str:
 
 
 _ANSI_RE = re.compile(r"\x1b\[[0-9;]*[A-Za-z]|\x1b\][^\x07\x1b]*(\x07|\x1b\\)?")
+# swallow everything to whitespace or a ": "-style suffix separator —
+# userinfo (user:token@host) must not survive the redaction
+_URL_RE = re.compile(r"https?://\S+?(?=:\s|[\s]|$)")
 
 
 def _errstr(e: BaseException, limit: int = 300) -> str:
-    """First line of the exception, ANSI escapes stripped, truncated —
-    what gets persisted into machine-readable artifacts (a raw
-    MosaicError once polluted measured_baselines.json with escape
-    sequences and a tunnel URL)."""
+    """First line of the exception, ANSI escapes stripped, endpoint
+    URLs redacted, truncated — what gets persisted into
+    machine-readable artifacts (a raw MosaicError once polluted
+    measured_baselines.json with escape sequences and a tunnel URL)."""
     s = _ANSI_RE.sub("", f"{type(e).__name__}: {e}")
+    s = _URL_RE.sub("<endpoint>", s)
     first = s.splitlines()[0] if s.splitlines() else s
     return first[:limit]
 

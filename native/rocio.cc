@@ -46,7 +46,10 @@ extern "C" {
 // library whose version does not match (a stale/pinned .so called with
 // new argtypes would read a pointer as an int — SIGSEGV or garbage).
 // v2: sub_w parameter inserted into roc_sectioned_counts/_fill.
-int roc_abi_version(void) { return 3; }
+// v4: num_cols parameter inserted into roc_block_counts/_fill (the
+//     distributed block-dense planner tiles a RECTANGULAR space:
+//     local dst rows x gathered source coordinates).
+int roc_abi_version(void) { return 4; }
 
 // ---------------------------------------------------------------------------
 // .lux binary format: u32 num_nodes, u64 num_edges, num_nodes x u64
@@ -572,17 +575,22 @@ int roc_sectioned_fill(const int64_t* row_ptr, const int32_t* col,
 // ---------------------------------------------------------------------------
 
 // (key, count) per occupied [block x block] tile, key ascending
-// (key = dst_tile * n_tiles + src_tile).  Counts include every edge
+// (key = dst_tile * n_src_tiles + src_tile, where n_src_tiles covers
+// num_cols — the source space may be wider than the dst rows, e.g.
+// the distributed planner's gathered coordinates).  Counts include
+// every edge
 // of the tile (saturation is the fill pass's business).  Writes at
 // most `cap` rows; returns the TOTAL occupied-tile count (a result
 // > cap means the output is truncated and the caller must retry with
 // more room), or kErrValue for out-of-range columns.
 int64_t roc_block_counts(const int64_t* row_ptr, const int32_t* col,
-                         int64_t num_rows, int64_t block,
+                         int64_t num_rows, int64_t num_cols,
+                         int64_t block,
                          int64_t* keys, int64_t* counts, int64_t cap) {
-  if (block <= 0) return kErrValue;
+  if (block <= 0 || num_cols <= 0) return kErrValue;
   int64_t n_tiles = (num_rows + block - 1) / block;
-  std::vector<int64_t> cnt(static_cast<size_t>(n_tiles), 0);
+  int64_t n_src_tiles = (num_cols + block - 1) / block;
+  std::vector<int64_t> cnt(static_cast<size_t>(n_src_tiles), 0);
   std::vector<int64_t> touched;
   int64_t nnz = 0;
   for (int64_t t = 0; t < n_tiles; ++t) {
@@ -592,14 +600,14 @@ int64_t roc_block_counts(const int64_t* row_ptr, const int32_t* col,
     for (int64_t v = lo; v < hi; ++v) {
       for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
         int64_t s = col[e] / block;
-        if (col[e] < 0 || s >= n_tiles) return kErrValue;
+        if (col[e] < 0 || s >= n_src_tiles) return kErrValue;
         if (cnt[static_cast<size_t>(s)]++ == 0) touched.push_back(s);
       }
     }
     std::sort(touched.begin(), touched.end());
     for (int64_t s : touched) {
       if (nnz < cap) {
-        keys[nnz] = t * n_tiles + s;
+        keys[nnz] = t * n_src_tiles + s;
         counts[nnz] = cnt[static_cast<size_t>(s)];
       }
       ++nnz;
@@ -618,20 +626,23 @@ int64_t roc_block_counts(const int64_t* row_ptr, const int32_t* col,
 // per-row edge order preserved).  Returns the residual edge count, or
 // kErrValue on out-of-range columns / capacity overflow.
 int64_t roc_block_fill(const int64_t* row_ptr, const int32_t* col,
-                       int64_t num_rows, int64_t block,
+                       int64_t num_rows, int64_t num_cols,
+                       int64_t block,
                        const int64_t* dense_keys, int64_t nblk,
                        uint8_t* a, int64_t* res_ptr, int32_t* res_col,
                        int64_t res_cap) {
-  if (block <= 0) return kErrValue;
+  if (block <= 0 || num_cols <= 0) return kErrValue;
   int64_t n_tiles = (num_rows + block - 1) / block;
-  std::vector<int64_t> blk_of(static_cast<size_t>(n_tiles), -1);
+  int64_t n_src_tiles = (num_cols + block - 1) / block;
+  std::vector<int64_t> blk_of(static_cast<size_t>(n_src_tiles), -1);
   int64_t res_n = 0;
   int64_t k_lo = 0;
   for (int64_t t = 0; t < n_tiles; ++t) {
     int64_t k_hi = k_lo;
-    while (k_hi < nblk && dense_keys[k_hi] < (t + 1) * n_tiles) ++k_hi;
+    while (k_hi < nblk && dense_keys[k_hi] < (t + 1) * n_src_tiles)
+      ++k_hi;
     for (int64_t i = k_lo; i < k_hi; ++i) {
-      blk_of[static_cast<size_t>(dense_keys[i] % n_tiles)] = i;
+      blk_of[static_cast<size_t>(dense_keys[i] % n_src_tiles)] = i;
     }
     int64_t lo = t * block;
     int64_t hi = std::min(num_rows, lo + block);
@@ -639,7 +650,7 @@ int64_t roc_block_fill(const int64_t* row_ptr, const int32_t* col,
       res_ptr[v] = res_n;
       for (int64_t e = row_ptr[v]; e < row_ptr[v + 1]; ++e) {
         int64_t s = col[e] / block;
-        if (col[e] < 0 || s >= n_tiles) return kErrValue;
+        if (col[e] < 0 || s >= n_src_tiles) return kErrValue;
         int64_t b = blk_of[static_cast<size_t>(s)];
         if (b >= 0) {
           uint8_t* slot = a + (b * block + (v - lo)) * block
@@ -654,7 +665,7 @@ int64_t roc_block_fill(const int64_t* row_ptr, const int32_t* col,
       }
     }
     for (int64_t i = k_lo; i < k_hi; ++i) {
-      blk_of[static_cast<size_t>(dense_keys[i] % n_tiles)] = -1;
+      blk_of[static_cast<size_t>(dense_keys[i] % n_src_tiles)] = -1;
     }
     k_lo = k_hi;
   }

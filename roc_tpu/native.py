@@ -78,7 +78,7 @@ def _load() -> Optional[ctypes.CDLL]:
     # signatures; a stale or pinned .so from before an ABI bump would
     # read a pointer slot as an int (SIGSEGV or silent garbage), so
     # mismatches fall back to the numpy paths instead of loading.
-    _ABI_VERSION = 3
+    _ABI_VERSION = 4
     try:
         lib.roc_abi_version.restype = ctypes.c_int
         got = int(lib.roc_abi_version())
@@ -124,11 +124,11 @@ def _load() -> Optional[ctypes.CDLL]:
                                        i64p, i64p, i32p, i32p]
     u8p = c.POINTER(c.c_uint8)
     lib.roc_block_counts.restype = c.c_int64
-    lib.roc_block_counts.argtypes = [i64p, i32p, i64, i64, i64p, i64p,
-                                     i64]
+    lib.roc_block_counts.argtypes = [i64p, i32p, i64, i64, i64, i64p,
+                                     i64p, i64]
     lib.roc_block_fill.restype = c.c_int64
-    lib.roc_block_fill.argtypes = [i64p, i32p, i64, i64, i64p, i64,
-                                   u8p, i64p, i32p, i64]
+    lib.roc_block_fill.argtypes = [i64p, i32p, i64, i64, i64, i64p,
+                                   i64, u8p, i64p, i32p, i64]
     _lib = lib
     return _lib
 
@@ -292,21 +292,28 @@ def sectioned_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
 
 
 def block_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
-                 num_rows: int, block: int
+                 num_rows: int, block: int,
+                 num_cols: int = None
                  ) -> Tuple[np.ndarray, np.ndarray]:
     """(keys, counts) per occupied [block x block] adjacency tile,
-    key-ascending (ops/blockdense.py plan_blocks, census pass)."""
+    key-ascending (ops/blockdense.py plan_blocks, census pass).
+    ``num_cols`` sets a rectangular tile space (distributed planner:
+    local dst rows x gathered source coordinates); default square."""
     lib = _load()
     assert lib is not None
+    if num_cols is None:
+        num_cols = num_rows
     row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
     col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
     n_tiles = -(-num_rows // block)
-    cap = int(min(n_tiles * n_tiles, col_idx.shape[0], 1 << 27))
+    n_src_tiles = -(-num_cols // block)
+    cap = int(min(n_tiles * n_src_tiles, col_idx.shape[0], 1 << 27))
+    cap = max(cap, 1)
     while True:
         keys = np.empty(cap, dtype=np.int64)
         counts = np.empty(cap, dtype=np.int64)
         nnz = int(lib.roc_block_counts(
-            _i64p(row_ptr), _i32p(col_idx), num_rows, block,
+            _i64p(row_ptr), _i32p(col_idx), num_rows, num_cols, block,
             _i64p(keys), _i64p(counts), cap))
         if nnz < 0:
             raise ValueError(f"roc_block_counts failed: {nnz}")
@@ -316,13 +323,17 @@ def block_counts(row_ptr: np.ndarray, col_idx: np.ndarray,
 
 
 def block_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
-               num_rows: int, block: int, dense_keys: np.ndarray
+               num_rows: int, block: int, dense_keys: np.ndarray,
+               num_cols: int = None
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """(a_blocks uint8 [nblk, block, block], res_row_ptr, res_col):
     fill the selected tiles' multiplicity tables, spill the rest (and
-    saturated duplicates) to a residual dst-major CSR."""
+    saturated duplicates) to a residual dst-major CSR.  ``num_cols``
+    as in :func:`block_counts`."""
     lib = _load()
     assert lib is not None
+    if num_cols is None:
+        num_cols = num_rows
     row_ptr = np.ascontiguousarray(row_ptr, dtype=np.int64)
     col_idx = np.ascontiguousarray(col_idx, dtype=np.int32)
     dense_keys = np.ascontiguousarray(dense_keys, dtype=np.int64)
@@ -331,7 +342,7 @@ def block_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
     res_ptr = np.empty(num_rows + 1, dtype=np.int64)
     res_col = np.empty(col_idx.shape[0], dtype=np.int32)
     rc = int(lib.roc_block_fill(
-        _i64p(row_ptr), _i32p(col_idx), num_rows, block,
+        _i64p(row_ptr), _i32p(col_idx), num_rows, num_cols, block,
         _i64p(dense_keys), nblk,
         a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
         _i64p(res_ptr), _i32p(res_col), res_col.shape[0]))

@@ -65,6 +65,14 @@ class BlockPlan:
     res_col: np.ndarray
     dense_edges: int
     total_edges: int
+    # source tile space (== vpad for the square single-device plan;
+    # the distributed planner tiles local dst rows x GATHERED source
+    # coordinates, so src_vpad covers num_cols instead)
+    src_vpad: int = 0
+
+    def __post_init__(self):
+        if not self.src_vpad:
+            self.src_vpad = self.vpad
 
     @property
     def n_blocks(self) -> int:
@@ -103,7 +111,8 @@ def _select_dense(counts: np.ndarray, min_fill: int,
 
 def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
                 num_rows: int, min_fill: int = 64,
-                a_budget_bytes: Optional[int] = 2 << 30) -> BlockPlan:
+                a_budget_bytes: Optional[int] = 2 << 30,
+                num_cols: Optional[int] = None) -> BlockPlan:
     """Tile the dst-major CSR into [128, 128] blocks; blocks with at
     least ``min_fill`` edges go dense, the rest stay residual CSR.
 
@@ -112,12 +121,20 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
     are kept — fill, not count, is what amortizes the per-block cost,
     and an unbounded plan is unusable anyway (at Reddit scale with
     65k-row communities ~930k blocks qualify = a 15 GiB A-table that
-    no 16 GiB chip can hold).  ``None`` disables the cap."""
+    no 16 GiB chip can hold).  ``None`` disables the cap.
+
+    ``num_cols`` sets a RECTANGULAR tile space: dst rows stay
+    ``num_rows`` but source ids may range over ``num_cols`` (the
+    distributed planner's local-rows x gathered-coordinates case).
+    Default: square (``num_rows``)."""
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     col_i32 = np.ascontiguousarray(col_idx, dtype=np.int32)
     E = col_i32.shape[0]
     vpad = -(-num_rows // BLOCK) * BLOCK
-    n_tiles = vpad // BLOCK
+    if num_cols is None:
+        num_cols = num_rows
+    src_vpad = -(-num_cols // BLOCK) * BLOCK
+    n_tiles = src_vpad // BLOCK    # tiles per dst-tile row of keys
 
     from .. import native
     if native.available():
@@ -126,17 +143,19 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
         # byte-identical plans (tested).  col stays int32 throughout —
         # Graph.col_idx already is, so no full-E copies happen here
         keys_all, counts_all = native.block_counts(
-            row_ptr, col_i32, num_rows, BLOCK)
+            row_ptr, col_i32, num_rows, BLOCK, num_cols=num_cols)
         dense_keys = keys_all[_select_dense(counts_all, min_fill,
                                             a_budget_bytes)]
         a, res_ptr, res_col = native.block_fill(
-            row_ptr, col_i32, num_rows, BLOCK, dense_keys)
+            row_ptr, col_i32, num_rows, BLOCK, dense_keys,
+            num_cols=num_cols)
         return BlockPlan(
             num_rows=num_rows, vpad=vpad, a_blocks=a,
             src_blk=(dense_keys % n_tiles).astype(np.int32),
             dst_blk=(dense_keys // n_tiles).astype(np.int32),
             res_row_ptr=res_ptr, res_col=res_col,
-            dense_edges=E - res_col.shape[0], total_edges=E)
+            dense_edges=E - res_col.shape[0], total_edges=E,
+            src_vpad=src_vpad)
 
     # numpy fallback works in int64 key space
     col_idx = col_i32.astype(np.int64)
@@ -197,30 +216,38 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
     return BlockPlan(
         num_rows=num_rows, vpad=vpad,
         a_blocks=a,
-        src_blk=(dense_blocks % (vpad // BLOCK)).astype(np.int32),
-        dst_blk=(dense_blocks // (vpad // BLOCK)).astype(np.int32),
+        src_blk=(dense_blocks % n_tiles).astype(np.int32),
+        dst_blk=(dense_blocks // n_tiles).astype(np.int32),
         res_row_ptr=res_ptr, res_col=res_col.astype(np.int32),
-        dense_edges=dense_edges, total_edges=E)
+        dense_edges=dense_edges, total_edges=E,
+        src_vpad=src_vpad)
 
 
 def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
                           src_blk: jax.Array, dst_blk: jax.Array,
                           num_rows: int, vpad: int,
                           out_dtype=jnp.float32,
-                          chunk_blocks: int = _CHUNK_BLOCKS
+                          chunk_blocks: int = _CHUNK_BLOCKS,
+                          src_vpad: int = 0
                           ) -> jax.Array:
     """Dense-tile partial aggregation (the residual CSR is the
     caller's, via the sectioned/ELL path on the SAME x).
 
-    x: [num_rows(+1), F] features (trailing rows ignored).
+    x: [src_rows, F] source features; ``src_vpad`` (default: ``vpad``)
+    is the source tile space — equal to vpad for the square
+    single-device plan, the padded GATHERED row count for the
+    distributed per-partition plan (x then is the all-gathered
+    matrix, dst tiles cover only this partition's local rows).
     Returns [num_rows, F] in ``out_dtype`` — fp32 accumulation over
     tiles (a hub tile receives many sequential adds).
     """
     F = x.shape[1]
     nblk = a_blocks.shape[0]
     n_tiles = vpad // BLOCK
-    xt = jnp.zeros((vpad, F), dtype=x.dtype).at[:num_rows].set(
-        x[:num_rows]).reshape(n_tiles, BLOCK, F)
+    src_vpad = src_vpad or vpad
+    src_rows = min(x.shape[0], src_vpad)
+    xt = jnp.zeros((src_vpad, F), dtype=x.dtype).at[:src_rows].set(
+        x[:src_rows]).reshape(src_vpad // BLOCK, BLOCK, F)
     # pad the block list to a chunk multiple; padding scatters zero
     # tiles into a dummy output tile.  Small plans shrink the chunk so
     # padding never exceeds one chunk's worth of zero work.

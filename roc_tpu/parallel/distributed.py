@@ -138,9 +138,40 @@ class ShardedData:
     sect_idx: Tuple[jax.Array, ...] = ()
     sect_sub_dst: Tuple[jax.Array, ...] = ()
     sect_meta: Tuple[Tuple[int, int], ...] = ()
+    # block-dense MXU layout (aggr_impl == "bdense"): per-partition
+    # dense [128,128] tiles over (local dst rows x gathered source
+    # coords), padded to a uniform block count; () or
+    # (a [P,nblk,128,128] u8, src_blk [P,nblk], dst_blk [P,nblk]).
+    # The residual scattered edges ride the sect_* tables above.
+    bd_tabs: Tuple[jax.Array, ...] = ()
+    bd_vpad: int = 0        # dst tile space (covers part_nodes)
+    bd_src_vpad: int = 0    # src tile space (covers gathered rows)
+    bd_occupancy: Tuple[dict, ...] = ()   # per-part plan stats
     # padded slots / real edges of the ring tables (halo='ring' only);
     # surfaced so trainer setup can echo the SPMD-uniformity cost
     ring_padding_ratio: Optional[float] = None
+
+
+def _sectioned_tables(ptrs: np.ndarray, cols: np.ndarray,
+                      pg: PartitionedGraph, src_rows: int,
+                      section_rows: Optional[int], sect_sub_w: int,
+                      sect_u16: bool, put):
+    """Build + upload the stacked per-part sectioned tables — shared
+    by the 'sectioned' branch (whole CSR) and the 'bdense' branch
+    (residual CSR), so tuning knobs apply to both in one place.
+    Returns (sect_idx, sect_sub_dst, sect_meta)."""
+    from ..core.ell import (default_section_rows,
+                            sectioned_from_padded_parts)
+    if section_rows is None:
+        section_rows = default_section_rows(sect_u16)
+    sect = sectioned_from_padded_parts(
+        ptrs, cols, pg.real_nodes, pg.part_nodes, src_rows=src_rows,
+        section_rows=section_rows, sub_w=sect_sub_w)
+    if sect_u16:
+        sect = sect.with_idx_dtype(np.uint16)
+    return (tuple(put(a) for a in sect.idx),
+            tuple(put(a) for a in sect.sub_dst),
+            tuple(zip(sect.sec_starts, sect.sec_sizes)))
 
 
 def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
@@ -148,7 +179,9 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   aggr_impl: str = "segment",
                   halo: str = "gather",
                   put=None, section_rows: Optional[int] = None,
-                  sect_sub_w: int = 8, sect_u16: bool = False
+                  sect_sub_w: int = 8, sect_u16: bool = False,
+                  bdense_min_fill: int = 64,
+                  bdense_a_budget: Optional[int] = 2 << 30
                   ) -> ShardedData:
     """Build + upload the stacked per-part arrays.  ``put`` overrides
     the upload (default: replicated-process ``device_put`` with the
@@ -167,6 +200,10 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     sect_idx = ()
     sect_sub_dst = ()
     sect_meta = ()
+    bd_tabs = ()
+    bd_vpad = 0
+    bd_src_vpad = 0
+    bd_occupancy = ()
     ring_padding_ratio = None
     if halo == "ring":
         # ring tables fully describe the aggregation — skip the O(E)
@@ -179,7 +216,8 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
     else:
         col_padded = remap_to_padded(pg)
-        if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8"):
+        if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
+                         "bdense"):
             # table-driven paths never read the flat edge arrays —
             # upload stubs instead of two [P, E_p] tensors
             edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
@@ -196,20 +234,64 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
             ell_row_pos = put(table.row_pos)
             ell_row_id = tuple(put(a) for a in table.row_id)
         elif aggr_impl == "sectioned":
-            from ..core.ell import (default_section_rows,
-                                    sectioned_from_padded_parts)
-            if section_rows is None:
-                section_rows = default_section_rows(sect_u16)
-            sect = sectioned_from_padded_parts(
-                pg.part_row_ptr, col_padded, pg.real_nodes,
-                pg.part_nodes,
+            sect_idx, sect_sub_dst, sect_meta = _sectioned_tables(
+                pg.part_row_ptr, col_padded, pg,
                 src_rows=pg.num_parts * pg.part_nodes,
-                section_rows=section_rows, sub_w=sect_sub_w)
-            if sect_u16:
-                sect = sect.with_idx_dtype(np.uint16)
-            sect_idx = tuple(put(a) for a in sect.idx)
-            sect_sub_dst = tuple(put(a) for a in sect.sub_dst)
-            sect_meta = tuple(zip(sect.sec_starts, sect.sec_sizes))
+                section_rows=section_rows, sect_sub_w=sect_sub_w,
+                sect_u16=sect_u16, put=put)
+        elif aggr_impl == "bdense":
+            # per-partition block-dense plans over the RECTANGULAR
+            # tile space (local dst rows x gathered source coords —
+            # ops/blockdense.py plan_blocks num_cols).  Stacked to a
+            # uniform block count: short partitions pad with zero-A
+            # tiles scattered into the dummy output tile, so every
+            # device runs the same program (SPMD uniformity, exactly
+            # the sectioned tables' padding-chunk scheme).
+            from ..core.ell import clean_part_ptr
+            from ..ops.blockdense import BLOCK, plan_blocks
+            src_rows = pg.num_parts * pg.part_nodes
+            plans = []
+            for p in range(pg.num_parts):
+                ptr = clean_part_ptr(pg.part_row_ptr[p],
+                                     pg.real_nodes[p], pg.part_nodes)
+                cols = col_padded[p][:int(ptr[-1])]
+                plans.append(plan_blocks(
+                    ptr, cols, pg.part_nodes,
+                    min_fill=bdense_min_fill,
+                    a_budget_bytes=bdense_a_budget,
+                    num_cols=src_rows))
+            bd_occupancy = tuple(pl.occupancy() for pl in plans)
+            nblk_max = max(pl.n_blocks for pl in plans)
+            if nblk_max:
+                bd_vpad = plans[0].vpad
+                bd_src_vpad = plans[0].src_vpad
+                n_dst_tiles = bd_vpad // BLOCK
+                a = np.zeros((pg.num_parts, nblk_max, BLOCK, BLOCK),
+                             dtype=np.uint8)
+                sblk = np.zeros((pg.num_parts, nblk_max),
+                                dtype=np.int32)
+                # padding blocks target the dummy output tile (index
+                # n_dst_tiles) — zero A keeps them numerically inert,
+                # the dummy dst keeps even rounding noise off real rows
+                dblk = np.full((pg.num_parts, nblk_max), n_dst_tiles,
+                               dtype=np.int32)
+                for p, pl in enumerate(plans):
+                    nb = pl.n_blocks
+                    a[p, :nb] = pl.a_blocks
+                    sblk[p, :nb] = pl.src_blk
+                    dblk[p, :nb] = pl.dst_blk
+                bd_tabs = (put(a), put(sblk), put(dblk))
+            # residual scattered edges -> the stacked sectioned tables
+            # (every edge, when no tile qualifies anywhere)
+            e_res = max(max(pl.res_col.shape[0] for pl in plans), 1)
+            res_ptrs = np.stack([pl.res_row_ptr for pl in plans])
+            res_cols = np.zeros((pg.num_parts, e_res), dtype=np.int32)
+            for p, pl in enumerate(plans):
+                res_cols[p, :pl.res_col.shape[0]] = pl.res_col
+            sect_idx, sect_sub_dst, sect_meta = _sectioned_tables(
+                res_ptrs, res_cols, pg, src_rows=src_rows,
+                section_rows=section_rows, sect_sub_w=sect_sub_w,
+                sect_u16=sect_u16, put=put)
         elif aggr_impl == "attn_flat8":
             # large-graph attention, sharded: per-partition SINGLE-
             # section tables over gathered coordinates (one uniform
@@ -224,7 +306,8 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                 section_rows=src_rows, seg_rows=8192)
             sect_idx = tuple(put(a) for a in sect.idx)
             sect_sub_dst = tuple(put(a) for a in sect.sub_dst)
-        if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8"):
+        if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
+                         "bdense"):
             col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
@@ -240,6 +323,10 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst,
         sect_meta=sect_meta,
+        bd_tabs=bd_tabs,
+        bd_vpad=bd_vpad,
+        bd_src_vpad=bd_src_vpad,
+        bd_occupancy=bd_occupancy,
         ring_padding_ratio=ring_padding_ratio,
     )
 
@@ -307,11 +394,6 @@ class DistributedTrainer:
         # multi-chip attention at >=20M edges would otherwise re-hit
         # the per-width-bucket compile wall (VERDICT r4 weak #3)
         config = resolve_attention_impl(model, config, dataset)
-        if config.aggr_impl == "bdense":
-            raise NotImplementedError(
-                "aggr_impl='bdense' is single-device (dense tiles over "
-                "the global id space; a per-partition tile build is "
-                "future work) — use 'sectioned' or 'ell' distributed")
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0
@@ -336,7 +418,24 @@ class DistributedTrainer:
             aggr_impl=config.aggr_impl,
             halo=config.halo,
             sect_sub_w=config.sect_sub_w,
-            sect_u16=config.sect_u16)
+            sect_u16=config.sect_u16,
+            bdense_min_fill=config.bdense_min_fill)
+        if config.aggr_impl == "bdense" and config.halo != "ring":
+            import sys
+            if config.verbose:
+                for p, occ in enumerate(self.data.bd_occupancy):
+                    print(f"# bdense part {p}: {occ['n_blocks']} "
+                          f"blocks, dense_frac={occ['dense_frac']}, "
+                          f"mean_fill={occ['mean_fill']}",
+                          file=sys.stderr)
+            if not self.data.bd_tabs:
+                # changes the effective execution path — echoes
+                # unconditionally, like the single-device fallback
+                # (train/trainer.py)
+                print("# bdense: no [128,128] tile reaches min_fill="
+                      f"{config.bdense_min_fill} on any partition — "
+                      "running the pure sectioned residual",
+                      file=sys.stderr)
         if data is not None:
             # the autopilot / auto-resolution above may have settled on
             # a different halo/aggr_impl than the caller built tables
@@ -349,7 +448,8 @@ class DistributedTrainer:
                     "shard_dataset_local(..., halo='ring') or pass "
                     "memory/halo explicitly)")
             if config.halo != "ring":
-                if config.aggr_impl in ("sectioned", "attn_flat8") \
+                if config.aggr_impl in ("sectioned", "attn_flat8",
+                                        "bdense") \
                         and not self.data.sect_idx:
                     raise ValueError(
                         f"injected data has no sectioned/flat8 tables "
@@ -419,15 +519,18 @@ class DistributedTrainer:
             symmetric=self.symmetric,
             halo=self.config.halo,
             sect_meta=self.data.sect_meta,
+            bd_vpad=self.data.bd_vpad,
+            bd_src_vpad=self.data.bd_src_vpad,
         )
 
     def _local_gctx(self, edge_src, edge_dst, in_degree, ell_idx,
                     ell_row_pos, ell_row_id, ring_idx, sect_idx,
-                    sect_sub_dst) -> GraphContext:
+                    sect_sub_dst, bd_tabs=()) -> GraphContext:
         """Local-block GraphContext for a shard_map body: slice the
         parts axis off every table.  attn_flat8 carries its single-
         section tables in the sect slots (ShardedData docstring) and
-        routes them to the flat8 fields the builder reads."""
+        routes them to the flat8 fields the builder reads; bdense
+        carries its residual there and its dense tiles in bd_tabs."""
         flat8 = self.config.aggr_impl == "attn_flat8"
         return dc_replace(
             self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
@@ -440,7 +543,10 @@ class DistributedTrainer:
             sect_sub_dst=(() if flat8
                           else tuple(a[0] for a in sect_sub_dst)),
             flat8_idx=sect_idx[0][0] if flat8 else None,
-            flat8_dst=sect_sub_dst[0][0] if flat8 else None)
+            flat8_dst=sect_sub_dst[0][0] if flat8 else None,
+            bd_a=bd_tabs[0][0] if bd_tabs else None,
+            bd_src=bd_tabs[1][0] if bd_tabs else None,
+            bd_dst=bd_tabs[2][0] if bd_tabs else None)
 
     def _build_train_step(self):
         mesh = self.mesh
@@ -449,13 +555,13 @@ class DistributedTrainer:
 
         def step(params, opt_state, feats, labels, mask, edge_src,
                  edge_dst, in_degree, ell_idx, ell_row_pos, ell_row_id,
-                 ring_idx, sect_idx, sect_sub_dst, key, lr):
+                 ring_idx, sect_idx, sect_sub_dst, bd_tabs, key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
             gctx = self._local_gctx(
                 edge_src[0], edge_dst[0], in_degree[0], ell_idx,
                 ell_row_pos, ell_row_id, ring_idx, sect_idx,
-                sect_sub_dst)
+                sect_sub_dst, bd_tabs)
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
@@ -482,14 +588,14 @@ class DistributedTrainer:
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_r, spec_r),
+                      spec_p, spec_p, spec_p, spec_r, spec_r),
             out_specs=(spec_r, spec_r, spec_r),
             check_vma=False)
         return jax.jit(sm, donate_argnums=(0, 1))
 
     def _local_forward(self, params, feats, edge_src, edge_dst,
                        in_degree, ell_idx, ell_row_pos, ell_row_id,
-                       ring_idx, sect_idx, sect_sub_dst):
+                       ring_idx, sect_idx, sect_sub_dst, bd_tabs):
         """Shared shard_map body: slice the parts axis off the local
         blocks, assemble the local GraphContext, run the inference
         forward — eval (adds metrics+psum) and predict (adds
@@ -498,7 +604,8 @@ class DistributedTrainer:
         feats = feats[0]
         gctx = self._local_gctx(
             edge_src[0], edge_dst[0], in_degree[0], ell_idx,
-            ell_row_pos, ell_row_id, ring_idx, sect_idx, sect_sub_dst)
+            ell_row_pos, ell_row_id, ring_idx, sect_idx, sect_sub_dst,
+            bd_tabs)
         return self.model.apply(cast_floats(params, self.compute),
                                 feats, gctx, key=None, train=False)
 
@@ -517,7 +624,7 @@ class DistributedTrainer:
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p),
+                      spec_p, spec_p),
             out_specs=spec_r, check_vma=False)
         return jax.jit(sm)
 
@@ -532,7 +639,7 @@ class DistributedTrainer:
                 self.params, self.opt_state, d.feats, d.labels,
                 d.mask, d.edge_src, d.edge_dst, d.in_degree,
                 d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
-                d.sect_idx, d.sect_sub_dst, step_key, lr)
+                d.sect_idx, d.sect_sub_dst, d.bd_tabs, step_key, lr)
 
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
@@ -548,7 +655,8 @@ class DistributedTrainer:
         m = summarize_metrics(jax.device_get(self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
             d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
-            d.ell_row_id, d.ring_idx, d.sect_idx, d.sect_sub_dst)))
+            d.ell_row_id, d.ring_idx, d.sect_idx, d.sect_sub_dst,
+            d.bd_tabs)))
         m["epoch"] = epoch
         return m
 
@@ -567,7 +675,7 @@ class DistributedTrainer:
         logits = jax.device_get(self._predict_step(
             self.params, d.feats, d.edge_src, d.edge_dst, d.in_degree,
             d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
-            d.sect_idx, d.sect_sub_dst))
+            d.sect_idx, d.sect_sub_dst, d.bd_tabs))
         return unpad_nodes(logits, self.pg)
 
     def _build_predict_step(self):
@@ -583,6 +691,6 @@ class DistributedTrainer:
         sm = jax.shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_p),
+                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p),
             out_specs=spec_r, check_vma=False)
         return jax.jit(sm)

@@ -173,6 +173,13 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     """
     import jax.numpy as jnp
     from ..core.ell import build_ell, ell_shape_plan, place_ell_part
+    if aggr_impl == "bdense":
+        raise NotImplementedError(
+            "aggr_impl='bdense' is single-controller only for now: the "
+            "uniform per-partition block count needs a cross-process "
+            "agreement pass this builder doesn't have — use "
+            "distributed.shard_dataset (single process) or "
+            "aggr_impl='sectioned' multi-host")
     from ..core.graph import MASK_NONE
     from ..core.partition import partition_col
     from ..core.source import as_source

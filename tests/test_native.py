@@ -198,3 +198,41 @@ def test_block_plan_native_matches_numpy():
         np.testing.assert_array_equal(pn.res_row_ptr, pp.res_row_ptr)
         np.testing.assert_array_equal(pn.res_col, pp.res_col)
         assert pn.dense_edges == pp.dense_edges
+
+
+def test_block_plan_rectangular_native_matches_numpy():
+    """num_cols > num_rows (the distributed local-rows x gathered-
+    coords plan): native and numpy paths agree byte-for-byte, and
+    src tiles index the WIDE space."""
+    if not native.available():
+        pytest.skip("librocio not built")
+    import roc_tpu.native as native_mod
+    from roc_tpu.ops import blockdense as bd
+
+    rng = np.random.RandomState(7)
+    num_rows, num_cols, E = 200, 900, 4000
+    # concentrate sources high so src tiles beyond the square range
+    # are exercised
+    col = np.sort(rng.randint(500, num_cols, size=E)).astype(np.int32)
+    rng.shuffle(col)
+    deg = rng.multinomial(E, np.ones(num_rows) / num_rows)
+    row_ptr = np.zeros(num_rows + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_ptr[1:])
+    col.sort()  # per-row order irrelevant; global sort is fine
+    pn = bd.plan_blocks(row_ptr, col, num_rows, min_fill=8,
+                        num_cols=num_cols)
+    avail = native_mod.available
+    native_mod.available = lambda: False
+    try:
+        pp = bd.plan_blocks(row_ptr, col, num_rows, min_fill=8,
+                            num_cols=num_cols)
+    finally:
+        native_mod.available = avail
+    assert pn.src_vpad == -(-num_cols // bd.BLOCK) * bd.BLOCK
+    assert pn.src_blk.max() >= num_rows // bd.BLOCK  # wide space hit
+    for a, b in ((pn.a_blocks, pp.a_blocks), (pn.src_blk, pp.src_blk),
+                 (pn.dst_blk, pp.dst_blk),
+                 (pn.res_row_ptr, pp.res_row_ptr),
+                 (pn.res_col, pp.res_col)):
+        np.testing.assert_array_equal(a, b)
+    assert pn.dense_edges == pp.dense_edges
