@@ -1,0 +1,50 @@
+"""Shared benchmark-substrate spec parser (micro_agg.py,
+blockdense_occupancy.py): ONE grammar for the synthetic graphs the
+aggregation races run on.
+
+    random               uniform sources (the headline synthetic)
+    planted[:ROWS]       ROWS-row communities, SHUFFLED vertex ids
+    plantedo[:ROWS]      same, ORACLE order (upper bound for any
+                         reordering pass)
+    skew[:A]             hub sources, u**(1+A) mapping
+"""
+
+GRAPH_SPEC_HELP = ("random | planted[:COMMUNITY_ROWS] (community "
+                   "structure with shuffled ids) | "
+                   "plantedo[:COMMUNITY_ROWS] (same, ORACLE vertex "
+                   "order — upper bound for any reordering pass) | "
+                   "skew[:A] (hub sources, u**(1+A) mapping)")
+
+
+def graph_from_spec(spec: str, V: int, E: int):
+    from roc_tpu.core.graph import planted_community_csr, random_csr
+    parts = spec.split(":")
+    if parts[0] == "random":
+        return random_csr(V, E, seed=0)
+    if parts[0] in ("planted", "plantedo"):
+        rows = int(parts[1]) if len(parts) > 1 else 65_536
+        return planted_community_csr(V, E, community_rows=rows, seed=0,
+                                     shuffle=(parts[0] == "planted"))
+    if parts[0] == "skew":
+        a = float(parts[1]) if len(parts) > 1 else 3.0
+        # one community spanning the whole graph + skewed member pick
+        # = globally hub-skewed sources
+        return planted_community_csr(V, E, community_rows=V,
+                                     intra_frac=1.0, seed=0,
+                                     shuffle=False, src_skew=a)
+    raise SystemExit(f"unknown --graph {spec!r}")
+
+
+def reorder_graph(g, name: str):
+    """Apply a registered ordering pass (or 'none'); returns
+    (graph, seconds)."""
+    if name == "none":
+        return g, 0.0
+    import time
+
+    from roc_tpu.core.reorder import ORDERINGS, apply_graph_order
+    if name not in ORDERINGS:
+        raise SystemExit(f"unknown --reorder {name!r}")
+    t0 = time.time()
+    g = apply_graph_order(g, ORDERINGS[name](g))
+    return g, time.time() - t0

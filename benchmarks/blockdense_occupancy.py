@@ -1,0 +1,82 @@
+"""Block-dense occupancy census: how much of a graph's edge mass can
+ride [128,128] MXU tiles under a given vertex order.
+
+Host-side only (no accelerator): the stat that decides whether
+``aggr_impl='bdense'`` can beat the ~7 ns/edge gather row-rate
+(BASELINE.md "Round-5 additions").  Substrate spec mirrors
+micro_agg's ``--graph``, plus an optional reorder pass so the
+ordering-recovery claim (core/reorder.py lpa_order) is measurable at
+any scale with one command:
+
+    python benchmarks/blockdense_occupancy.py \
+        --nodes 232965 --edges 114848857 \
+        --graph planted:16384 --reorder lpa --tag reddit_shuffled_lpa
+
+Merges the row into benchmarks/blockdense_occupancy.json.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(HERE, "blockdense_occupancy.json")
+
+
+def main():
+    from _substrates import GRAPH_SPEC_HELP, graph_from_spec, \
+        reorder_graph
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=232_965)
+    ap.add_argument("--edges", type=int, default=114_848_857)
+    ap.add_argument("--graph", default="planted:16384",
+                    help=GRAPH_SPEC_HELP)
+    ap.add_argument("--reorder", default="none",
+                    choices=["none", "bfs", "lpa"])
+    ap.add_argument("--min-fill", type=int, default=64)
+    ap.add_argument("--a-budget", type=int, default=2 << 30)
+    ap.add_argument("--tag", default=None,
+                    help="JSON key (default: derived from the spec)")
+    args = ap.parse_args()
+
+    t0 = time.time()
+    g = graph_from_spec(args.graph, args.nodes, args.edges)
+    gen_s = time.time() - t0
+
+    g, reorder_s = reorder_graph(g, args.reorder)
+    if reorder_s:
+        print(f"# {args.reorder} reorder: {reorder_s:.1f}s")
+
+    from roc_tpu.ops.blockdense import plan_blocks
+    t0 = time.time()
+    plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
+                       min_fill=args.min_fill,
+                       a_budget_bytes=args.a_budget)
+    plan_s = time.time() - t0
+
+    row = dict(plan.occupancy(), V=g.num_nodes, E=g.num_edges,
+               min_fill=args.min_fill, gen_s=round(gen_s, 1),
+               plan_s=round(plan_s, 1),
+               reorder=args.reorder,
+               reorder_s=round(reorder_s, 1))
+    tag = args.tag or (args.graph.replace(":", "") +
+                       ("" if args.reorder == "none"
+                        else f"_{args.reorder}"))
+    print(tag, json.dumps(row, sort_keys=True))
+
+    data = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            data = json.load(f)
+    data[tag] = row
+    with open(OUT, "w") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+
+
+if __name__ == "__main__":
+    main()

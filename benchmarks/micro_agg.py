@@ -48,16 +48,12 @@ def main():
                     default="ell,pallas,scan:2048,scan:4096,blocked:1024")
     ap.add_argument("--seg-rows", type=int, default=131_072,
                     help="sectioned carry-scan chunk size (sub-rows)")
+    from _substrates import GRAPH_SPEC_HELP
     ap.add_argument("--graph", type=str, default="random",
-                    help="random | planted[:COMMUNITY_ROWS] (community "
-                         "structure with shuffled ids) | "
-                         "plantedo[:COMMUNITY_ROWS] (same, ORACLE "
-                         "vertex order — upper bound for any "
-                         "reordering pass) | "
-                         "skew[:A] (hub sources, u**(1+A) mapping)")
+                    help=GRAPH_SPEC_HELP)
     ap.add_argument("--reorder", type=str, default="none",
-                    help="none | bfs — relabel vertices before table "
-                         "build (core/reorder.py)")
+                    help="none | bfs | lpa — relabel vertices before "
+                         "table build (core/reorder.py)")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the env var alone is "
                          "overridden by the axon sitecustomize)")
@@ -67,7 +63,7 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    from roc_tpu.core.graph import planted_community_csr, random_csr
+    from _substrates import graph_from_spec, reorder_graph
     from roc_tpu.core.partition import padded_edge_list
     from roc_tpu.ops.aggregate import aggregate, aggregate_ell
 
@@ -79,29 +75,10 @@ def main():
     f0 = jax.jit(lambda x: x + 1.0)
     print(f"# sync overhead ~{bench(lambda: f0(z), args.iters):.1f} ms "
           f"(subtract from rows below)")
-    gspec = args.graph.split(":")
-    if gspec[0] == "random":
-        g = random_csr(V, E, seed=0)
-    elif gspec[0] in ("planted", "plantedo"):
-        rows = int(gspec[1]) if len(gspec) > 1 else 65_536
-        g = planted_community_csr(V, E, community_rows=rows, seed=0,
-                                  shuffle=(gspec[0] == "planted"))
-    elif gspec[0] == "skew":
-        a = float(gspec[1]) if len(gspec) > 1 else 3.0
-        # one community spanning the whole graph + skewed member pick
-        # = globally hub-skewed sources
-        g = planted_community_csr(V, E, community_rows=V,
-                                  intra_frac=1.0, seed=0,
-                                  shuffle=False, src_skew=a)
-    else:
-        raise SystemExit(f"unknown --graph {args.graph!r}")
-    if args.reorder == "bfs":
-        from roc_tpu.core.reorder import apply_graph_order, bfs_order
-        t0 = time.time()
-        g = apply_graph_order(g, bfs_order(g))
-        print(f"# bfs reorder: {time.time() - t0:.1f}s")
-    elif args.reorder != "none":
-        raise SystemExit(f"unknown --reorder {args.reorder!r}")
+    g = graph_from_spec(args.graph, V, E)
+    g, reorder_s = reorder_graph(g, args.reorder)
+    if reorder_s:
+        print(f"# {args.reorder} reorder: {reorder_s:.1f}s")
     dtype = getattr(jnp, args.dtype)
     feats_np = np.random.RandomState(0).rand(V + 1, F).astype(np.float32)
     feats_np[-1] = 0

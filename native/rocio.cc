@@ -49,7 +49,8 @@ extern "C" {
 // v4: num_cols parameter inserted into roc_block_counts/_fill (the
 //     distributed block-dense planner tiles a RECTANGULAR space:
 //     local dst rows x gathered source coordinates).
-int roc_abi_version(void) { return 4; }
+// v5: roc_lpa_iterate added (label-propagation vertex ordering).
+int roc_abi_version(void) { return 5; }
 
 // ---------------------------------------------------------------------------
 // .lux binary format: u32 num_nodes, u64 num_edges, num_nodes x u64
@@ -671,6 +672,64 @@ int64_t roc_block_fill(const int64_t* row_ptr, const int32_t* col,
   }
   res_ptr[num_rows] = res_n;
   return res_n;
+}
+
+// ---------------------------------------------------------------------------
+// Label propagation (core/reorder.py lpa_order): one ASYNCHRONOUS
+// sweep over an undirected neighbor CSR, in increasing vertex order.
+// labels_out starts as a copy of labels and every vote READS
+// labels_out, so vertex v sees the already-updated labels of
+// vertices < v.  labels_out[v] = the most frequent label among v's
+// neighbors, ties -> smallest label; isolated vertices keep theirs.
+// Returns the number of vertices whose final label differs from the
+// entry label (the caller iterates to convergence).
+//
+// Asynchrony is load-bearing, not an optimization: fully-synchronous
+// LPA 2-cycles (a star flips center<->leaf labels forever, so a
+// convergence test never fires and the result depends on sweep-count
+// parity), and no fixed vertex bipartition fixes that (same-class
+// cycles survive).  The async rule is cycle-free by a lexicographic
+// potential: every change either strictly raises the vertex's
+// neighbor-agreement count or keeps it equal while strictly lowering
+// the label (smallest-among-maxima tie rule), so sweeps terminate.
+// The numpy fallback replays the identical vertex order — results
+// are tested equal.
+// ---------------------------------------------------------------------------
+
+int64_t roc_lpa_iterate(const int64_t* nbr_ptr, const int32_t* nbr,
+                        int64_t num_nodes, const int32_t* labels,
+                        int32_t* labels_out) {
+  std::vector<int32_t> scratch;
+  int64_t changed = 0;
+  std::copy(labels, labels + num_nodes, labels_out);
+  for (int64_t v = 0; v < num_nodes; ++v) {
+    int64_t lo = nbr_ptr[v], hi = nbr_ptr[v + 1];
+    if (hi <= lo) {
+      continue;
+    }
+    scratch.clear();
+    for (int64_t e = lo; e < hi; ++e) {
+      if (nbr[e] < 0 || nbr[e] >= num_nodes) return kErrValue;
+      scratch.push_back(labels_out[nbr[e]]);
+    }
+    std::sort(scratch.begin(), scratch.end());
+    int32_t best = scratch[0];
+    int64_t best_n = 0;
+    const int64_t n = static_cast<int64_t>(scratch.size());
+    int64_t i = 0;
+    while (i < n) {
+      int64_t j = i;
+      while (j < n && scratch[j] == scratch[i]) ++j;
+      if (j - i > best_n) {
+        best_n = j - i;
+        best = scratch[i];
+      }
+      i = j;
+    }
+    labels_out[v] = best;
+    if (best != labels[v]) ++changed;
+  }
+  return changed;
 }
 
 }  // extern "C"

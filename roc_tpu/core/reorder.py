@@ -11,6 +11,14 @@ narrow id range.  This module provides that preprocessing pass:
 - :func:`bfs_order` — breadth-first relabeling from a max-degree seed
   (the classic bandwidth-reduction family: neighbors get consecutive
   ids, communities become contiguous id blocks);
+- :func:`lpa_order` — label-propagation community detection +
+  cluster-major relabeling.  The ordering quality the block-dense MXU
+  path (``ops/blockdense.py``) rides on: BFS recovers only ~5% of the
+  oracle dense_frac on a shuffled planted-community graph, LPA
+  recovers it EXACTLY (measured: oracle 0.813, shuffled 0.003,
+  shuffled+bfs 0.045, shuffled+lpa 0.813 at V=65k/E=8M/communities
+  4096) because communities become contiguous id blocks regardless of
+  where BFS's frontier happens to wander;
 - :func:`apply_vertex_order` — permute a whole Dataset (CSR, features,
   labels, masks) so training on the reordered graph is equivalent up
   to the vertex relabeling (logits come back in the NEW order; use the
@@ -34,22 +42,33 @@ import numpy as np
 from .graph import Dataset, Graph
 
 
+def _undirected_csr(graph: Graph) -> Tuple[np.ndarray, np.ndarray]:
+    """(nbr_ptr, nbr int32): symmetrized adjacency — in-edges (CSR
+    rows) + out-edges (reverse), duplicates kept (they weight the LPA
+    vote like the aggregation weights the sum).  int32 neighbors:
+    vertex ids come from an int32 col_idx, and the Reddit-scale
+    undirected table is ~230M entries — int64 would double its
+    resident gigabyte for nothing."""
+    V = graph.num_nodes
+    deg_in = np.diff(graph.row_ptr)
+    dst_all = np.repeat(np.arange(V, dtype=np.int32), deg_in)
+    src_all = np.asarray(graph.col_idx, dtype=np.int32)
+    u = np.concatenate([src_all, dst_all])
+    v = np.concatenate([dst_all, src_all])
+    order = np.argsort(u, kind="stable")
+    v = v[order]
+    nbr_ptr = np.zeros(V + 1, dtype=np.int64)
+    np.cumsum(np.bincount(u, minlength=V), out=nbr_ptr[1:])
+    return nbr_ptr, v
+
+
 def bfs_order(graph: Graph) -> np.ndarray:
     """``perm[new_id] == old_id``: BFS relabeling over the undirected
     view of the CSR, seeded at the max-in-degree vertex of each
     component (processed in decreasing seed degree).  O(V + E)."""
     V = graph.num_nodes
-    row_ptr, col = graph.row_ptr, graph.col_idx
-    # undirected adjacency: in-edges (CSR rows) + out-edges (reverse)
-    deg_in = np.diff(row_ptr)
-    dst_all = np.repeat(np.arange(V, dtype=np.int64), deg_in)
-    src_all = col.astype(np.int64)
-    u = np.concatenate([src_all, dst_all])
-    v = np.concatenate([dst_all, src_all])
-    order = np.argsort(u, kind="stable")
-    u, v = u[order], v[order]
-    nbr_ptr = np.zeros(V + 1, dtype=np.int64)
-    np.cumsum(np.bincount(u, minlength=V), out=nbr_ptr[1:])
+    deg_in = np.diff(graph.row_ptr)
+    nbr_ptr, v = _undirected_csr(graph)
 
     visited = np.zeros(V, dtype=bool)
     out = np.empty(V, dtype=np.int64)
@@ -79,6 +98,76 @@ def bfs_order(graph: Graph) -> np.ndarray:
             frontier = nxt
     assert pos == V
     return out
+
+
+def lpa_labels(graph: Graph, max_iters: int = 16,
+               tol_frac: float = 1e-3) -> np.ndarray:
+    """int32 [V] community labels via ASYNCHRONOUS label propagation
+    over the undirected view: each sweep walks vertices in increasing
+    id order, assigning each the most frequent label among its
+    neighbors AS ALREADY UPDATED this sweep (ties -> smallest label;
+    isolated vertices keep theirs).  Asynchrony is what makes the
+    pass terminate: fully-synchronous LPA 2-cycles on bipartite-like
+    structures (a star flips center<->leaf labels every sweep, so a
+    convergence test never fires and the result depends on sweep
+    count), and no fixed vertex bipartition fixes that.  The async
+    rule is cycle-free by a lexicographic potential — every change
+    strictly raises the vertex's neighbor-agreement count or keeps it
+    equal while strictly lowering the label.  Stops when a sweep
+    changes fewer than ``tol_frac * V`` labels or after ``max_iters``
+    sweeps.  O(E) per sweep on the native path (``roc_lpa_iterate``);
+    the numpy fallback replays the identical vertex order (slow
+    Python loop — correctness/CI path, the native library is the
+    scale path), tested equal."""
+    V = graph.num_nodes
+    nbr_ptr, nbr = _undirected_csr(graph)
+    labels = np.arange(V, dtype=np.int32)
+    tol = max(1, int(tol_frac * V))
+
+    from .. import native
+    use_native = native.available()
+    for _ in range(max_iters):
+        if use_native:
+            labels, changed = native.lpa_iterate(nbr_ptr, nbr, labels)
+        else:
+            labels, changed = _lpa_sweep_numpy(nbr_ptr, nbr, labels, V)
+        if changed < tol:
+            break
+    return labels
+
+
+def _lpa_sweep_numpy(nbr_ptr: np.ndarray, nbr: np.ndarray,
+                     labels: np.ndarray, V: int
+                     ) -> Tuple[np.ndarray, int]:
+    """One asynchronous sweep, id order — the exact semantics of the
+    native ``roc_lpa_iterate`` (tested equal).  Per-vertex Python
+    loop: the fallback exists for environments without the native
+    library, not for Reddit-scale graphs."""
+    out = labels.copy()
+    for v in range(V):
+        lo, hi = nbr_ptr[v], nbr_ptr[v + 1]
+        if hi <= lo:
+            continue
+        votes = out[nbr[lo:hi]]
+        vals, cnt = np.unique(votes, return_counts=True)
+        # smallest label among the maxima (np.unique sorts vals, so
+        # argmax's first-hit rule lands on it)
+        out[v] = vals[np.argmax(cnt)]
+    return out, int((out != labels).sum())
+
+
+def lpa_order(graph: Graph, max_iters: int = 16) -> np.ndarray:
+    """``perm[new_id] == old_id``: cluster-major relabeling from
+    label-propagation communities (original id order within each
+    cluster).  The ordering pass that makes ``aggr_impl='bdense'``
+    win on community graphs with arbitrary vertex ids — see the
+    module docstring for the measured oracle-recovery numbers."""
+    labels = lpa_labels(graph, max_iters=max_iters)
+    return np.lexsort((np.arange(graph.num_nodes), labels))
+
+
+# the CLI/benchmark dispatch — ONE place to register an ordering pass
+ORDERINGS = {"bfs": bfs_order, "lpa": lpa_order}
 
 
 def apply_graph_order(graph: Graph, perm: np.ndarray) -> Graph:

@@ -78,7 +78,7 @@ def _load() -> Optional[ctypes.CDLL]:
     # signatures; a stale or pinned .so from before an ABI bump would
     # read a pointer slot as an int (SIGSEGV or silent garbage), so
     # mismatches fall back to the numpy paths instead of loading.
-    _ABI_VERSION = 4
+    _ABI_VERSION = 5
     try:
         lib.roc_abi_version.restype = ctypes.c_int
         got = int(lib.roc_abi_version())
@@ -129,6 +129,8 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.roc_block_fill.restype = c.c_int64
     lib.roc_block_fill.argtypes = [i64p, i32p, i64, i64, i64, i64p,
                                    i64, u8p, i64p, i32p, i64]
+    lib.roc_lpa_iterate.restype = c.c_int64
+    lib.roc_lpa_iterate.argtypes = [i64p, i32p, i64, i32p, i32p]
     _lib = lib
     return _lib
 
@@ -349,3 +351,22 @@ def block_fill(row_ptr: np.ndarray, col_idx: np.ndarray,
     if rc < 0:
         raise ValueError(f"roc_block_fill failed: {rc}")
     return a, res_ptr, res_col[:rc].copy()
+
+
+def lpa_iterate(nbr_ptr: np.ndarray, nbr: np.ndarray,
+                labels: np.ndarray) -> Tuple[np.ndarray, int]:
+    """One ASYNCHRONOUS label-propagation sweep over an undirected
+    CSR, in increasing vertex order (core/reorder.py lpa_labels):
+    returns (new_labels, changed)."""
+    lib = _load()
+    assert lib is not None
+    nbr_ptr = np.ascontiguousarray(nbr_ptr, dtype=np.int64)
+    nbr = np.ascontiguousarray(nbr, dtype=np.int32)
+    labels = np.ascontiguousarray(labels, dtype=np.int32)
+    out = np.empty_like(labels)
+    rc = int(lib.roc_lpa_iterate(
+        _i64p(nbr_ptr), _i32p(nbr), labels.shape[0],
+        _i32p(labels), _i32p(out)))
+    if rc < 0:
+        raise ValueError(f"roc_lpa_iterate failed: {rc}")
+    return out, rc

@@ -159,6 +159,13 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
 
     # numpy fallback works in int64 key space
     col_idx = col_i32.astype(np.int64)
+    if E and (col_idx.min() < 0 or col_idx.max() >= num_cols):
+        # same hard error as the native path's kErrValue — an
+        # out-of-range source would otherwise build a key outside the
+        # declared tile space and aggregate silently wrong
+        raise ValueError(
+            f"col_idx out of range [0, {num_cols}) for the declared "
+            f"source space")
     deg = np.diff(row_ptr)
     dst_all = np.repeat(np.arange(num_rows, dtype=np.int64), deg)
     key = (dst_all // BLOCK) * n_tiles + col_idx // BLOCK

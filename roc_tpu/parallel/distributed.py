@@ -420,7 +420,11 @@ class DistributedTrainer:
             sect_sub_w=config.sect_sub_w,
             sect_u16=config.sect_u16,
             bdense_min_fill=config.bdense_min_fill)
-        if config.aggr_impl == "bdense" and config.halo != "ring":
+        if config.aggr_impl == "bdense" and config.halo != "ring" \
+                and data is None:
+            # own build only: injected data carries no plan to report
+            # (an empty bd_tabs there means the CALLER never planned,
+            # not that no tile qualified)
             import sys
             if config.verbose:
                 for p, occ in enumerate(self.data.bd_occupancy):
@@ -457,6 +461,17 @@ class DistributedTrainer:
                         f"{config.aggr_impl!r} — build it with the "
                         f"same aggr_impl (note: attention models at "
                         f">=20M edges auto-route to 'attn_flat8')")
+                if config.aggr_impl in ("sectioned", "bdense") \
+                        and self.data.sect_idx \
+                        and not self.data.sect_meta:
+                    # flat8-built tables carry sect_idx but no
+                    # sect_meta — aggregate_ell_sect would zip over
+                    # () and return all-zero aggregations silently
+                    raise ValueError(
+                        f"injected data carries flat8-style tables "
+                        f"(no section metadata) but the resolved "
+                        f"aggr_impl is {config.aggr_impl!r} — build "
+                        f"it with the same aggr_impl")
                 if config.aggr_impl in ("ell", "pallas") \
                         and not self.data.ell_idx:
                     raise ValueError(

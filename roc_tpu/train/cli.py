@@ -120,12 +120,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("--profile-dir", type=str, default=None,
                     help="write a jax.profiler trace of one epoch here")
     ap.add_argument("--reorder", default="none",
-                    choices=["none", "bfs"],
+                    choices=["none", "bfs", "lpa"],
                     help="vertex relabeling for gather locality "
                          "(core/reorder.py): clusters neighborhoods "
                          "into narrow id ranges so the sectioned "
                          "layout pads less on community-structured "
-                         "graphs; metrics are relabeling-invariant")
+                         "graphs ('lpa' = label-propagation "
+                         "communities, the ordering --impl bdense "
+                         "rides on); metrics are relabeling-invariant")
     return ap.parse_args(argv)
 
 
@@ -177,12 +179,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         ds = synthetic_dataset(512, 8, in_dim=layers[0],
                                num_classes=layers[-1], seed=args.seed)
     perm = None
-    if args.reorder == "bfs":
-        from ..core.reorder import apply_vertex_order, bfs_order
+    if args.reorder != "none":
+        from ..core.reorder import ORDERINGS, apply_vertex_order
         t0 = time.time()
-        ds, perm = apply_vertex_order(ds, bfs_order(ds.graph))
-        print(f"# reorder=bfs applied in {time.time() - t0:.1f}s",
-              file=sys.stderr)
+        ds, perm = apply_vertex_order(
+            ds, ORDERINGS[args.reorder](ds.graph))
+        print(f"# reorder={args.reorder} applied in "
+              f"{time.time() - t0:.1f}s", file=sys.stderr)
     # config echo, like gnn.cc:48-60
     print(f"# dataset={ds.name} V={ds.graph.num_nodes} "
           f"E={ds.graph.num_edges} layers={layers} model={args.model} "
