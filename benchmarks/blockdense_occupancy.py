@@ -10,9 +10,12 @@ any scale with one command:
 
     python benchmarks/blockdense_occupancy.py \
         --nodes 232965 --edges 114848857 \
-        --graph planted:16384 --reorder lpa --tag reddit_shuffled_lpa
+        --graph planted:16384 --reorder lpa
 
-Merges the row into benchmarks/blockdense_occupancy.json.
+Merges the row into benchmarks/blockdense_occupancy.json under a key
+derived from the spec (here ``planted16384_lpa``) so re-running the
+recorded command updates the recorded row rather than forking a new
+one; ``--tag`` overrides the key.
 """
 
 import argparse
@@ -62,6 +65,7 @@ def main():
     row = dict(plan.occupancy(), V=g.num_nodes, E=g.num_edges,
                min_fill=args.min_fill, gen_s=round(gen_s, 1),
                plan_s=round(plan_s, 1),
+               graph=args.graph,
                reorder=args.reorder,
                reorder_s=round(reorder_s, 1))
     tag = args.tag or (args.graph.replace(":", "") +

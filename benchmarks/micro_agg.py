@@ -79,7 +79,10 @@ def main():
     g, reorder_s = reorder_graph(g, args.reorder)
     if reorder_s:
         print(f"# {args.reorder} reorder: {reorder_s:.1f}s")
-    dtype = getattr(jnp, args.dtype)
+    # 'mixed' is the TRAINER's dtype flag (fp32 params + bf16 compute);
+    # here the aggregation input itself is what's typed, so map it to
+    # bf16 instead of dying after a multi-minute reorder pass
+    dtype = jnp.bfloat16 if args.dtype == "mixed" else getattr(jnp, args.dtype)
     feats_np = np.random.RandomState(0).rand(V + 1, F).astype(np.float32)
     feats_np[-1] = 0
     feats = jnp.asarray(feats_np, dtype=dtype)

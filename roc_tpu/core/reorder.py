@@ -194,10 +194,15 @@ def apply_graph_order(graph: Graph, perm: np.ndarray) -> Graph:
 
 
 def apply_vertex_order(dataset: Dataset,
-                       perm: np.ndarray) -> Tuple[Dataset, np.ndarray]:
+                       perm: np.ndarray,
+                       order_name: str = "bfs"
+                       ) -> Tuple[Dataset, np.ndarray]:
     """Dataset with vertices relabeled so ``new_id = rank(old_id)``.
 
-    perm: ``perm[new_id] == old_id`` (from :func:`bfs_order`).
+    perm: ``perm[new_id] == old_id`` (from :func:`bfs_order` /
+    :func:`lpa_order`); ``order_name`` is the provenance suffix
+    appended to the dataset name (the config echo and any artifact
+    keyed on it record which ordering produced the ids).
     Returns ``(reordered_dataset, perm)``; row ``perm[i]`` of the
     original corresponds to row ``i`` of the result, so original-order
     logits are ``new_logits[inv]`` with ``inv = argsort(perm)``...
@@ -210,7 +215,7 @@ def apply_vertex_order(dataset: Dataset,
         labels=np.ascontiguousarray(dataset.labels[perm]),
         mask=np.ascontiguousarray(dataset.mask[perm]),
         num_classes=dataset.num_classes,
-        name=dataset.name + "+bfs"), perm
+        name=dataset.name + "+" + order_name), perm
 
 
 def cross_section_pairs(graph: Graph, section_rows: int) -> int:

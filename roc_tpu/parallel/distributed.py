@@ -472,6 +472,28 @@ class DistributedTrainer:
                         f"(no section metadata) but the resolved "
                         f"aggr_impl is {config.aggr_impl!r} — build "
                         f"it with the same aggr_impl")
+                if config.aggr_impl == "bdense" \
+                        and not self.data.bd_tabs \
+                        and not self.data.bd_occupancy:
+                    # sectioned-built data passes the two checks above
+                    # (sect_idx + sect_meta both present) but would
+                    # silently run residual-only; a genuine bdense
+                    # build always records per-part occupancy, even
+                    # when no tile qualifies and bd_tabs stays empty
+                    raise ValueError(
+                        "injected data carries no block-dense plan "
+                        "but the resolved aggr_impl is 'bdense' — "
+                        "build it with shard_dataset(..., "
+                        "aggr_impl='bdense')")
+                if config.aggr_impl == "bdense" \
+                        and not self.data.bd_tabs:
+                    # planned, but no [128,128] tile reached min_fill:
+                    # the step runs the pure sectioned residual — same
+                    # echo as the own-build path below
+                    import sys
+                    print("# bdense: injected plan has no dense tiles "
+                          "— running the pure sectioned residual",
+                          file=sys.stderr)
                 if config.aggr_impl in ("ell", "pallas") \
                         and not self.data.ell_idx:
                     raise ValueError(

@@ -183,7 +183,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..core.reorder import ORDERINGS, apply_vertex_order
         t0 = time.time()
         ds, perm = apply_vertex_order(
-            ds, ORDERINGS[args.reorder](ds.graph))
+            ds, ORDERINGS[args.reorder](ds.graph),
+            order_name=args.reorder)
         print(f"# reorder={args.reorder} applied in "
               f"{time.time() - t0:.1f}s", file=sys.stderr)
     # config echo, like gnn.cc:48-60

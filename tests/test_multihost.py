@@ -274,3 +274,23 @@ def test_injected_data_without_flat8_tables_fails_fast():
     with pytest.raises(ValueError, match="flat8"):
         DistributedTrainer(build_gat([12, 8, 3], dropout_rate=0.0),
                            ds, 4, cfg, mesh=mesh, data=ell_data, pg=pg)
+
+
+def test_injected_sectioned_data_with_bdense_impl_fails_fast():
+    """Sectioned-built data passes the sect_idx/sect_meta checks but
+    carries no block plan; resolved aggr_impl='bdense' must raise at
+    construction, not silently run the pure sectioned residual."""
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=11)
+    pg = partition_graph(ds.graph, 4, node_multiple=8, edge_multiple=64)
+    mesh = mh.make_parts_mesh(4)
+    sect_data = mh.shard_dataset_local(ds, pg, mesh,
+                                       aggr_impl="sectioned")
+    cfg = TrainConfig(verbose=False, aggr_impl="bdense",
+                      dropout_rate=0.0)
+    with pytest.raises(ValueError, match="block-dense"):
+        DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
+                           ds, 4, cfg, mesh=mesh, data=sect_data,
+                           pg=pg)
