@@ -42,7 +42,9 @@ def main():
     ap.add_argument("--reorder", default="none",
                     choices=["none", "bfs", "lpa"])
     ap.add_argument("--min-fill", type=int, default=64)
-    ap.add_argument("--a-budget", type=int, default=2 << 30)
+    ap.add_argument("--a-budget", type=int, default=2 << 30,
+                    help="uint8 A-table byte cap (0 = uncapped, same "
+                         "convention as micro_agg.py --a-budget)")
     ap.add_argument("--tag", default=None,
                     help="JSON key (default: derived from the spec)")
     args = ap.parse_args()
@@ -59,7 +61,7 @@ def main():
     t0 = time.time()
     plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
                        min_fill=args.min_fill,
-                       a_budget_bytes=args.a_budget)
+                       a_budget_bytes=args.a_budget or None)
     plan_s = time.time() - t0
 
     row = dict(plan.occupancy(), V=g.num_nodes, E=g.num_edges,
@@ -68,9 +70,16 @@ def main():
                graph=args.graph,
                reorder=args.reorder,
                reorder_s=round(reorder_s, 1))
-    tag = args.tag or (args.graph.replace(":", "") +
-                       ("" if args.reorder == "none"
-                        else f"_{args.reorder}"))
+    # non-default plan knobs join the derived key: rows measured under
+    # different min_fill/a_budget must never overwrite each other
+    tag = args.tag or (args.graph.replace(":", "")
+                       + ("" if args.reorder == "none"
+                          else f"_{args.reorder}")
+                       + ("" if args.min_fill == 64
+                          else f"_f{args.min_fill}")
+                       + ("" if args.a_budget == 2 << 30
+                          else "_bunc" if not args.a_budget
+                          else f"_b{args.a_budget >> 30}g"))
     print(tag, json.dumps(row, sort_keys=True))
 
     data = {}

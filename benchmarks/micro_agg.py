@@ -54,6 +54,11 @@ def main():
     ap.add_argument("--reorder", type=str, default="none",
                     help="none | bfs | lpa — relabel vertices before "
                          "table build (core/reorder.py)")
+    ap.add_argument("--a-budget", type=int, default=2 << 30,
+                    help="bdense uint8 A-table byte cap (densest "
+                         "blocks kept; 0 = uncapped).  The 2 GiB "
+                         "default binds at Reddit scale: 6 GiB + "
+                         "bdense:32 lifts dense_frac 0.52 -> 0.81")
     ap.add_argument("--cpu", action="store_true",
                     help="force the CPU backend (the env var alone is "
                          "overridden by the axon sitecustomize)")
@@ -191,7 +196,8 @@ def main():
             min_fill = chunk if ":" in spec else 64
             t0 = time.time()
             plan = plan_blocks(g.row_ptr, g.col_idx, V,
-                               min_fill=min_fill)
+                               min_fill=min_fill,
+                               a_budget_bytes=args.a_budget or None)
             occ = plan.occupancy()
             res_frac = 1.0 - occ["dense_frac"]
             have_residual = plan.res_col.shape[0] > 0

@@ -195,7 +195,7 @@ def apply_graph_order(graph: Graph, perm: np.ndarray) -> Graph:
 
 def apply_vertex_order(dataset: Dataset,
                        perm: np.ndarray,
-                       order_name: str = "bfs"
+                       order_name: str
                        ) -> Tuple[Dataset, np.ndarray]:
     """Dataset with vertices relabeled so ``new_id = rank(old_id)``.
 

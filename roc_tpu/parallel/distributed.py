@@ -419,7 +419,8 @@ class DistributedTrainer:
             halo=config.halo,
             sect_sub_w=config.sect_sub_w,
             sect_u16=config.sect_u16,
-            bdense_min_fill=config.bdense_min_fill)
+            bdense_min_fill=config.bdense_min_fill,
+            bdense_a_budget=config.bdense_a_budget)
         if config.aggr_impl == "bdense" and config.halo != "ring" \
                 and data is None:
             # own build only: injected data carries no plan to report

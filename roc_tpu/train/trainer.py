@@ -103,7 +103,13 @@ class TrainConfig:
     sect_u16: bool = False
     # - bdense_min_fill: edges per [128,128] tile below which the tile
     #   stays in the sectioned residual (aggr_impl='bdense')
+    # - bdense_a_budget: uint8 A-table byte cap (densest blocks kept);
+    #   the 2 GiB default was measured BINDING on the community
+    #   substrate at Reddit scale — min_fill=32 with a 6 GiB budget
+    #   lifts dense_frac 0.52 -> 0.81 (blockdense_occupancy.json
+    #   planted16384_lpa_f32_b6g).  None disables the cap.
     bdense_min_fill: int = 64
+    bdense_a_budget: Optional[int] = 2 << 30
 
 
 def resolve_dtypes(name: str):
@@ -262,6 +268,7 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        sect_sub_w: int = 8,
                        sect_u16: bool = False,
                        bdense_min_fill: int = 64,
+                       bdense_a_budget: Optional[int] = 2 << 30,
                        verbose: bool = False) -> GraphContext:
     """Single-device GraphContext: edges padded to the chunk multiple,
     dummy source id == num_nodes (the appended zero row).
@@ -320,7 +327,8 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         from ..ops.blockdense import plan_blocks
         import sys as _sys
         plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
-                           min_fill=bdense_min_fill)
+                           min_fill=bdense_min_fill,
+                           a_budget_bytes=bdense_a_budget)
         occ = plan.occupancy()
         if plan.n_blocks:
             if verbose:
@@ -479,6 +487,7 @@ class Trainer:
                 sect_sub_w=config.sect_sub_w,
                 sect_u16=config.sect_u16,
                 bdense_min_fill=config.bdense_min_fill,
+                bdense_a_budget=config.bdense_a_budget,
                 verbose=config.verbose)
         # Dataset tensors are jitted *arguments*, not closure captures:
         # capturing them would embed a second copy of the feature matrix

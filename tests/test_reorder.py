@@ -20,7 +20,7 @@ def test_reorder_preserves_graph_structure():
     exists after reordering — exact edge-set isomorphism."""
     ds = synthetic_dataset(150, 5, in_dim=4, num_classes=3, seed=1)
     g = ds.graph
-    new_ds, perm = apply_vertex_order(ds, bfs_order(g))
+    new_ds, perm = apply_vertex_order(ds, bfs_order(g), "bfs")
     rank = np.argsort(perm)
     V = g.num_nodes
 
@@ -49,7 +49,7 @@ def test_training_metrics_invariant_under_reorder():
     from roc_tpu.models.gcn import build_gcn
     from roc_tpu.train.trainer import TrainConfig, Trainer
     ds = synthetic_dataset(256, 7, in_dim=12, num_classes=4, seed=2)
-    new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph))
+    new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph), "bfs")
     metrics = []
     for d in (ds, new_ds):
         model = build_gcn([12, 16, 4], dropout_rate=0.0)
@@ -90,7 +90,7 @@ def test_bfs_shrinks_sectioned_tables_on_community_graph():
     community graph."""
     from roc_tpu.core.ell import section_sub_counts
     ds = _planted_community_dataset()
-    new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph))
+    new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph), "bfs")
     sec = 64
 
     def sub_rows(g):
@@ -113,7 +113,7 @@ def test_bfs_reduces_cross_section_pairs_on_community_graph():
     ds = _planted_community_dataset()
     sec = 64  # one community per section when perfectly clustered
     before = cross_section_pairs(ds.graph, sec)
-    new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph))
+    new_ds, _ = apply_vertex_order(ds, bfs_order(ds.graph), "bfs")
     after = cross_section_pairs(new_ds.graph, sec)
     assert after * 2 <= before, (before, after)
 
@@ -185,7 +185,7 @@ def test_training_metrics_invariant_under_lpa_reorder():
     from roc_tpu.models.gcn import build_gcn
     from roc_tpu.train.trainer import TrainConfig, Trainer
     ds = synthetic_dataset(256, 7, in_dim=12, num_classes=4, seed=2)
-    new_ds, _ = apply_vertex_order(ds, lpa_order(ds.graph))
+    new_ds, _ = apply_vertex_order(ds, lpa_order(ds.graph), "lpa")
     metrics = []
     for d in (ds, new_ds):
         model = build_gcn([12, 16, 4], dropout_rate=0.0)
