@@ -319,3 +319,36 @@ def test_bdense_multihost_local_build_rejected():
     with pytest.raises(NotImplementedError, match="bdense"):
         mh.shard_dataset_local(ds, pg, make_mesh(4),
                                aggr_impl="bdense")
+
+
+def test_trainer_bdense_a_budget_caps_plan_and_stays_exact():
+    """TrainConfig.bdense_a_budget reaches the planner: a one-block
+    budget shrinks the dense plan vs uncapped, pushes the dropped
+    blocks into the sectioned residual, and the capped trainer still
+    matches the segment reference exactly."""
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+
+    ds = synthetic_dataset(300, 9, in_dim=12, num_classes=3, seed=4)
+    kw = dict(learning_rate=0.05, epochs=4, eval_every=1 << 30,
+              verbose=False, dropout_rate=0.0, symmetric=True)
+    uncapped = Trainer(
+        build_gcn([12, 8, 3], dropout_rate=0.0), ds,
+        TrainConfig(aggr_impl="bdense", bdense_min_fill=250,
+                    bdense_a_budget=None, **kw))
+    capped = Trainer(
+        build_gcn([12, 8, 3], dropout_rate=0.0), ds,
+        TrainConfig(aggr_impl="bdense", bdense_min_fill=250,
+                    bdense_a_budget=128 * 128, **kw))
+    n_unc = int(uncapped.gctx.bd_a.shape[0])
+    assert n_unc > 1, "fixture must yield multiple dense tiles"
+    assert int(capped.gctx.bd_a.shape[0]) == 1
+    ref = Trainer(build_gcn([12, 8, 3], dropout_rate=0.0), ds,
+                  TrainConfig(aggr_impl="segment", **kw))
+    capped.train()
+    ref.train()
+    for k in ref.params:
+        np.testing.assert_allclose(np.asarray(capped.params[k]),
+                                   np.asarray(ref.params[k]),
+                                   rtol=2e-4, atol=2e-4)
