@@ -8,7 +8,11 @@ n=0
 while true; do
   n=$((n + 1))
   echo "[$(date +%H:%M:%S)] attempt $n" >> "$LOG"
-  if timeout 180 python - >> "$LOG" 2>&1 <<'EOF'
+  # -k: a probe stuck in the claim wait often ignores SIGTERM — without
+  # the kill escalation the watch itself hangs on attempt 1 forever.
+  # (KILLing a claim WAITER is safe; the holder-wedge caveat in
+  # bench.py applies to processes that already won the claim.)
+  if timeout -k 30 180 python - >> "$LOG" 2>&1 <<'EOF'
 import jax
 ds = jax.devices()
 print("CLAIMED:", [(d.platform, d.device_kind) for d in ds])
