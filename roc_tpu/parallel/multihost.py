@@ -153,7 +153,9 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                         aggr_impl: str = "segment",
                         halo: str = "gather",
                         section_rows: Optional[int] = None,
-                        sect_sub_w: int = 8, sect_u16: bool = False):
+                        sect_sub_w: int = 8, sect_u16: bool = False,
+                        bdense_min_fill: int = 64,
+                        bdense_a_budget: Optional[int] = 2 << 30):
     """Multi-host version of ``distributed.shard_dataset``: each process
     BUILDS and uploads only its own partitions' shards — row-sliced
     loads via :class:`roc_tpu.core.source.DataSource`, per-partition
@@ -170,16 +172,11 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     parts.  ``halo='ring'`` is partition-local too: per-part pair
     lists from local column reads, with the uniform pair width agreed
     via an O(P) collective (never a whole-graph pass).
+    ``aggr_impl='bdense'`` agrees the uniform per-part block count and
+    the residual sectioned chunk plan the same O(P) way.
     """
     import jax.numpy as jnp
     from ..core.ell import build_ell, ell_shape_plan, place_ell_part
-    if aggr_impl == "bdense":
-        raise NotImplementedError(
-            "aggr_impl='bdense' is single-controller only for now: the "
-            "uniform per-partition block count needs a cross-process "
-            "agreement pass this builder doesn't have — use "
-            "distributed.shard_dataset (single process) or "
-            "aggr_impl='sectioned' multi-host")
     from ..core.graph import MASK_NONE
     from ..core.partition import partition_col
     from ..core.source import as_source
@@ -252,7 +249,8 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     # edge_src field and the ELL table build
     cols = {p: remap_col_to_padded(pg, partition_col(pg, src.col_slice, p))
             for p in local}
-    use_stub = aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8")
+    use_stub = aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
+                             "bdense")
 
     def edge_src_build(p):
         return cols[p]
@@ -318,41 +316,105 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                               (plan[0], seg, 8), np.int32),)
         sect_sub_dst = (put_parts(lambda p: sects[p].sub_dst[0],
                                   (plan[0], seg), np.int32),)
-    elif aggr_impl == "sectioned":
-        # uniform chunk plan from an O(P * n_sec) elementwise-max
-        # collective over per-part sub-row counts — same agreement
-        # pattern as the ring's pair width, never a whole-graph pass
-        from ..core.ell import (clean_part_ptr, default_section_rows,
+    def local_sectioned_tables(ptrs, colmap):
+        """Stacked sectioned tables from per-part (ptr, cols) dicts —
+        the ONE multihost implementation of the uniform-chunk-plan
+        agreement (O(P * n_sec) elementwise-max collective over
+        per-part sub-row counts, same pattern as the ring's pair
+        width; never a whole-graph pass).  Shared by the 'sectioned'
+        branch and the bdense residual, mirroring
+        distributed._sectioned_tables."""
+        from ..core.ell import (default_section_rows,
                                 section_sub_counts, sectioned_from_graph,
                                 sectioned_plan)
         sec_rows = (section_rows if section_rows is not None
                     else default_section_rows(sect_u16))
         idx_np_dtype = np.uint16 if sect_u16 else np.int32
         src_rows = P * pn
-        ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
-                                  pn) for p in local}
         cnts = {p: section_sub_counts(
-            ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows,
+            ptrs[p], colmap[p], pn, src_rows,
             sec_rows, sub_w=sect_sub_w) for p in local}
         counts_max = _allreduce_part_vec_max(mesh, local, cnts)
         seg, plan = sectioned_plan(counts_max)
         sects = {p: sectioned_from_graph(
-            ptrs[p], cols[p][:int(ptrs[p][-1])], pn, src_rows=src_rows,
+            ptrs[p], colmap[p], pn, src_rows=src_rows,
             section_rows=sec_rows, seg_rows=seg, chunks_plan=plan,
             counts=cnts[p], sub_w=sect_sub_w) for p in local}
         if sect_u16:
             sects = {p: s.with_idx_dtype(np.uint16)
                      for p, s in sects.items()}
         first = sects[local[0]]
-        sect_idx = tuple(
-            put_parts(lambda p, s=s: sects[p].idx[s],
-                      (plan[s], seg, sect_sub_w), idx_np_dtype)
-            for s in range(len(first.idx)))
-        sect_sub_dst = tuple(
-            put_parts(lambda p, s=s: sects[p].sub_dst[s],
-                      (plan[s], seg), np.int32)
-            for s in range(len(first.sub_dst)))
-        sect_meta = tuple(zip(first.sec_starts, first.sec_sizes))
+        return (
+            tuple(put_parts(lambda p, s=s: sects[p].idx[s],
+                            (plan[s], seg, sect_sub_w), idx_np_dtype)
+                  for s in range(len(first.idx))),
+            tuple(put_parts(lambda p, s=s: sects[p].sub_dst[s],
+                            (plan[s], seg), np.int32)
+                  for s in range(len(first.sub_dst))),
+            tuple(zip(first.sec_starts, first.sec_sizes)))
+
+    if aggr_impl == "sectioned":
+        from ..core.ell import clean_part_ptr
+        ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
+                                  pn) for p in local}
+        sect_idx, sect_sub_dst, sect_meta = local_sectioned_tables(
+            ptrs, {p: cols[p][:int(ptrs[p][-1])] for p in local})
+
+    bd_tabs = ()
+    bd_vpad = 0
+    bd_src_vpad = 0
+    bd_occupancy = ()
+    if aggr_impl == "bdense":
+        # partition-local block-dense plans over the rectangular tile
+        # space (local dst rows x gathered sources), exactly
+        # distributed.shard_dataset's layout.  The two SPMD shapes
+        # every host must agree on — the uniform per-part block count
+        # and the residual sectioned chunk plan — come from the same
+        # O(P) collectives the sectioned/ring branches use; no
+        # whole-graph pass.
+        from ..core.ell import clean_part_ptr
+        from ..ops.blockdense import BLOCK, plan_blocks
+        src_rows = P * pn
+        ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
+                                  pn) for p in local}
+        plans = {p: plan_blocks(
+            ptrs[p], cols[p][:int(ptrs[p][-1])], pn,
+            min_fill=bdense_min_fill, a_budget_bytes=bdense_a_budget,
+            num_cols=src_rows) for p in local}
+        bd_occupancy = tuple(plans[p].occupancy() for p in local)
+        # uniform per-part block count: global max via the O(P)
+        # stats collective (the sum slot is unused here)
+        nblk_max, _ = _allreduce_part_stats(
+            mesh, local, {p: (plans[p].n_blocks, 0) for p in local})
+        if nblk_max:
+            bd_vpad = plans[local[0]].vpad
+            bd_src_vpad = plans[local[0]].src_vpad
+            n_dst_tiles = bd_vpad // BLOCK
+
+            def bd_field(get, fill, np_dtype, extra=()):
+                def build(p):
+                    pl = plans[p]
+                    out = np.full((nblk_max,) + extra, fill,
+                                  dtype=np_dtype)
+                    out[:pl.n_blocks] = get(pl)
+                    return out
+                return build
+            # padding blocks: zero A scattered into the dummy output
+            # tile — numerically inert, same scheme as shard_dataset
+            bd_tabs = (
+                put_parts(bd_field(lambda pl: pl.a_blocks, 0, np.uint8,
+                                   (BLOCK, BLOCK)),
+                          (nblk_max, BLOCK, BLOCK), np.uint8),
+                put_parts(bd_field(lambda pl: pl.src_blk, 0, np.int32),
+                          (nblk_max,), np.int32),
+                put_parts(bd_field(lambda pl: pl.dst_blk, n_dst_tiles,
+                                   np.int32),
+                          (nblk_max,), np.int32))
+        # residual scattered edges -> the stacked sectioned tables
+        # (every edge, when no tile qualifies anywhere)
+        sect_idx, sect_sub_dst, sect_meta = local_sectioned_tables(
+            {p: plans[p].res_row_ptr for p in local},
+            {p: plans[p].res_col for p in local})
 
     stub_build = lambda p: np.zeros(1, np.int32)
     return ShardedData(
@@ -376,4 +438,8 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
         sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst,
         sect_meta=sect_meta,
+        bd_tabs=bd_tabs,
+        bd_vpad=bd_vpad,
+        bd_src_vpad=bd_src_vpad,
+        bd_occupancy=bd_occupancy,
     )

@@ -16,6 +16,7 @@ logits to ``<outdir>/result.npz`` for the parent to compare against a
 single-process run of the identical workload.
 
 Usage: python multihost_worker.py <coordinator> <nproc> <pid> <outdir>
+       [aggr_impl]
 """
 
 import os
@@ -25,6 +26,7 @@ import sys
 def main() -> None:
     coordinator, nproc, pid, outdir = (
         sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    impl = sys.argv[5] if len(sys.argv) > 5 else "ell"
     # 4 virtual CPU devices per process; force CPU via jax.config (the
     # env var alone is overridden by the axon sitecustomize)
     os.environ["XLA_FLAGS"] = (
@@ -52,12 +54,16 @@ def main() -> None:
     local = mh.process_local_parts(mesh)
     # locality layout: this process owns a contiguous block of 4 parts
     assert len(local) == 4, local
-    cfg = TrainConfig(epochs=2, verbose=False, aggr_impl="ell",
+    # min_fill=8 for bdense: the tiny fixture must actually yield
+    # dense tiles so the cross-process block-count agreement is real
+    cfg = TrainConfig(epochs=2, verbose=False, aggr_impl=impl,
+                      bdense_min_fill=8,
                       symmetric=True, dropout_rate=0.0,
                       eval_every=1 << 30)
     pg = partition_graph(ds.graph, n_parts, node_multiple=8,
                          edge_multiple=cfg.chunk)
-    data = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="ell")
+    data = mh.shard_dataset_local(ds, pg, mesh, aggr_impl=impl,
+                                  bdense_min_fill=8)
     tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
                             ds, n_parts, cfg, mesh=mesh, data=data,
                             pg=pg)

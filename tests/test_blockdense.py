@@ -306,19 +306,44 @@ def test_bdense_distributed_no_dense_tiles_falls_back():
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_bdense_multihost_local_build_rejected():
-    """The partition-local multi-host builder has no cross-process
-    block-count agreement yet — it must say so, not mis-build."""
+def test_bdense_multihost_local_build_matches_global_and_trains():
+    """shard_dataset_local's bdense tables (block-count + residual
+    chunk plan agreed via the O(P) collectives) must equal
+    shard_dataset's single-controller build, and the injected-data
+    path must train through them."""
     from roc_tpu.core.graph import synthetic_dataset
-    from roc_tpu.parallel import multihost as mh
-    from roc_tpu.parallel.distributed import make_mesh
     from roc_tpu.core.partition import partition_graph
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel import multihost as mh
+    from roc_tpu.parallel.distributed import (DistributedTrainer,
+                                              shard_dataset)
+    from roc_tpu.train.trainer import TrainConfig
 
     ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=2)
     pg = partition_graph(ds.graph, 4, node_multiple=8, edge_multiple=64)
-    with pytest.raises(NotImplementedError, match="bdense"):
-        mh.shard_dataset_local(ds, pg, make_mesh(4),
-                               aggr_impl="bdense")
+    mesh = mh.make_parts_mesh(4)
+    kw = dict(aggr_impl="bdense", bdense_min_fill=8)
+    loc = mh.shard_dataset_local(ds, pg, mesh, **kw)
+    glo = shard_dataset(ds, pg, mesh, **kw)
+    assert len(loc.bd_tabs) == 3 == len(glo.bd_tabs), \
+        "fixture must yield dense tiles in both builders"
+    for a, b in zip(loc.bd_tabs, glo.bd_tabs):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert (loc.bd_vpad, loc.bd_src_vpad) == (glo.bd_vpad,
+                                              glo.bd_src_vpad)
+    for a, b in zip(loc.sect_idx, glo.sect_idx):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for a, b in zip(loc.sect_sub_dst, glo.sect_sub_dst):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert loc.sect_meta == glo.sect_meta
+    assert loc.edge_src.shape[-1] == 1
+    cfg = TrainConfig(epochs=2, verbose=False, aggr_impl="bdense",
+                      bdense_min_fill=8, dropout_rate=0.0,
+                      eval_every=1 << 30)
+    tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
+                            ds, 4, cfg, mesh=mesh, data=loc, pg=pg)
+    tr.train(epochs=2)
+    assert np.isfinite(tr.evaluate()["train_loss"])
 
 
 def test_trainer_bdense_a_budget_caps_plan_and_stays_exact():

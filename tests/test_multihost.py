@@ -143,13 +143,15 @@ def test_distributed_trainer_on_local_shards(halo):
     assert np.isfinite(m["train_loss"])
 
 
-def test_two_process_dcn_parity(tmp_path):
+@pytest.mark.parametrize("impl", ["ell", "bdense"])
+def test_two_process_dcn_parity(tmp_path, impl):
     """REAL 2-process execution (VERDICT r4 missing #3): two OS
     processes x 4 CPU devices meet via jax.distributed.initialize,
     each builds only its own partitions with shard_dataset_local,
     trains 2 epochs with cross-process psum, and the result must match
     a single-process run of the identical 8-part workload bit-for-bit
-    up to float tolerance."""
+    up to float tolerance.  The bdense variant exercises the REAL
+    cross-process block-count/chunk-plan agreement collectives."""
     import socket
     import subprocess
     import sys as _sys
@@ -166,7 +168,7 @@ def test_two_process_dcn_parity(tmp_path):
     env["PYTHONPATH"] = repo + _os.pathsep + env.get("PYTHONPATH", "")
     procs = [subprocess.Popen(
         [_sys.executable, worker, f"localhost:{port}", "2", str(i),
-         str(tmp_path)],
+         str(tmp_path), impl],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for i in (0, 1)]
     outs = []
@@ -188,7 +190,8 @@ def test_two_process_dcn_parity(tmp_path):
     from roc_tpu.train.trainer import TrainConfig
     ds = synthetic_dataset(16 * 8, 6, in_dim=12, num_classes=3, seed=0)
     mesh = mh.make_parts_mesh(8)
-    cfg = TrainConfig(epochs=2, verbose=False, aggr_impl="ell",
+    cfg = TrainConfig(epochs=2, verbose=False, aggr_impl=impl,
+                      bdense_min_fill=8,
                       symmetric=True, dropout_rate=0.0,
                       eval_every=1 << 30)
     tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
