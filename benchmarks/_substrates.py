@@ -35,16 +35,70 @@ def graph_from_spec(spec: str, V: int, E: int):
     raise SystemExit(f"unknown --graph {spec!r}")
 
 
-def reorder_graph(g, name: str):
+def reorder_graph(g, name: str, cache_key: str = None):
     """Apply a registered ordering pass (or 'none'); returns
-    (graph, seconds)."""
+    (graph, seconds).
+
+    ``cache_key`` (e.g. ``f"{spec}_{V}_{E}"`` from the generating
+    flags) caches the PERMUTATION on disk under
+    ``benchmarks/.reorder_cache/``: the substrate generators are
+    seed-deterministic, so the same spec always yields the same graph
+    and the one-time 2-5 min lpa pass at Reddit scale need not be
+    repaid by every benchmark invocation (it repeatedly pushed
+    chip-side runs into their timeouts).  The cached file stores the
+    permutation, not the graph — O(V) bytes; a loaded file is
+    verified to BE a permutation of [0, V) (a corrupt one is
+    recomputed, since apply_graph_order itself only checks shape and
+    would relabel silently wrong)."""
     if name == "none":
         return g, 0.0
+    import hashlib
+    import os
+    import sys
     import time
 
+    from roc_tpu.core import reorder as _reorder_mod
     from roc_tpu.core.reorder import ORDERINGS, apply_graph_order
     if name not in ORDERINGS:
         raise SystemExit(f"unknown --reorder {name!r}")
+    cache_path = None
+    if cache_key is not None:
+        # the ordering module's source hash versions the key: editing
+        # the lpa/bfs pass auto-invalidates cached permutations (these
+        # benchmarks MEASURE ordering quality — serving a stale perm
+        # would silently report the old algorithm's numbers)
+        with open(_reorder_mod.__file__, "rb") as f:
+            algo_ver = hashlib.sha1(f.read()).hexdigest()[:8]
+        cache_dir = os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), ".reorder_cache")
+        cache_path = os.path.join(
+            cache_dir,
+            f"{cache_key}_{name}_{algo_ver}.npy".replace(":", ""))
+        if os.path.exists(cache_path):
+            import numpy as np
+            t0 = time.time()
+            try:
+                perm = np.load(cache_path)
+            except (ValueError, OSError, EOFError):
+                perm = np.empty(0)   # corrupt file -> recompute
+            if (perm.shape == (g.num_nodes,)
+                    and np.array_equal(np.sort(perm),
+                                       np.arange(g.num_nodes))):
+                print(f"# cached {name} perm: {cache_path}",
+                      file=sys.stderr)
+                return (apply_graph_order(g, perm),
+                        time.time() - t0)
     t0 = time.time()
-    g = apply_graph_order(g, ORDERINGS[name](g))
-    return g, time.time() - t0
+    perm = ORDERINGS[name](g)
+    g = apply_graph_order(g, perm)
+    took = time.time() - t0
+    if cache_path is not None:
+        import numpy as np
+        os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+        # pid-unique tmp name: concurrent benchmark invocations must
+        # not interleave writes into one file (np.save appends .npy
+        # unless the name already ends with it)
+        tmp = f"{cache_path}.{os.getpid()}.tmp.npy"
+        np.save(tmp, perm)
+        os.replace(tmp, cache_path)
+    return g, took

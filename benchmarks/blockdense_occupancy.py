@@ -53,7 +53,9 @@ def main():
     g = graph_from_spec(args.graph, args.nodes, args.edges)
     gen_s = time.time() - t0
 
-    g, reorder_s = reorder_graph(g, args.reorder)
+    g, reorder_s = reorder_graph(
+        g, args.reorder,
+        cache_key=f"{args.graph}_{args.nodes}_{args.edges}")
     if reorder_s:
         print(f"# {args.reorder} reorder: {reorder_s:.1f}s")
 

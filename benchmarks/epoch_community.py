@@ -70,7 +70,9 @@ def main() -> int:
     t0 = time.time()
     graph = graph_from_spec(args.graph, args.nodes, args.edges)
     gen_s = time.time() - t0
-    graph, reorder_s = reorder_graph(graph, args.reorder)
+    graph, reorder_s = reorder_graph(
+        graph, args.reorder,
+        cache_key=f"{args.graph}_{args.nodes}_{args.edges}")
     print(f"# {dev.platform} {dev.device_kind}: "
           f"V={graph.num_nodes:,} E={graph.num_edges:,} "
           f"gen {gen_s:.0f}s, {args.reorder} reorder {reorder_s:.0f}s",

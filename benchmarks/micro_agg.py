@@ -81,7 +81,8 @@ def main():
     print(f"# sync overhead ~{bench(lambda: f0(z), args.iters):.1f} ms "
           f"(subtract from rows below)")
     g = graph_from_spec(args.graph, V, E)
-    g, reorder_s = reorder_graph(g, args.reorder)
+    g, reorder_s = reorder_graph(
+        g, args.reorder, cache_key=f"{args.graph}_{V}_{E}")
     if reorder_s:
         print(f"# {args.reorder} reorder: {reorder_s:.1f}s")
     # 'mixed' is the TRAINER's dtype flag (fp32 params + bf16 compute);
