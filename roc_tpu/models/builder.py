@@ -231,7 +231,8 @@ class GraphContext:
                 "neighbors across sections and cannot host the edge "
                 "softmax")
         from ..ops.attention import (gat_aggregate_ell,
-                                     gat_aggregate_flat8)
+                                     gat_aggregate_flat8,
+                                     resolve_dh_chunk)
         if a_src.ndim == 1:                  # single-head vectors
             a_src = a_src[None, :]
             a_dst = a_dst[None, :]
@@ -250,7 +251,9 @@ class GraphContext:
             return gat_aggregate_flat8(full, s_full, d_local,
                                        self.flat8_idx, self.flat8_dst,
                                        self.num_rows,
-                                       neg_slope=neg_slope)
+                                       neg_slope=neg_slope,
+                                       dh_chunk=resolve_dh_chunk(
+                                           self.num_rows, K, dh))
         return gat_aggregate_ell(full, s_full, d_local, self.ell_idx,
                                  self.ell_row_id, self.ell_row_pos,
                                  self.num_rows, neg_slope=neg_slope)

@@ -24,6 +24,8 @@ so the reference's symmetric kernel-reuse trick does not apply.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -126,10 +128,31 @@ def gat_aggregate_ell(full: jax.Array, s_full: jax.Array,
     return cat[ell_row_pos]
 
 
+def resolve_dh_chunk(num_rows: int, heads: int, dh: int,
+                     carry_budget: int = 768 << 20) -> Optional[int]:
+    """Per-head feature-dim chunk width for :func:`gat_aggregate_flat8`.
+
+    The numerator scan carries ``[num_rows+1, heads*dh]`` fp32; at
+    ogbn-products scale (V=2.45M, F=256) that is 2.5 GiB, and its
+    backward cotangent doubles it — the measured single-chip OOM
+    (16.61 G of 15.75 G HBM, 2026-07-31).  Chunking dh re-runs the
+    score computation per slice (one extra ``s_full`` gather pass,
+    ~E*K bytes — negligible next to the feature gather) in exchange
+    for an O(1/n_chunks) carry.  Returns None when the full carry
+    fits ``carry_budget``."""
+    bytes_per_dh = (num_rows + 1) * heads * 4
+    if bytes_per_dh * dh <= carry_budget:
+        return None
+    # chunk width straight from the budget so the per-chunk carry is
+    # GUARANTEED to fit (a ceil-of-ceil split can overshoot ~2x)
+    return max(1, min(dh, carry_budget // bytes_per_dh))
+
+
 def gat_aggregate_flat8(full: jax.Array, s_full: jax.Array,
                         d_local: jax.Array, f8_idx: jax.Array,
                         f8_dst: jax.Array, num_rows: int,
-                        neg_slope: float = 0.2) -> jax.Array:
+                        neg_slope: float = 0.2,
+                        dh_chunk: Optional[int] = None) -> jax.Array:
     """Attention aggregation over the UNIFORM width-8 sub-row layout —
     the large-graph form (same numerics as :func:`gat_aggregate_ell`,
     different reduction structure).
@@ -184,31 +207,82 @@ def gat_aggregate_flat8(full: jax.Array, s_full: jax.Array,
     rowmax = lax.stop_gradient(
         jnp.where(jnp.isfinite(rowmax), rowmax, 0.0))
 
-    def pass2(carry, ch):
-        num, den = carry
-        idx_ch, dst_ch = ch
-        e, valid = scores(idx_ch, dst_ch)
-        w = jnp.where(valid, jnp.exp(e - rowmax[dst_ch][:, None, :]),
-                      0.0)                             # [seg, 8, K]
-        den = den.at[dst_ch].add(w.sum(axis=1),
-                                 indices_are_sorted=True)
-        g = full[idx_ch].reshape(*idx_ch.shape, K, F // K)
-        # numerator carry stays fp32: a hub row of degree d receives
-        # d/8 sequential scatter-adds of full-magnitude partials —
-        # accumulating those in bf16 would lose low-order bits every
-        # add (the bucket path reduces a whole row in one fp32-MXU
-        # einsum, and this path must match its numerics)
-        part = jnp.einsum("swk,swkd->skd", w.astype(full.dtype), g,
-                          preferred_element_type=jnp.float32
-                          ).reshape(idx_ch.shape[0], F)
-        num = num.at[dst_ch].add(part, indices_are_sorted=True)
-        return (num, den), None
+    dh = F // K
+    if dh_chunk is None or dh_chunk >= dh:
+        def pass2(carry, ch):
+            num, den = carry
+            idx_ch, dst_ch = ch
+            e, valid = scores(idx_ch, dst_ch)
+            w = jnp.where(valid,
+                          jnp.exp(e - rowmax[dst_ch][:, None, :]),
+                          0.0)                         # [seg, 8, K]
+            den = den.at[dst_ch].add(w.sum(axis=1),
+                                     indices_are_sorted=True)
+            g = full[idx_ch].reshape(*idx_ch.shape, K, dh)
+            # numerator carry stays fp32: a hub row of degree d
+            # receives d/8 sequential scatter-adds of full-magnitude
+            # partials — accumulating those in bf16 would lose
+            # low-order bits every add (the bucket path reduces a
+            # whole row in one fp32-MXU einsum, and this path must
+            # match its numerics)
+            part = jnp.einsum("swk,swkd->skd", w.astype(full.dtype),
+                              g, preferred_element_type=jnp.float32
+                              ).reshape(idx_ch.shape[0], F)
+            num = num.at[dst_ch].add(part, indices_are_sorted=True)
+            return (num, den), None
 
-    num0 = jnp.zeros((num_rows + 1, F), dtype=jnp.float32)
+        num0 = jnp.zeros((num_rows + 1, F), dtype=jnp.float32)
+        den0 = jnp.zeros((num_rows + 1, K), dtype=jnp.float32)
+        (num, den), _ = lax.scan(jax.checkpoint(pass2), (num0, den0),
+                                 (f8_idx, f8_dst))
+        den = jnp.maximum(den[:num_rows], 1e-20)
+        numr = num[:num_rows].reshape(num_rows, K, dh)
+        out = (numr / den[:, :, None]).astype(full.dtype)
+        return out.reshape(num_rows, F)
+
+    # dh-chunked numerator (resolve_dh_chunk): the fused pass2 carry
+    # is [num_rows+1, F] fp32 and autodiff doubles it — the products-
+    # scale OOM.  Scores are cheap (one [G+1, K] gather per pass), so
+    # the denominator gets its own scan and each dh slice re-derives w
+    # while carrying only [num_rows+1, K*dc] fp32.  Per-element math
+    # and scatter-add order match the fused form (tested to <=3e-7;
+    # XLA lowers non-dividing slice widths slightly differently).
+    def passden(den, ch):
+        e, valid = scores(*ch)
+        w = jnp.where(valid,
+                      jnp.exp(e - rowmax[ch[1]][:, None, :]), 0.0)
+        return den.at[ch[1]].add(w.sum(axis=1),
+                                 indices_are_sorted=True), None
+
     den0 = jnp.zeros((num_rows + 1, K), dtype=jnp.float32)
-    (num, den), _ = lax.scan(jax.checkpoint(pass2), (num0, den0),
-                             (f8_idx, f8_dst))
+    den, _ = lax.scan(jax.checkpoint(passden), den0,
+                      (f8_idx, f8_dst))
     den = jnp.maximum(den[:num_rows], 1e-20)
-    numr = num[:num_rows].reshape(num_rows, K, F // K)
-    out = (numr / den[:, :, None]).astype(full.dtype)
-    return out.reshape(num_rows, F)
+    fullr = full.reshape(full.shape[0], K, dh)
+    outs = []
+    for lo in range(0, dh, dh_chunk):
+        dc = min(dh_chunk, dh - lo)
+        # materialize the slice once per chunk ([G+1, K*dc]) so the
+        # scan gathers dc-wide rows, not F-wide ones
+        full_c = lax.slice_in_dim(fullr, lo, lo + dc, axis=2) \
+            .reshape(full.shape[0], K * dc)
+
+        def pass2c(num, ch, full_c=full_c, dc=dc):
+            idx_ch, dst_ch = ch
+            e, valid = scores(idx_ch, dst_ch)
+            w = jnp.where(valid,
+                          jnp.exp(e - rowmax[dst_ch][:, None, :]),
+                          0.0)
+            g = full_c[idx_ch].reshape(*idx_ch.shape, K, dc)
+            part = jnp.einsum("swk,swkd->skd", w.astype(full.dtype),
+                              g, preferred_element_type=jnp.float32
+                              ).reshape(idx_ch.shape[0], K * dc)
+            return num.at[dst_ch].add(part,
+                                      indices_are_sorted=True), None
+
+        num0 = jnp.zeros((num_rows + 1, K * dc), dtype=jnp.float32)
+        num, _ = lax.scan(jax.checkpoint(pass2c), num0,
+                          (f8_idx, f8_dst))
+        numr = num[:num_rows].reshape(num_rows, K, dc)
+        outs.append((numr / den[:, :, None]).astype(full.dtype))
+    return jnp.concatenate(outs, axis=2).reshape(num_rows, F)
