@@ -50,6 +50,9 @@ def main() -> int:
     ap.add_argument("--min-fill", type=int, default=64)
     ap.add_argument("--a-budget", type=int, default=2 << 30,
                     help="bdense A-table byte cap (0 = uncapped)")
+    ap.add_argument("--bdense-group", type=int, default=1,
+                    help="dense blocks reduced per output-tile update "
+                         "(pad_plan_groups; cuts output RMW traffic)")
     ap.add_argument("--cpu", action="store_true",
                     help="CPU rehearsal; result NOT recorded")
     args = ap.parse_args()
@@ -101,6 +104,7 @@ def main() -> int:
                           compute_dtype=compute_dtype,
                           bdense_min_fill=args.min_fill,
                           bdense_a_budget=args.a_budget or None,
+                          bdense_group=args.bdense_group,
                           verbose=False, eval_every=1 << 30,
                           symmetric=True)
         t0 = time.time()
@@ -120,6 +124,8 @@ def main() -> int:
         if impl == "bdense":
             row["min_fill"] = args.min_fill
             row["a_budget"] = args.a_budget
+            if args.bdense_group > 1:
+                row["bdense_group"] = args.bdense_group
         rows[impl] = row
         print(f"# {impl}: epoch {row['epoch_ms']} ms "
               f"(compile {compile_s:.0f}s)", file=sys.stderr)

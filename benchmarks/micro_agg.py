@@ -108,11 +108,9 @@ def main():
         return ell_cache["t"], ell_cache["prep"]
 
     for spec in args.impls.split(","):
-        if ":" in spec:
-            impl, chunk = spec.split(":")
-            chunk = int(chunk)
-        else:
-            impl, chunk = spec, 1024
+        parts = spec.split(":")
+        impl = parts[0]
+        chunk = int(parts[1]) if len(parts) > 1 else 1024
         if impl == "sectioned":
             # sectioned:ROWS overrides the section size (in source
             # rows) — the dtype-aware sweep: bf16 tables are half the
@@ -188,17 +186,22 @@ def main():
             # bf16 batched matmuls + the residual through the sectioned
             # gather (VERDICT r4 #1).  bdense:MINFILL sets the dense
             # threshold (edges per block; default 64 ~ the measured
-            # row-rate breakeven).  Occupancy stats print with the row
-            # — they are the claim's evidence either way.
+            # row-rate breakeven); bdense:MINFILL:GROUP reduces GROUP
+            # dst-sharing blocks per output-tile update
+            # (pad_plan_groups — cuts the [128,F] fp32 RMW traffic).
+            # Occupancy stats print with the row — they are the
+            # claim's evidence either way.
             from roc_tpu.core.ell import sectioned_from_graph
             from roc_tpu.ops.aggregate import aggregate_ell_sect
             from roc_tpu.ops.blockdense import (aggregate_block_dense,
                                                 plan_blocks)
-            min_fill = chunk if ":" in spec else 64
+            min_fill = chunk if len(parts) > 1 else 64
+            group = int(parts[2]) if len(parts) > 2 else 1
             t0 = time.time()
             plan = plan_blocks(g.row_ptr, g.col_idx, V,
                                min_fill=min_fill,
-                               a_budget_bytes=args.a_budget or None)
+                               a_budget_bytes=args.a_budget or None,
+                               group=group)
             occ = plan.occupancy()
             res_frac = 1.0 - occ["dense_frac"]
             have_residual = plan.res_col.shape[0] > 0
@@ -218,21 +221,24 @@ def main():
             if have_residual:
                 def agg_bd(x, a, s, d, i, dd):
                     dense = aggregate_block_dense(x, a, s, d, V,
-                                                  plan.vpad)
+                                                  plan.vpad,
+                                                  group=group)
                     return dense + aggregate_ell_sect(x, i, dd, meta, V)
                 f = jax.jit(agg_bd)
                 run = lambda: f(feats, ab, sb, db, sidx, sdst)
             else:
                 f = jax.jit(lambda x, a, s, d: aggregate_block_dense(
-                    x, a, s, d, V, plan.vpad))
+                    x, a, s, d, V, plan.vpad, group=group))
                 run = lambda: f(feats, ab, sb, db)
             try:
                 ms = bench(run, args.iters)
+                gpad = (f", group {group} (+{plan.pad_blocks} pad)"
+                        if group > 1 else "")
                 print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
                       f"(prep {prep:.1f}s, {occ['n_blocks']} blocks, "
                       f"fill {occ['mean_fill']}, dense "
                       f"{occ['dense_frac']:.0%}, residual "
-                      f"{res_frac:.0%})")
+                      f"{res_frac:.0%}{gpad})")
             except Exception as e:  # noqa: BLE001 - report and continue
                 print(f"{spec:16s} FAILED: {type(e).__name__}: "
                       f"{str(e)[:200]}")

@@ -110,6 +110,9 @@ class GraphContext:
     bd_src: Optional[jax.Array] = None
     bd_dst: Optional[jax.Array] = None
     bd_vpad: int = 0
+    # blocks reduced per output-tile update (>1 requires a
+    # pad_plan_groups-padded plan — cuts output RMW traffic group-x)
+    bd_group: int = 1
     # source tile space when it differs from bd_vpad (distributed:
     # dst tiles cover local rows, src tiles the gathered coordinates)
     bd_src_vpad: int = 0
@@ -148,7 +151,8 @@ class GraphContext:
                     full, self.bd_a, self.bd_src, self.bd_dst,
                     self.num_rows, self.bd_vpad,
                     out_dtype=full.dtype,
-                    src_vpad=self.bd_src_vpad)
+                    src_vpad=self.bd_src_vpad,
+                    group=self.bd_group)
             if self.sect_idx:
                 res = aggregate_ell_sect(
                     full, self.sect_idx, self.sect_sub_dst,
@@ -304,13 +308,14 @@ def _gctx_flatten(g: GraphContext):
                 g.bd_src, g.bd_dst)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
            g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
-           g.sect_meta, g.bd_vpad, g.bd_src_vpad)
+           g.sect_meta, g.bd_vpad, g.bd_src_vpad, g.bd_group)
     return children, aux
 
 
 def _gctx_unflatten(aux, children):
     (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
-     symmetric, halo, axis_name, sect_meta, bd_vpad, bd_src_vpad) = aux
+     symmetric, halo, axis_name, sect_meta, bd_vpad, bd_src_vpad,
+     bd_group) = aux
     (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
      sect_idx, sect_sub_dst, ell_row_id, flat8_idx,
      flat8_dst, bd_a, bd_src, bd_dst) = children
@@ -324,7 +329,7 @@ def _gctx_unflatten(aux, children):
         sect_sub_dst=sect_sub_dst, sect_meta=sect_meta,
         ell_row_id=ell_row_id, flat8_idx=flat8_idx,
         flat8_dst=flat8_dst, bd_a=bd_a, bd_src=bd_src, bd_dst=bd_dst,
-        bd_vpad=bd_vpad, bd_src_vpad=bd_src_vpad)
+        bd_vpad=bd_vpad, bd_src_vpad=bd_src_vpad, bd_group=bd_group)
 
 
 # GraphContext is a pytree so the graph tables travel as jit ARGUMENTS.

@@ -31,7 +31,7 @@ CSR kernel ``/root/reference/scattergather_kernel.cu:20-76``.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from typing import Optional, Tuple
 
 import jax
@@ -69,6 +69,9 @@ class BlockPlan:
     # the distributed planner tiles local dst rows x GATHERED source
     # coordinates, so src_vpad covers num_cols instead)
     src_vpad: int = 0
+    # zero-A group-alignment blocks appended by pad_plan_groups (the
+    # group they enable is the kernel's ``group`` argument)
+    pad_blocks: int = 0
 
     def __post_init__(self):
         if not self.src_vpad:
@@ -80,39 +83,80 @@ class BlockPlan:
 
     def occupancy(self) -> dict:
         """The stats that decide whether this path can win (recorded
-        with every race row)."""
+        with every race row).  ``mean_fill`` is over the RAW (edge-
+        carrying) blocks — inert group padding must not dilute the
+        evidence behind the min-fill breakeven; ``a_bytes`` is the
+        real device table incl. padding."""
         nb = self.n_blocks
-        return {
+        raw = nb - self.pad_blocks
+        occ = {
             "n_blocks": nb,
             "dense_edges": int(self.dense_edges),
             "dense_frac": round(self.dense_edges
                                 / max(self.total_edges, 1), 4),
-            "mean_fill": round(self.dense_edges / max(nb, 1), 1),
+            "mean_fill": round(self.dense_edges / max(raw, 1), 1),
             "a_bytes": int(nb) * BLOCK * BLOCK,
         }
+        if self.pad_blocks:
+            occ["pad_blocks"] = int(self.pad_blocks)
+        return occ
 
 
 def _select_dense(counts: np.ndarray, min_fill: int,
-                  a_budget_bytes: Optional[int]) -> np.ndarray:
+                  a_budget_bytes: Optional[int],
+                  group: int = 1,
+                  dst_of: Optional[np.ndarray] = None) -> np.ndarray:
     """Boolean selection over the occupied-tile census: at least
     ``min_fill`` edges, densest-first under the A-table budget.  ONE
-    place for the rule — the native and numpy plan paths share it."""
+    place for the rule — the native and numpy plan paths share it.
+
+    With ``group > 1`` the budget applies to the table AFTER
+    :func:`pad_plan_groups` alignment (up to ``group-1`` zero blocks
+    per occupied dst tile) — padding must never silently defeat the
+    byte cap the budget exists to enforce.  ``dst_of`` gives each
+    candidate's dst tile id; the padded size is monotone in the
+    number of kept blocks (a new block either fills an existing
+    group's padding slot or opens one new group), so a binary search
+    finds the largest densest-first prefix that fits."""
     dense_sel = counts >= min_fill
-    if a_budget_bytes is not None:
-        max_blocks = int(a_budget_bytes // (BLOCK * BLOCK))
-        if int(dense_sel.sum()) > max_blocks:
-            cand = np.flatnonzero(dense_sel)
-            keep = cand[np.argsort(-counts[cand],
-                                   kind="stable")[:max_blocks]]
-            dense_sel = np.zeros_like(dense_sel)
-            dense_sel[keep] = True
+    if a_budget_bytes is None:
+        return dense_sel
+    bb = BLOCK * BLOCK
+    cand = np.flatnonzero(dense_sel)
+    order = cand[np.argsort(-counts[cand], kind="stable")]
+    if group > 1:
+        assert dst_of is not None
+
+        def fits(k: int) -> bool:
+            if k == 0:
+                return True
+            w = np.bincount(dst_of[order[:k]])
+            padded = int((-(-w[w > 0] // group) * group).sum())
+            return padded * bb <= a_budget_bytes
+
+        keep_n = len(order)
+        if not fits(keep_n):
+            lo, hi = 0, keep_n
+            while lo < hi:          # max k with fits(k); fits(lo) holds
+                mid = (lo + hi + 1) // 2
+                if fits(mid):
+                    lo = mid
+                else:
+                    hi = mid - 1
+            keep_n = lo
+    else:
+        keep_n = min(len(order), int(a_budget_bytes // bb))
+    if keep_n < len(order):
+        dense_sel = np.zeros_like(dense_sel)
+        dense_sel[order[:keep_n]] = True
     return dense_sel
 
 
 def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
                 num_rows: int, min_fill: int = 64,
                 a_budget_bytes: Optional[int] = 2 << 30,
-                num_cols: Optional[int] = None) -> BlockPlan:
+                num_cols: Optional[int] = None,
+                group: int = 1) -> BlockPlan:
     """Tile the dst-major CSR into [128, 128] blocks; blocks with at
     least ``min_fill`` edges go dense, the rest stay residual CSR.
 
@@ -126,7 +170,12 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
     ``num_cols`` sets a RECTANGULAR tile space: dst rows stay
     ``num_rows`` but source ids may range over ``num_cols`` (the
     distributed planner's local-rows x gathered-coordinates case).
-    Default: square (``num_rows``)."""
+    Default: square (``num_rows``).
+
+    ``group > 1`` returns a :func:`pad_plan_groups`-aligned plan for
+    the kernel's grouped output-tile reduction; the budget then caps
+    the PADDED table (the selection accounts for alignment blocks up
+    front — see _select_dense)."""
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     col_i32 = np.ascontiguousarray(col_idx, dtype=np.int32)
     E = col_i32.shape[0]
@@ -144,18 +193,19 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
         # Graph.col_idx already is, so no full-E copies happen here
         keys_all, counts_all = native.block_counts(
             row_ptr, col_i32, num_rows, BLOCK, num_cols=num_cols)
-        dense_keys = keys_all[_select_dense(counts_all, min_fill,
-                                            a_budget_bytes)]
+        dense_keys = keys_all[_select_dense(
+            counts_all, min_fill, a_budget_bytes, group=group,
+            dst_of=keys_all // n_tiles)]
         a, res_ptr, res_col = native.block_fill(
             row_ptr, col_i32, num_rows, BLOCK, dense_keys,
             num_cols=num_cols)
-        return BlockPlan(
+        return pad_plan_groups(BlockPlan(
             num_rows=num_rows, vpad=vpad, a_blocks=a,
             src_blk=(dense_keys % n_tiles).astype(np.int32),
             dst_blk=(dense_keys // n_tiles).astype(np.int32),
             res_row_ptr=res_ptr, res_col=res_col,
             dense_edges=E - res_col.shape[0], total_edges=E,
-            src_vpad=src_vpad)
+            src_vpad=src_vpad), group)
 
     # numpy fallback works in int64 key space
     col_idx = col_i32.astype(np.int64)
@@ -173,7 +223,8 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
     key_s = key[order]
     blocks, starts, counts = np.unique(key_s, return_index=True,
                                        return_counts=True)
-    dense_sel = _select_dense(counts, min_fill, a_budget_bytes)
+    dense_sel = _select_dense(counts, min_fill, a_budget_bytes,
+                              group=group, dst_of=blocks // n_tiles)
     dense_blocks = blocks[dense_sel]
     nblk = int(dense_blocks.shape[0])
     a = np.zeros((nblk, BLOCK, BLOCK), dtype=np.uint8)
@@ -220,14 +271,54 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
     res_ptr = np.zeros(num_rows + 1, dtype=np.int64)
     np.cumsum(res_deg, out=res_ptr[1:])
     # residual edges arrive dst-sorted already (dst_all is sorted)
-    return BlockPlan(
+    return pad_plan_groups(BlockPlan(
         num_rows=num_rows, vpad=vpad,
         a_blocks=a,
         src_blk=(dense_blocks % n_tiles).astype(np.int32),
         dst_blk=(dense_blocks // n_tiles).astype(np.int32),
         res_row_ptr=res_ptr, res_col=res_col.astype(np.int32),
         dense_edges=dense_edges, total_edges=E,
-        src_vpad=src_vpad)
+        src_vpad=src_vpad), group)
+
+
+def pad_plan_groups(plan: BlockPlan, group: int) -> BlockPlan:
+    """Pad each dst tile's block run to a multiple of ``group`` with
+    zero-A blocks (src tile 0 — A==0 makes the contribution zero), so
+    :func:`aggregate_block_dense` can reduce ``group`` blocks per
+    output-tile update (``group=...``).
+
+    Why: with group=1 every dense block costs one read-modify-write
+    of a [128, F] fp32 output tile (~256 KiB at F=256) — the DOMINANT
+    HBM traffic of the path (A is 16 KiB, the source tile 64 KiB
+    bf16).  Blocks are already dst-major sorted, so padding runs to a
+    group multiple lets one einsum reduce a whole group in registers
+    and write each output tile ``group``x less often.  Padding
+    overhead is <= (group-1) blocks per OCCUPIED dst tile — a few
+    percent at the measured widths (mean 213 blocks/tile on the
+    planted-community substrate at Reddit scale)."""
+    if group <= 1 or plan.n_blocks == 0:
+        return plan
+    dst = plan.dst_blk
+    uniq, counts = np.unique(dst, return_counts=True)
+    padded = -(-counts // group) * group
+    total = int(padded.sum())
+    if total == plan.n_blocks:
+        return plan
+    new_start = np.zeros(len(uniq) + 1, np.int64)
+    np.cumsum(padded, out=new_start[1:])
+    old_start = np.zeros(len(uniq) + 1, np.int64)
+    np.cumsum(counts, out=old_start[1:])
+    run_id = np.repeat(np.arange(len(uniq)), counts)
+    pos = (new_start[run_id]
+           + (np.arange(plan.n_blocks) - old_start[run_id]))
+    a2 = np.zeros((total, BLOCK, BLOCK), np.uint8)
+    a2[pos] = plan.a_blocks
+    src2 = np.zeros(total, np.int32)
+    src2[pos] = plan.src_blk
+    dst2 = np.repeat(uniq, padded).astype(np.int32)
+    return replace(plan, a_blocks=a2, src_blk=src2, dst_blk=dst2,
+                   pad_blocks=plan.pad_blocks
+                   + (total - plan.n_blocks))
 
 
 def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
@@ -235,7 +326,8 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
                           num_rows: int, vpad: int,
                           out_dtype=jnp.float32,
                           chunk_blocks: int = _CHUNK_BLOCKS,
-                          src_vpad: int = 0
+                          src_vpad: int = 0,
+                          group: int = 1
                           ) -> jax.Array:
     """Dense-tile partial aggregation (the residual CSR is the
     caller's, via the sectioned/ELL path on the SAME x).
@@ -247,18 +339,29 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
     matrix, dst tiles cover only this partition's local rows).
     Returns [num_rows, F] in ``out_dtype`` — fp32 accumulation over
     tiles (a hub tile receives many sequential adds).
+
+    ``group > 1`` requires a :func:`pad_plan_groups`-padded plan
+    (every run of ``group`` consecutive blocks shares one dst tile):
+    each group is reduced in ONE einsum and its output tile updated
+    once — ``group``x less output read-modify-write traffic.
     """
     F = x.shape[1]
     nblk = a_blocks.shape[0]
     n_tiles = vpad // BLOCK
     src_vpad = src_vpad or vpad
     src_rows = min(x.shape[0], src_vpad)
+    if group > 1 and nblk % group:
+        raise ValueError(
+            f"group={group} needs a pad_plan_groups-padded plan; "
+            f"got {nblk} blocks")
     xt = jnp.zeros((src_vpad, F), dtype=x.dtype).at[:src_rows].set(
         x[:src_rows]).reshape(src_vpad // BLOCK, BLOCK, F)
     # pad the block list to a chunk multiple; padding scatters zero
     # tiles into a dummy output tile.  Small plans shrink the chunk so
     # padding never exceeds one chunk's worth of zero work.
-    chunk_blocks = max(1, min(chunk_blocks, nblk))
+    group = max(1, group)
+    chunk_blocks = max(group, min(chunk_blocks, nblk)
+                       // group * group)
     chunks = max(1, -(-nblk // chunk_blocks))
     pad = chunks * chunk_blocks - nblk
     a_p = jnp.concatenate([
@@ -277,10 +380,19 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
     def body(out, ch):
         a_u8, s_ids, d_ids = ch
         gx = xt[s_ids].astype(compute)              # [C, 128, F]
-        y = jnp.einsum("bij,bjf->bif", a_u8.astype(compute), gx,
-                       preferred_element_type=jnp.float32)
-        # several blocks can share a dst tile within one chunk -> NOT
-        # unique; the plan's dst-major sort keeps them sorted
+        if group > 1:
+            C = s_ids.shape[0]
+            y = jnp.einsum("gwij,gwjf->gif",
+                           a_u8.astype(compute).reshape(
+                               C // group, group, BLOCK, BLOCK),
+                           gx.reshape(C // group, group, BLOCK, F),
+                           preferred_element_type=jnp.float32)
+            d_ids = d_ids.reshape(C // group, group)[:, 0]
+        else:
+            y = jnp.einsum("bij,bjf->bif", a_u8.astype(compute), gx,
+                           preferred_element_type=jnp.float32)
+        # several blocks/groups can share a dst tile within one chunk
+        # -> NOT unique; the plan's dst-major sort keeps them sorted
         return out.at[d_ids].add(y, indices_are_sorted=True), None
 
     out0 = jnp.zeros((n_tiles + 1, BLOCK, F), dtype=jnp.float32)

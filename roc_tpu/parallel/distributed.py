@@ -147,6 +147,11 @@ class ShardedData:
     bd_vpad: int = 0        # dst tile space (covers part_nodes)
     bd_src_vpad: int = 0    # src tile space (covers gathered rows)
     bd_occupancy: Tuple[dict, ...] = ()   # per-part plan stats
+    # the pad_plan_groups alignment the tables were built for: the
+    # kernel's ``group`` MUST match it (the trainer validates injected
+    # data — a mismatched group would reduce across dst-tile
+    # boundaries and mis-aggregate with no shape error)
+    bd_group: int = 1
     # padded slots / real edges of the ring tables (halo='ring' only);
     # surfaced so trainer setup can echo the SPMD-uniformity cost
     ring_padding_ratio: Optional[float] = None
@@ -181,7 +186,8 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   put=None, section_rows: Optional[int] = None,
                   sect_sub_w: int = 8, sect_u16: bool = False,
                   bdense_min_fill: int = 64,
-                  bdense_a_budget: Optional[int] = 2 << 30
+                  bdense_a_budget: Optional[int] = 2 << 30,
+                  bdense_group: int = 1
                   ) -> ShardedData:
     """Build + upload the stacked per-part arrays.  ``put`` overrides
     the upload (default: replicated-process ``device_put`` with the
@@ -255,11 +261,14 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                 ptr = clean_part_ptr(pg.part_row_ptr[p],
                                      pg.real_nodes[p], pg.part_nodes)
                 cols = col_padded[p][:int(ptr[-1])]
+                # group>1 plans arrive per-part group-aligned, so the
+                # stacked tail padding below extends in WHOLE
+                # dummy-dst groups (nb and nblk_max both multiples)
                 plans.append(plan_blocks(
                     ptr, cols, pg.part_nodes,
                     min_fill=bdense_min_fill,
                     a_budget_bytes=bdense_a_budget,
-                    num_cols=src_rows))
+                    num_cols=src_rows, group=bdense_group))
             bd_occupancy = tuple(pl.occupancy() for pl in plans)
             nblk_max = max(pl.n_blocks for pl in plans)
             if nblk_max:
@@ -327,6 +336,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         bd_vpad=bd_vpad,
         bd_src_vpad=bd_src_vpad,
         bd_occupancy=bd_occupancy,
+        bd_group=bdense_group if bd_tabs else 1,
         ring_padding_ratio=ring_padding_ratio,
     )
 
@@ -420,7 +430,8 @@ class DistributedTrainer:
             sect_sub_w=config.sect_sub_w,
             sect_u16=config.sect_u16,
             bdense_min_fill=config.bdense_min_fill,
-            bdense_a_budget=config.bdense_a_budget)
+            bdense_a_budget=config.bdense_a_budget,
+            bdense_group=config.bdense_group)
         if config.aggr_impl == "bdense" and config.halo != "ring" \
                 and data is None:
             # own build only: injected data carries no plan to report
@@ -473,6 +484,18 @@ class DistributedTrainer:
                         f"(no section metadata) but the resolved "
                         f"aggr_impl is {config.aggr_impl!r} — build "
                         f"it with the same aggr_impl")
+                if config.aggr_impl == "bdense" \
+                        and self.data.bd_tabs \
+                        and self.data.bd_group != config.bdense_group:
+                    # a group mismatch would reduce across dst-tile
+                    # boundaries (or trip the kernel's alignment
+                    # check) — fail here with the cause, not mid-step
+                    raise ValueError(
+                        f"injected data was built with bdense_group="
+                        f"{self.data.bd_group} but the config wants "
+                        f"bdense_group={config.bdense_group} — build "
+                        f"it with shard_dataset(..., bdense_group="
+                        f"{config.bdense_group})")
                 if config.aggr_impl == "bdense" \
                         and not self.data.bd_tabs \
                         and not self.data.bd_occupancy:
@@ -559,6 +582,9 @@ class DistributedTrainer:
             sect_meta=self.data.sect_meta,
             bd_vpad=self.data.bd_vpad,
             bd_src_vpad=self.data.bd_src_vpad,
+            # the DATA's group, validated == config at init: the
+            # tables define what the kernel may assume
+            bd_group=self.data.bd_group,
         )
 
     def _local_gctx(self, edge_src, edge_dst, in_degree, ell_idx,

@@ -155,7 +155,8 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
                         section_rows: Optional[int] = None,
                         sect_sub_w: int = 8, sect_u16: bool = False,
                         bdense_min_fill: int = 64,
-                        bdense_a_budget: Optional[int] = 2 << 30):
+                        bdense_a_budget: Optional[int] = 2 << 30,
+                        bdense_group: int = 1):
     """Multi-host version of ``distributed.shard_dataset``: each process
     BUILDS and uploads only its own partitions' shards — row-sliced
     loads via :class:`roc_tpu.core.source.DataSource`, per-partition
@@ -377,10 +378,14 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
         src_rows = P * pn
         ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
                                   pn) for p in local}
+        # group>1 plans arrive per-part group-aligned BEFORE the
+        # nblk_max collective: every host's count is a group multiple,
+        # so the uniform stacked tail below pads in whole dummy-dst
+        # groups
         plans = {p: plan_blocks(
             ptrs[p], cols[p][:int(ptrs[p][-1])], pn,
             min_fill=bdense_min_fill, a_budget_bytes=bdense_a_budget,
-            num_cols=src_rows) for p in local}
+            num_cols=src_rows, group=bdense_group) for p in local}
         bd_occupancy = tuple(plans[p].occupancy() for p in local)
         # uniform per-part block count: global max via the O(P)
         # stats collective (the sum slot is unused here)
@@ -442,4 +447,5 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
         bd_vpad=bd_vpad,
         bd_src_vpad=bd_src_vpad,
         bd_occupancy=bd_occupancy,
+        bd_group=bdense_group if bd_tabs else 1,
     )

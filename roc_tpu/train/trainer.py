@@ -110,6 +110,11 @@ class TrainConfig:
     #   planted16384_lpa_f32_b6g).  None disables the cap.
     bdense_min_fill: int = 64
     bdense_a_budget: Optional[int] = 2 << 30
+    # - bdense_group: dense blocks reduced per output-tile update
+    #   (pad_plan_groups).  >1 cuts the dominant [128, F] fp32 output
+    #   read-modify-write traffic group-x for <= (group-1) zero-A
+    #   padding blocks per occupied dst tile.
+    bdense_group: int = 1
 
 
 def resolve_dtypes(name: str):
@@ -269,6 +274,7 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        sect_u16: bool = False,
                        bdense_min_fill: int = 64,
                        bdense_a_budget: Optional[int] = 2 << 30,
+                       bdense_group: int = 1,
                        verbose: bool = False) -> GraphContext:
     """Single-device GraphContext: edges padded to the chunk multiple,
     dummy source id == num_nodes (the appended zero row).
@@ -328,7 +334,8 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         import sys as _sys
         plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
                            min_fill=bdense_min_fill,
-                           a_budget_bytes=bdense_a_budget)
+                           a_budget_bytes=bdense_a_budget,
+                           group=bdense_group)
         occ = plan.occupancy()
         if plan.n_blocks:
             if verbose:
@@ -393,6 +400,7 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         bd_src=bd_src,
         bd_dst=bd_dst,
         bd_vpad=bd_vpad,
+        bd_group=bdense_group if bd_a is not None else 1,
     )
 
 
@@ -488,6 +496,7 @@ class Trainer:
                 sect_u16=config.sect_u16,
                 bdense_min_fill=config.bdense_min_fill,
                 bdense_a_budget=config.bdense_a_budget,
+                bdense_group=config.bdense_group,
                 verbose=config.verbose)
         # Dataset tensors are jitted *arguments*, not closure captures:
         # capturing them would embed a second copy of the feature matrix

@@ -53,6 +53,80 @@ def test_block_dense_plus_residual_matches_reference(min_fill):
                                atol=1e-4)
 
 
+@pytest.mark.parametrize("group", [2, 4, 7])
+def test_grouped_reduction_matches_ungrouped(group):
+    """pad_plan_groups + group>1 kernel == the group=1 result exactly
+    in structure (same dense/residual split) and numerically (the
+    padding blocks are zero-A): the output-RMW-traffic optimization
+    must not change a single value."""
+    from roc_tpu.ops.blockdense import pad_plan_groups
+    g = planted_community_csr(500, 6000, community_rows=BLOCK,
+                              shuffle=False, seed=3)
+    plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes, min_fill=4)
+    assert plan.n_blocks > 2
+    padded = pad_plan_groups(plan, group)
+    # group alignment, per-dst-tile padding only
+    assert padded.n_blocks % group == 0
+    assert padded.n_blocks < plan.n_blocks + group * len(
+        np.unique(plan.dst_blk))
+    # padding blocks are inert: zero A
+    assert padded.a_blocks.sum() == plan.a_blocks.sum()
+    # every group shares one dst tile
+    dgrp = padded.dst_blk.reshape(-1, group)
+    assert (dgrp == dgrp[:, :1]).all()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(g.num_nodes, 24).astype(np.float32))
+    base = np.asarray(aggregate_block_dense(
+        x, jnp.asarray(plan.a_blocks), jnp.asarray(plan.src_blk),
+        jnp.asarray(plan.dst_blk), g.num_nodes, plan.vpad,
+        chunk_blocks=4))
+    got = np.asarray(aggregate_block_dense(
+        x, jnp.asarray(padded.a_blocks), jnp.asarray(padded.src_blk),
+        jnp.asarray(padded.dst_blk), g.num_nodes, padded.vpad,
+        chunk_blocks=4 * group, group=group))
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-5)
+    # an unpadded plan with group>1 must fail fast, not mis-aggregate
+    if plan.n_blocks % group:
+        with pytest.raises(ValueError, match="pad_plan_groups"):
+            aggregate_block_dense(
+                x, jnp.asarray(plan.a_blocks),
+                jnp.asarray(plan.src_blk), jnp.asarray(plan.dst_blk),
+                g.num_nodes, plan.vpad, group=group)
+
+
+def test_trainer_bdense_group_matches_segment():
+    """TrainConfig.bdense_group end-to-end through the Trainer:
+    grouped bdense == ungrouped == segment (same trained params), with
+    a real dense+residual split and real group padding exercised."""
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+
+    ds = synthetic_dataset(300, 9, in_dim=12, num_classes=3, seed=4)
+    kw = dict(learning_rate=0.05, epochs=5, eval_every=1 << 30,
+              verbose=False, dropout_rate=0.0, symmetric=True)
+    trainers = {}
+    for label, impl, grp in (("segment", "segment", 1),
+                             ("bdense", "bdense", 1),
+                             ("bdense_g4", "bdense", 4)):
+        tr = Trainer(build_gcn([12, 8, 3], dropout_rate=0.0), ds,
+                     TrainConfig(aggr_impl=impl, bdense_min_fill=40,
+                                 bdense_group=grp, **kw))
+        tr.train()
+        trainers[label] = tr
+    tg = trainers["bdense_g4"]
+    assert tg.gctx.bd_group == 4
+    assert tg.gctx.bd_a.shape[0] % 4 == 0
+    # padding actually happened (the fixture's tile widths are odd)
+    assert tg.gctx.bd_a.shape[0] > trainers["bdense"].gctx.bd_a.shape[0]
+    for ref in ("bdense", "segment"):
+        for k in trainers[ref].params:
+            np.testing.assert_allclose(
+                np.asarray(tg.params[k]),
+                np.asarray(trainers[ref].params[k]),
+                rtol=2e-4, atol=2e-4)
+
+
 def test_plan_occupancy_reflects_structure():
     """Oracle-ordered community graph concentrates edges into few
     blocks; uniform random at the same V/E does not — the stat that
@@ -135,6 +209,39 @@ def test_a_budget_keeps_densest_blocks():
     x = jnp.asarray(rng.randn(g.num_nodes, 8).astype(np.float32))
     np.testing.assert_allclose(_dense_plus_residual(g, x, capped),
                                _reference(g, x), rtol=1e-4, atol=1e-4)
+
+
+def test_group_padding_respects_a_budget():
+    """With group>1 the budget caps the PADDED table: the selection
+    must account for alignment blocks up front, never exceed the byte
+    cap after padding, and exactness must survive (dropped blocks fall
+    to the residual)."""
+    g = planted_community_csr(600, 9000, community_rows=BLOCK,
+                              shuffle=False, seed=5)
+    budget = 4 * BLOCK * BLOCK  # room for four PADDED blocks
+    plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes, min_fill=1,
+                       a_budget_bytes=budget, group=4)
+    assert plan.n_blocks * BLOCK * BLOCK <= budget
+    assert plan.n_blocks % 4 == 0
+    # group=1 at the same budget keeps 4 raw blocks; grouping must
+    # not keep MORE raw blocks than that
+    raw = plan.n_blocks - plan.pad_blocks
+    assert 0 < raw <= 4
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(g.num_nodes, 8).astype(np.float32))
+    out = np.asarray(aggregate_block_dense(
+        x, jnp.asarray(plan.a_blocks), jnp.asarray(plan.src_blk),
+        jnp.asarray(plan.dst_blk), g.num_nodes, plan.vpad,
+        chunk_blocks=4, group=4))
+    res_deg = np.diff(plan.res_row_ptr)
+    rdst = np.repeat(np.arange(g.num_nodes, dtype=np.int64), res_deg)
+    if rdst.size:
+        xp = jnp.concatenate([x, jnp.zeros((1, x.shape[1]), x.dtype)])
+        out = out + np.asarray(aggregate_segment(
+            xp, jnp.asarray(plan.res_col), jnp.asarray(rdst),
+            g.num_nodes))
+    np.testing.assert_allclose(out, _reference(g, x), rtol=1e-4,
+                               atol=1e-4)
 
 
 def test_trainer_bdense_matches_segment():
@@ -252,9 +359,13 @@ def test_bdense_distributed_matches_segment():
                                rtol=2e-3, atol=2e-3)
 
 
-def test_bdense_distributed_matches_single_device():
+@pytest.mark.parametrize("group", [1, 4])
+def test_bdense_distributed_matches_single_device(group):
     """1-vs-N invariance for the bdense path: the 4-part distributed
-    run reproduces the single-device bdense trajectory."""
+    run reproduces the single-device bdense trajectory — with and
+    without the grouped output-tile reduction (whose per-part
+    alignment + whole-group stacked tail padding is the subtle SPMD
+    invariant)."""
     from roc_tpu.core.graph import synthetic_dataset
     from roc_tpu.models.gcn import build_gcn
     from roc_tpu.parallel.distributed import DistributedTrainer
@@ -262,7 +373,8 @@ def test_bdense_distributed_matches_single_device():
 
     ds = synthetic_dataset(384, 9, in_dim=12, num_classes=3, seed=4)
     kw = dict(learning_rate=0.05, epochs=4, eval_every=1 << 30,
-              verbose=False, dropout_rate=0.0, symmetric=True)
+              verbose=False, dropout_rate=0.0, symmetric=True,
+              bdense_group=group)
     td = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
                             ds, 4,
                             TrainConfig(aggr_impl="bdense",
@@ -270,12 +382,41 @@ def test_bdense_distributed_matches_single_device():
     t1 = Trainer(build_gcn([12, 8, 3], dropout_rate=0.0), ds,
                  TrainConfig(aggr_impl="bdense", bdense_min_fill=64,
                              **kw))
+    if group > 1:
+        assert td.data.bd_group == group
+        assert td.data.bd_tabs[0].shape[1] % group == 0
     td.train()
     t1.train()
     for k in t1.params:
         np.testing.assert_allclose(np.asarray(td.params[k]),
                                    np.asarray(t1.params[k]),
                                    rtol=2e-4, atol=2e-4)
+
+
+def test_bdense_distributed_group_mismatch_fails_fast():
+    """Injected data built with one bdense_group must be rejected by a
+    config wanting another — a silent mismatch would reduce across
+    dst-tile boundaries without any shape error."""
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.core.partition import partition_graph
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel import multihost as mh
+    from roc_tpu.parallel.distributed import (DistributedTrainer,
+                                              shard_dataset)
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=2)
+    pg = partition_graph(ds.graph, 4, node_multiple=8, edge_multiple=64)
+    mesh = mh.make_parts_mesh(4)
+    data = shard_dataset(ds, pg, mesh, aggr_impl="bdense",
+                         bdense_min_fill=8)  # group=1 tables
+    assert data.bd_tabs and data.bd_group == 1
+    with pytest.raises(ValueError, match="bdense_group"):
+        DistributedTrainer(
+            build_gcn([12, 8, 3], dropout_rate=0.0), ds, 4,
+            TrainConfig(aggr_impl="bdense", bdense_min_fill=8,
+                        bdense_group=4, verbose=False),
+            mesh=mesh, data=data, pg=pg)
 
 
 def test_bdense_distributed_no_dense_tiles_falls_back():
@@ -306,11 +447,14 @@ def test_bdense_distributed_no_dense_tiles_falls_back():
                                    rtol=2e-4, atol=2e-4)
 
 
-def test_bdense_multihost_local_build_matches_global_and_trains():
+@pytest.mark.parametrize("group", [1, 3])
+def test_bdense_multihost_local_build_matches_global_and_trains(group):
     """shard_dataset_local's bdense tables (block-count + residual
     chunk plan agreed via the O(P) collectives) must equal
-    shard_dataset's single-controller build, and the injected-data
-    path must train through them."""
+    shard_dataset's single-controller build — including the group
+    alignment, whose uniform stacked tail relies on every host's
+    count being a group multiple — and the injected-data path must
+    train through them."""
     from roc_tpu.core.graph import synthetic_dataset
     from roc_tpu.core.partition import partition_graph
     from roc_tpu.models.gcn import build_gcn
@@ -322,11 +466,13 @@ def test_bdense_multihost_local_build_matches_global_and_trains():
     ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=2)
     pg = partition_graph(ds.graph, 4, node_multiple=8, edge_multiple=64)
     mesh = mh.make_parts_mesh(4)
-    kw = dict(aggr_impl="bdense", bdense_min_fill=8)
+    kw = dict(aggr_impl="bdense", bdense_min_fill=8,
+              bdense_group=group)
     loc = mh.shard_dataset_local(ds, pg, mesh, **kw)
     glo = shard_dataset(ds, pg, mesh, **kw)
     assert len(loc.bd_tabs) == 3 == len(glo.bd_tabs), \
         "fixture must yield dense tiles in both builders"
+    assert loc.bd_group == group == glo.bd_group
     for a, b in zip(loc.bd_tabs, glo.bd_tabs):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert (loc.bd_vpad, loc.bd_src_vpad) == (glo.bd_vpad,
@@ -338,8 +484,8 @@ def test_bdense_multihost_local_build_matches_global_and_trains():
     assert loc.sect_meta == glo.sect_meta
     assert loc.edge_src.shape[-1] == 1
     cfg = TrainConfig(epochs=2, verbose=False, aggr_impl="bdense",
-                      bdense_min_fill=8, dropout_rate=0.0,
-                      eval_every=1 << 30)
+                      bdense_min_fill=8, bdense_group=group,
+                      dropout_rate=0.0, eval_every=1 << 30)
     tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
                             ds, 4, cfg, mesh=mesh, data=loc, pg=pg)
     tr.train(epochs=2)
