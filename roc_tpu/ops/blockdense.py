@@ -42,6 +42,16 @@ from jax import lax
 BLOCK = 128          # MXU-native tile edge
 _CHUNK_BLOCKS = 256  # blocks per scan step: bounds the [C,128,F] transient
 
+# aggr_impl='auto' structure probe (probe_dense_frac): below this edge
+# count the sectioned gather is cheap enough that planning overhead
+# isn't worth probing; at/above this dense fraction the measured
+# bdense win (1.64x at 0.52, 2.49x at 0.81 — BASELINE.md) justifies
+# switching.  0.15 is conservative: every block past min_fill is
+# already cheaper per edge than the 7 ns/edge gather, but a thin
+# dense slice still costs A-table HBM residency next to the model.
+BDENSE_AUTO_MIN_EDGES = 5_000_000
+BDENSE_AUTO_MIN_FRAC = 0.15
+
 
 @dataclass
 class BlockPlan:
@@ -156,7 +166,9 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
                 num_rows: int, min_fill: int = 64,
                 a_budget_bytes: Optional[int] = 2 << 30,
                 num_cols: Optional[int] = None,
-                group: int = 1) -> BlockPlan:
+                group: int = 1,
+                census: Optional[Tuple[np.ndarray, np.ndarray]] = None
+                ) -> BlockPlan:
     """Tile the dst-major CSR into [128, 128] blocks; blocks with at
     least ``min_fill`` edges go dense, the rest stay residual CSR.
 
@@ -175,7 +187,12 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
     ``group > 1`` returns a :func:`pad_plan_groups`-aligned plan for
     the kernel's grouped output-tile reduction; the budget then caps
     the PADDED table (the selection accounts for alignment blocks up
-    front — see _select_dense)."""
+    front — see _select_dense).
+
+    ``census`` is an optional precomputed ``(keys, counts)`` from
+    :func:`probe_dense_frac` over the SAME (num_rows, num_cols) tile
+    space — the auto probe's O(E) walk is then not repeated (native
+    path only; the numpy fallback recomputes)."""
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     col_i32 = np.ascontiguousarray(col_idx, dtype=np.int32)
     E = col_i32.shape[0]
@@ -191,8 +208,9 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
         # scale vs ~15 min for the numpy argsort/unique pipeline);
         # byte-identical plans (tested).  col stays int32 throughout —
         # Graph.col_idx already is, so no full-E copies happen here
-        keys_all, counts_all = native.block_counts(
-            row_ptr, col_i32, num_rows, BLOCK, num_cols=num_cols)
+        keys_all, counts_all = census if census is not None \
+            else native.block_counts(
+                row_ptr, col_i32, num_rows, BLOCK, num_cols=num_cols)
         dense_keys = keys_all[_select_dense(
             counts_all, min_fill, a_budget_bytes, group=group,
             dst_of=keys_all // n_tiles)]
@@ -279,6 +297,46 @@ def plan_blocks(row_ptr: np.ndarray, col_idx: np.ndarray,
         res_row_ptr=res_ptr, res_col=res_col.astype(np.int32),
         dense_edges=dense_edges, total_edges=E,
         src_vpad=src_vpad), group)
+
+
+def probe_dense_frac(row_ptr: np.ndarray, col_idx: np.ndarray,
+                     num_rows: int, min_fill: int = 64,
+                     a_budget_bytes: Optional[int] = 2 << 30,
+                     num_cols: Optional[int] = None,
+                     group: int = 1, return_census: bool = False):
+    """Census-only estimate of the edge fraction a bdense plan would
+    put on dense tiles — the ``aggr_impl='auto'`` structure probe.
+
+    Runs the native O(E) tile census + the budget selection but skips
+    the A fill (the expensive half of planning), so ``auto`` can
+    decide sectioned-vs-bdense in ~a second at Reddit scale.  Returns
+    None without librocio — the numpy census costs minutes at the
+    scales where probing matters, and ``auto`` must never be slower
+    than what it replaces.  (The estimate ignores uint8 saturation
+    overflow — pathological >255-multiplicity edges land in the
+    residual at plan time; negligible for the decision.)
+
+    ``return_census=True`` returns ``(frac, (keys, counts))`` so a
+    following :func:`plan_blocks` call over the SAME tile space can
+    reuse the census instead of re-walking the CSR."""
+    from .. import native
+    if not native.available():
+        return None
+    row_ptr = np.asarray(row_ptr, dtype=np.int64)
+    col_i32 = np.ascontiguousarray(col_idx, dtype=np.int32)
+    E = col_i32.shape[0]
+    if num_cols is None:
+        num_cols = num_rows
+    n_tiles = -(-num_cols // BLOCK)
+    if E == 0:
+        empty = (np.zeros(0, np.int64), np.zeros(0, np.int64))
+        return (0.0, empty) if return_census else 0.0
+    keys, counts = native.block_counts(row_ptr, col_i32, num_rows,
+                                       BLOCK, num_cols=num_cols)
+    sel = _select_dense(counts, min_fill, a_budget_bytes, group=group,
+                        dst_of=keys // n_tiles)
+    frac = float(counts[sel].sum()) / E
+    return (frac, (keys, counts)) if return_census else frac
 
 
 def pad_plan_groups(plan: BlockPlan, group: int) -> BlockPlan:

@@ -388,16 +388,24 @@ class DistributedTrainer:
                 "distributed >HBM mechanism is halo='ring' (the "
                 "autopilot picks it automatically for parts > 1)")
         if config.aggr_impl == "auto":
-            # data-driven split: the gather-table bound uses the
-            # GLOBAL node count (a partition gathers from all nodes);
-            # the scatter-carry bound uses the per-partition output
-            # rows (resolve_auto_impl docstring)
-            from ..core.ell import resolve_auto_impl
+            # shared rule incl. the bdense structure probe (the global
+            # dense fraction is the right proxy: per-part plans tile
+            # contiguous local row ranges of the same vertex order).
+            # The gather-table bound uses the GLOBAL node count, the
+            # scatter-carry bound the per-partition output rows
+            # (resolve_auto_impl docstring).  Multi-process runs skip
+            # the probe — every SPMD process must resolve identically.
+            import jax as _jax
+            from ..train.trainer import resolve_auto_impl_probed
             v = dataset.graph.num_nodes
-            config = dc_replace(
-                config,
-                aggr_impl=resolve_auto_impl(
-                    v, out_rows=-(-v // num_parts)))
+            impl, _ = resolve_auto_impl_probed(
+                dataset.graph, out_rows=-(-v // num_parts),
+                bdense_min_fill=config.bdense_min_fill,
+                bdense_a_budget=config.bdense_a_budget,
+                bdense_group=config.bdense_group,
+                verbose=config.verbose,
+                multiprocess=_jax.process_count() > 1)
+            config = dc_replace(config, aggr_impl=impl)
         from ..train.trainer import resolve_attention_impl
         # dataset passed: attention models past ATTN_FLAT8_MIN_EDGES
         # auto-route to the uniform flat8 layout here too —

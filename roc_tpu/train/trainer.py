@@ -11,7 +11,7 @@ the minimum end-to-end slice (BASELINE.md config 1/2 path).
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace as dc_replace
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -267,6 +267,55 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
         halo=plan.halo if num_parts > 1 else config.halo)
 
 
+def resolve_auto_impl_probed(graph, out_rows: Optional[int] = None, *,
+                             bdense_min_fill: int = 64,
+                             bdense_a_budget: Optional[int] = 2 << 30,
+                             bdense_group: int = 1,
+                             verbose: bool = False,
+                             multiprocess: bool = False):
+    """ONE home for the full ``aggr_impl='auto'`` rule: the measured
+    sectioned/ell node-count window (core/ell.py resolve_auto_impl)
+    plus the bdense STRUCTURE probe — when the vertex order
+    concentrates enough edges into [128,128] tiles (community graphs
+    after ``--reorder lpa``), the MXU block-dense path beats the
+    row-rate-bound gather (measured 1.64-2.49x, BASELINE.md).  The
+    probe is census-only (~a second at Reddit scale) and native-gated.
+
+    Returns ``(impl, census)``; ``census`` is the reusable
+    ``(keys, counts)`` when the probe selected 'bdense' over the SAME
+    square tile space plan_blocks will use, else None.
+
+    ``multiprocess=True`` skips the probe entirely: its outcome
+    depends on per-host native availability, and every SPMD process
+    must resolve the SAME impl — multi-process resolution stays pure
+    arithmetic (set aggr_impl explicitly to use bdense there)."""
+    import sys as _sys
+    from ..core.ell import resolve_auto_impl
+    from ..ops import blockdense as _BD
+    impl = resolve_auto_impl(graph.num_nodes, out_rows=out_rows)
+    if (impl != "sectioned" or multiprocess
+            or graph.num_edges < _BD.BDENSE_AUTO_MIN_EDGES):
+        return impl, None
+    probe = _BD.probe_dense_frac(
+        graph.row_ptr, graph.col_idx, graph.num_nodes,
+        min_fill=bdense_min_fill, a_budget_bytes=bdense_a_budget,
+        group=bdense_group, return_census=True)
+    if probe is None:
+        return impl, None
+    frac, census = probe
+    if frac >= _BD.BDENSE_AUTO_MIN_FRAC:
+        # changes the execution path — echoes unconditionally
+        print(f"# aggr_impl='auto' -> 'bdense' (census: {frac:.0%} "
+              f"of edges on dense tiles >= "
+              f"{_BD.BDENSE_AUTO_MIN_FRAC:.0%})", file=_sys.stderr)
+        return "bdense", census
+    if verbose:
+        print(f"# auto bdense probe: dense_frac {frac:.1%} < "
+              f"{_BD.BDENSE_AUTO_MIN_FRAC:.0%} — staying sectioned",
+              file=_sys.stderr)
+    return impl, None
+
+
 def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        chunk: int = 512,
                        symmetric: Optional[bool] = None,
@@ -283,12 +332,12 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     the same names); ``verbose`` gates the informational echoes (the
     impl-override ones stay unconditional)."""
     g = dataset.graph
+    bd_census = None
     if aggr_impl == "auto":
-        # data-driven split: sectioned wins in its measured node-count
-        # window, ell outside it (core/ell.py resolve_auto_impl has
-        # the numbers)
-        from ..core.ell import resolve_auto_impl
-        aggr_impl = resolve_auto_impl(g.num_nodes)
+        aggr_impl, bd_census = resolve_auto_impl_probed(
+            g, bdense_min_fill=bdense_min_fill,
+            bdense_a_budget=bdense_a_budget,
+            bdense_group=bdense_group, verbose=verbose)
     ell_idx: tuple = ()
     ell_row_pos = None
     sect_idx: tuple = ()
@@ -335,7 +384,7 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
                            min_fill=bdense_min_fill,
                            a_budget_bytes=bdense_a_budget,
-                           group=bdense_group)
+                           group=bdense_group, census=bd_census)
         occ = plan.occupancy()
         if plan.n_blocks:
             if verbose:
@@ -498,6 +547,16 @@ class Trainer:
                 bdense_a_budget=config.bdense_a_budget,
                 bdense_group=config.bdense_group,
                 verbose=config.verbose)
+            if config.aggr_impl == "auto":
+                # reflect the resolved impl (the probe/window choice)
+                # so recorded artifacts and callers reading
+                # trainer.config.aggr_impl see what actually runs —
+                # the DistributedTrainer already writes its resolution
+                # back.  Gated on 'auto': the host-features branch
+                # above builds a placeholder context whose impl must
+                # never overwrite an explicit user choice.
+                self.config = dc_replace(self.config,
+                                         aggr_impl=self.gctx.aggr_impl)
         # Dataset tensors are jitted *arguments*, not closure captures:
         # capturing them would embed a second copy of the feature matrix
         # as an executable constant and recompile per Trainer instance

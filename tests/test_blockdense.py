@@ -211,6 +211,87 @@ def test_a_budget_keeps_densest_blocks():
                                _reference(g, x), rtol=1e-4, atol=1e-4)
 
 
+def test_probe_dense_frac_matches_plan():
+    """The census-only auto probe must agree with the full plan's
+    dense_frac (same census + same selection, minus the A fill)."""
+    import roc_tpu.native as native_mod
+    if not native_mod.available():
+        pytest.skip("probe is native-gated")
+    from roc_tpu.ops.blockdense import probe_dense_frac
+    comm = planted_community_csr(2048, 60_000, community_rows=512,
+                                 intra_frac=0.9, shuffle=False, seed=1)
+    unif = random_csr(20_000, 100_000, seed=2)
+    for g, v in ((comm, 2048), (unif, 20_000)):
+        frac = probe_dense_frac(g.row_ptr, g.col_idx, v, min_fill=64)
+        plan = plan_blocks(g.row_ptr, g.col_idx, v, min_fill=64)
+        assert frac == pytest.approx(plan.occupancy()["dense_frac"],
+                                     abs=1e-3)
+    # grouped probe respects the padded-budget selection
+    budget = 4 * BLOCK * BLOCK
+    frac_b = probe_dense_frac(comm.row_ptr, comm.col_idx, 2048,
+                              min_fill=1, a_budget_bytes=budget,
+                              group=4)
+    plan_b = plan_blocks(comm.row_ptr, comm.col_idx, 2048, min_fill=1,
+                         a_budget_bytes=budget, group=4)
+    assert frac_b == pytest.approx(plan_b.occupancy()["dense_frac"],
+                                   abs=1e-3)
+
+
+def test_auto_impl_probes_structure(monkeypatch):
+    """aggr_impl='auto' switches to bdense when the census finds
+    enough dense-tile structure, and stays sectioned on a uniform
+    graph — the flagship path must be reachable without naming it."""
+    import roc_tpu.native as native_mod
+    if not native_mod.available():
+        pytest.skip("probe is native-gated")
+    from roc_tpu.core import ell as ell_mod
+    from roc_tpu.core.graph import Dataset
+    from roc_tpu.ops import blockdense as bd
+    from roc_tpu.train.trainer import make_graph_context
+
+    # shrink the gate sizes so the fixture stays test-sized; the
+    # trainer reads both dynamically
+    monkeypatch.setattr(bd, "BDENSE_AUTO_MIN_EDGES", 10_000)
+    monkeypatch.setattr(ell_mod, "SECTIONED_BOUNDS_DEFAULT",
+                        (1_000, 10**9), raising=False)
+    monkeypatch.setattr(ell_mod, "sectioned_bounds",
+                        lambda device_kind=None: (1_000, 10**9))
+
+    def mk(g):
+        rng = np.random.RandomState(0)
+        return Dataset(graph=g,
+                       features=rng.rand(g.num_nodes, 8).astype(
+                           np.float32),
+                       labels=np.zeros(g.num_nodes, np.int32),
+                       mask=np.ones(g.num_nodes, np.int32),
+                       num_classes=2, name="probe")
+
+    comm = planted_community_csr(2048, 60_000, community_rows=512,
+                                 intra_frac=0.9, shuffle=False, seed=1)
+    gc = make_graph_context(mk(comm), "auto", bdense_min_fill=64)
+    assert gc.aggr_impl == "bdense"
+    assert gc.bd_a is not None
+    unif = random_csr(20_000, 100_000, seed=2)
+    gu = make_graph_context(mk(unif), "auto", bdense_min_fill=64)
+    assert gu.aggr_impl == "sectioned"
+
+    # the shared resolver: census returned on the bdense path is
+    # byte-identical to a fresh plan's walk; multiprocess runs skip
+    # the probe (per-host native availability must not desync SPMD)
+    from roc_tpu.train.trainer import resolve_auto_impl_probed
+    impl, census = resolve_auto_impl_probed(comm, bdense_min_fill=64)
+    assert impl == "bdense" and census is not None
+    p_census = plan_blocks(comm.row_ptr, comm.col_idx, 2048,
+                           min_fill=64, census=census)
+    p_fresh = plan_blocks(comm.row_ptr, comm.col_idx, 2048,
+                          min_fill=64)
+    np.testing.assert_array_equal(p_census.a_blocks, p_fresh.a_blocks)
+    np.testing.assert_array_equal(p_census.res_col, p_fresh.res_col)
+    impl_mp, cen_mp = resolve_auto_impl_probed(
+        comm, bdense_min_fill=64, multiprocess=True)
+    assert impl_mp == "sectioned" and cen_mp is None
+
+
 def test_group_padding_respects_a_budget():
     """With group>1 the budget caps the PADDED table: the selection
     must account for alignment blocks up front, never exceed the byte
