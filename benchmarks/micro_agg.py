@@ -193,15 +193,16 @@ def main():
             # claim's evidence either way.
             from roc_tpu.core.ell import sectioned_from_graph
             from roc_tpu.ops.aggregate import aggregate_ell_sect
-            from roc_tpu.ops.blockdense import (aggregate_block_dense,
-                                                plan_blocks)
+            from roc_tpu.ops.blockdense import (BLOCK,
+                                                aggregate_block_dense,
+                                                plan_blocks_packed)
             min_fill = chunk if len(parts) > 1 else 64
             group = int(parts[2]) if len(parts) > 2 else 1
             t0 = time.time()
-            plan = plan_blocks(g.row_ptr, g.col_idx, V,
-                               min_fill=min_fill,
-                               a_budget_bytes=args.a_budget or None,
-                               group=group)
+            plan = plan_blocks_packed(
+                g.row_ptr, g.col_idx, V, min_fill=min_fill,
+                a_budget_bytes=args.a_budget or None, group=group)
+            u4 = plan.a_blocks.shape[-1] == BLOCK // 2
             occ = plan.occupancy()
             res_frac = 1.0 - occ["dense_frac"]
             have_residual = plan.res_col.shape[0] > 0
@@ -234,6 +235,7 @@ def main():
                 ms = bench(run, args.iters)
                 gpad = (f", group {group} (+{plan.pad_blocks} pad)"
                         if group > 1 else "")
+                gpad += ", A u4" if u4 else ""
                 print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
                       f"(prep {prep:.1f}s, {occ['n_blocks']} blocks, "
                       f"fill {occ['mean_fill']}, dense "

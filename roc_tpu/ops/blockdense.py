@@ -59,7 +59,10 @@ class BlockPlan:
 
     a_blocks: uint8 [nblk, 128, 128] edge multiplicities (the planted
       generators emit duplicate edges; segment-sum semantics require
-      counts, not 0/1).
+      counts, not 0/1) — OR, after :func:`pack_a_u4`, uint4-packed
+      [nblk, 128, 64] with two multiplicities per byte (low nibble =
+      even column); consumers must check the trailing axis before
+      indexing columns directly.
     src_blk/dst_blk: int32 [nblk] tile ids, sorted by dst_blk (the
       output scatter-add sees sorted indices).
     res_row_ptr/res_col: the residual dst-major CSR (edges in blocks
@@ -105,7 +108,8 @@ class BlockPlan:
             "dense_frac": round(self.dense_edges
                                 / max(self.total_edges, 1), 4),
             "mean_fill": round(self.dense_edges / max(raw, 1), 1),
-            "a_bytes": int(nb) * BLOCK * BLOCK,
+            # real device bytes — halved when pack_a_u4 applied
+            "a_bytes": int(self.a_blocks.nbytes),
         }
         if self.pad_blocks:
             occ["pad_blocks"] = int(self.pad_blocks)
@@ -379,6 +383,59 @@ def pad_plan_groups(plan: BlockPlan, group: int) -> BlockPlan:
                    + (total - plan.n_blocks))
 
 
+def plan_blocks_packed(row_ptr: np.ndarray, col_idx: np.ndarray,
+                       num_rows: int, min_fill: int = 64,
+                       a_budget_bytes: Optional[int] = 2 << 30,
+                       num_cols: Optional[int] = None,
+                       group: int = 1,
+                       census=None) -> BlockPlan:
+    """:func:`plan_blocks` + the u4 packing budget policy — ONE home
+    for the rule (trainer and micro_agg share it): plan against
+    DOUBLE the A budget first, since :func:`pack_a_u4` halves device
+    bytes and a packable graph can afford 2x the blocks within the
+    stated cap; unpackable plans (multi-edge hubs past 4 bits — rare)
+    re-plan at the true budget, reusing ``census`` so only the fill
+    repeats."""
+    budget2 = (a_budget_bytes * 2
+               if a_budget_bytes is not None else None)
+    plan = plan_blocks(row_ptr, col_idx, num_rows, min_fill=min_fill,
+                       a_budget_bytes=budget2, num_cols=num_cols,
+                       group=group, census=census)
+    p4 = pack_a_u4(plan)
+    if p4 is not None:
+        return p4
+    if a_budget_bytes is not None \
+            and plan.a_blocks.nbytes > a_budget_bytes:
+        plan = plan_blocks(row_ptr, col_idx, num_rows,
+                           min_fill=min_fill,
+                           a_budget_bytes=a_budget_bytes,
+                           num_cols=num_cols, group=group,
+                           census=census)
+    return plan
+
+
+def pack_a_u4(plan: BlockPlan) -> Optional[BlockPlan]:
+    """Pack the uint8 A-table to uint4 (two multiplicities per byte,
+    ``byte[..., k] = col 2k | col 2k+1 << 4``) — halves the A-table's
+    HBM bytes AND its read traffic (~17% of the grouped dense path's
+    per-block bytes).  Exact only when every multiplicity fits 4 bits;
+    returns None otherwise (community plans almost always fit — the
+    mean slot multiplicity is 1-2 — but a hub-multiedge plan must
+    fall back to uint8 rather than saturate silently).
+
+    The kernel detects packing from the trailing axis
+    (``BLOCK // 2``) and unpacks in-register per chunk.  Applied on
+    the single-device path (make_graph_context / micro_agg); the
+    stacked distributed/multihost builders keep uint8 — their
+    SPMD-uniform shapes would need a cross-part/host agreement on
+    packability that isn't worth the collective yet."""
+    if plan.n_blocks == 0 or plan.a_blocks.max() > 15:
+        return None
+    a = plan.a_blocks
+    packed = (a[..., 0::2] | (a[..., 1::2] << 4)).astype(np.uint8)
+    return replace(plan, a_blocks=packed)
+
+
 def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
                           src_blk: jax.Array, dst_blk: jax.Array,
                           num_rows: int, vpad: int,
@@ -422,9 +479,12 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
                        // group * group)
     chunks = max(1, -(-nblk // chunk_blocks))
     pad = chunks * chunk_blocks - nblk
+    # uint4-packed A (pack_a_u4) is detected from the trailing axis
+    a_w = a_blocks.shape[-1]
+    packed = a_w == BLOCK // 2
     a_p = jnp.concatenate([
         a_blocks,
-        jnp.zeros((pad, BLOCK, BLOCK), dtype=a_blocks.dtype)]) \
+        jnp.zeros((pad, BLOCK, a_w), dtype=a_blocks.dtype)]) \
         if pad else a_blocks
     s_p = jnp.concatenate([src_blk,
                            jnp.zeros(pad, dtype=src_blk.dtype)]) \
@@ -437,6 +497,11 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
 
     def body(out, ch):
         a_u8, s_ids, d_ids = ch
+        if packed:
+            # in-register uint4 unpack: byte k holds cols 2k / 2k+1
+            a_u8 = jnp.stack([a_u8 & 0xF, a_u8 >> 4],
+                             axis=-1).reshape(a_u8.shape[0],
+                                              BLOCK, BLOCK)
         gx = xt[s_ids].astype(compute)              # [C, 128, F]
         if group > 1:
             C = s_ids.shape[0]
@@ -457,6 +522,6 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
     C = chunk_blocks
     out, _ = lax.scan(
         body, out0,
-        (a_p.reshape(chunks, C, BLOCK, BLOCK),
+        (a_p.reshape(chunks, C, BLOCK, a_w),
          s_p.reshape(chunks, C), d_p.reshape(chunks, C)))
     return out[:n_tiles].reshape(vpad, F)[:num_rows].astype(out_dtype)

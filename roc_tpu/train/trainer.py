@@ -379,19 +379,22 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         # order concentrates edges into tiles; the occupancy echo
         # makes a mis-fit choice visible)
         from ..core.ell import default_section_rows, sectioned_from_graph
-        from ..ops.blockdense import plan_blocks
+        from ..ops.blockdense import BLOCK, plan_blocks_packed
         import sys as _sys
-        plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
-                           min_fill=bdense_min_fill,
-                           a_budget_bytes=bdense_a_budget,
-                           group=bdense_group, census=bd_census)
+        plan = plan_blocks_packed(g.row_ptr, g.col_idx, g.num_nodes,
+                                  min_fill=bdense_min_fill,
+                                  a_budget_bytes=bdense_a_budget,
+                                  group=bdense_group,
+                                  census=bd_census)
+        packed = plan.a_blocks.shape[-1] == BLOCK // 2
         occ = plan.occupancy()
         if plan.n_blocks:
             if verbose:
                 print(f"# bdense plan: {occ['n_blocks']} blocks, "
                       f"fill {occ['mean_fill']}, dense "
                       f"{occ['dense_frac']:.0%} (residual "
-                      f"{1 - occ['dense_frac']:.0%} via sectioned)",
+                      f"{1 - occ['dense_frac']:.0%} via sectioned"
+                      f"{', A u4-packed' if packed else ''})",
                       file=_sys.stderr)
             bd_a = jnp.asarray(plan.a_blocks)
             bd_src = jnp.asarray(plan.src_blk)

@@ -211,6 +211,73 @@ def test_a_budget_keeps_densest_blocks():
                                _reference(g, x), rtol=1e-4, atol=1e-4)
 
 
+def test_u4_packed_a_matches_uint8():
+    """pack_a_u4 halves the A bytes and the kernel's in-register
+    unpack reproduces the uint8 result exactly — grouped and
+    ungrouped; plans with multiplicities past 4 bits must refuse to
+    pack rather than saturate."""
+    from roc_tpu.ops.blockdense import pack_a_u4
+    g = planted_community_csr(500, 6000, community_rows=BLOCK,
+                              shuffle=False, seed=3)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(g.num_nodes, 24).astype(np.float32))
+    for group in (1, 4):
+        plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
+                           min_fill=4, group=group)
+        assert plan.a_blocks.max() <= 15, "fixture must be packable"
+        packed = pack_a_u4(plan)
+        assert packed is not None
+        assert packed.a_blocks.nbytes * 2 == plan.a_blocks.nbytes
+        assert packed.occupancy()["a_bytes"] * 2 == \
+            plan.occupancy()["a_bytes"]
+        base = np.asarray(aggregate_block_dense(
+            x, jnp.asarray(plan.a_blocks), jnp.asarray(plan.src_blk),
+            jnp.asarray(plan.dst_blk), g.num_nodes, plan.vpad,
+            chunk_blocks=4 * group, group=group))
+        got = np.asarray(aggregate_block_dense(
+            x, jnp.asarray(packed.a_blocks),
+            jnp.asarray(packed.src_blk), jnp.asarray(packed.dst_blk),
+            g.num_nodes, packed.vpad,
+            chunk_blocks=4 * group, group=group))
+        np.testing.assert_array_equal(got, base)
+    # >15 multiplicity: refuse to pack (the 400-duplicate fixture)
+    from roc_tpu.core.graph import Graph
+    row_ptr = np.array([0, 400, 401, 402], dtype=np.int64)
+    col_idx = np.array([1] * 400 + [2, 0], dtype=np.int32)
+    gd = Graph(row_ptr=row_ptr, col_idx=col_idx)
+    pd = plan_blocks(gd.row_ptr, gd.col_idx, gd.num_nodes, min_fill=1)
+    assert pd.a_blocks.max() > 15
+    assert pack_a_u4(pd) is None
+
+
+def test_plan_blocks_packed_budget_policy():
+    """plan_blocks_packed spends the stated budget in DEVICE bytes:
+    a packable graph keeps ~2x the blocks a uint8 plan could (packed
+    bytes still <= budget); an unpackable graph re-plans to the uint8
+    cap rather than exceeding it."""
+    from roc_tpu.ops.blockdense import plan_blocks_packed
+    g = planted_community_csr(600, 9000, community_rows=BLOCK,
+                              shuffle=False, seed=5)
+    budget = 2 * BLOCK * BLOCK  # two uint8 blocks / four packed
+    p8 = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes, min_fill=1,
+                     a_budget_bytes=budget)
+    pp = plan_blocks_packed(g.row_ptr, g.col_idx, g.num_nodes,
+                            min_fill=1, a_budget_bytes=budget)
+    assert pp.a_blocks.shape[-1] == BLOCK // 2, "fixture packable"
+    assert pp.a_blocks.nbytes <= budget
+    assert pp.n_blocks == 2 * p8.n_blocks
+    # unpackable: the 400-duplicate fixture must land at uint8 <= cap
+    from roc_tpu.core.graph import Graph
+    row_ptr = np.array([0, 400, 401, 402], dtype=np.int64)
+    col_idx = np.array([1] * 400 + [2, 0], dtype=np.int32)
+    gd = Graph(row_ptr=row_ptr, col_idx=col_idx)
+    pu = plan_blocks_packed(gd.row_ptr, gd.col_idx, gd.num_nodes,
+                            min_fill=1,
+                            a_budget_bytes=BLOCK * BLOCK)
+    assert pu.a_blocks.shape[-1] == BLOCK  # uint8
+    assert pu.a_blocks.nbytes <= BLOCK * BLOCK
+
+
 def test_probe_dense_frac_matches_plan():
     """The census-only auto probe must agree with the full plan's
     dense_frac (same census + same selection, minus the A fill)."""
@@ -574,10 +641,11 @@ def test_bdense_multihost_local_build_matches_global_and_trains(group):
 
 
 def test_trainer_bdense_a_budget_caps_plan_and_stays_exact():
-    """TrainConfig.bdense_a_budget reaches the planner: a one-block
-    budget shrinks the dense plan vs uncapped, pushes the dropped
-    blocks into the sectioned residual, and the capped trainer still
-    matches the segment reference exactly."""
+    """TrainConfig.bdense_a_budget reaches the planner and caps
+    DEVICE bytes: a one-uint8-block budget holds TWO u4-packed blocks
+    on this (packable) fixture, shrinks the plan vs uncapped, pushes
+    the dropped blocks into the sectioned residual, and the capped
+    trainer still matches the segment reference exactly."""
     from roc_tpu.core.graph import synthetic_dataset
     from roc_tpu.models.gcn import build_gcn
     from roc_tpu.train.trainer import TrainConfig, Trainer
@@ -594,8 +662,10 @@ def test_trainer_bdense_a_budget_caps_plan_and_stays_exact():
         TrainConfig(aggr_impl="bdense", bdense_min_fill=250,
                     bdense_a_budget=128 * 128, **kw))
     n_unc = int(uncapped.gctx.bd_a.shape[0])
-    assert n_unc > 1, "fixture must yield multiple dense tiles"
-    assert int(capped.gctx.bd_a.shape[0]) == 1
+    assert n_unc > 2, "fixture must yield multiple dense tiles"
+    assert int(capped.gctx.bd_a.shape[0]) == 2
+    assert capped.gctx.bd_a.shape[-1] == 64  # u4-packed
+    assert capped.gctx.bd_a.size <= 128 * 128  # device bytes <= cap
     ref = Trainer(build_gcn([12, 8, 3], dropout_rate=0.0), ds,
                   TrainConfig(aggr_impl="segment", **kw))
     capped.train()
