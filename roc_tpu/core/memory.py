@@ -91,12 +91,18 @@ def estimate_plan_bytes(num_nodes: int, num_edges: int,
                         dtype_bytes: int = 4, halo: str = "gather",
                         features: str = "hbm", remat: bool = False,
                         ring_padding: float = 1.7,
-                        remat_policy: str = "save_aggregates") -> int:
+                        remat_policy: str = "save_aggregates",
+                        extra_table_bytes: int = 0) -> int:
     """Coarse per-device peak-HBM estimate for one train step.
 
     ``layer_dims`` is the CLI layer spec (in-dim, hidden..., classes).
     Deliberately simple and slightly pessimistic — the policy needs
-    ordering between plans, not byte-exact numbers."""
+    ordering between plans, not byte-exact numbers.
+
+    ``extra_table_bytes`` covers impl-specific resident tables the
+    generic ``E*4`` term misses — today the bdense A-table, whose
+    worst case is exactly ``bdense_a_budget`` (the planner's device-
+    byte cap)."""
     V_p = -(-num_nodes // num_parts)
     E_p = -(-num_edges // num_parts)
     b = dtype_bytes
@@ -116,7 +122,7 @@ def estimate_plan_bytes(num_nodes: int, num_edges: int,
         total += 65536 * F * b  # one streamed block + dY reuse
 
     # edge tables: ELL idx ~ E_p int32 (+ row positions)
-    total += E_p * 4 + V_p * 4
+    total += E_p * 4 + V_p * 4 + extra_table_bytes
     if halo == "ring":
         total += int(2 * E_p * 4 * ring_padding)  # src+dst flat tables
 
@@ -145,7 +151,8 @@ def choose_memory_plan(num_nodes: int, num_edges: int,
                        dtype_bytes: int = 4,
                        hbm_bytes: Optional[int] = None,
                        head_streamable: bool = True,
-                       remat_policy: str = "save_aggregates"
+                       remat_policy: str = "save_aggregates",
+                       extra_table_bytes: int = 0
                        ) -> MemoryPlan:
     """First-fit over plans ordered cheapest-compute-first.
 
@@ -170,7 +177,12 @@ def choose_memory_plan(num_nodes: int, num_edges: int,
         est[name] = estimate_plan_bytes(
             num_nodes, num_edges, layer_dims, num_parts, dtype_bytes,
             halo=halo, features=feats, remat=remat,
-            remat_policy=remat_policy)
+            remat_policy=remat_policy,
+            # ring runs never build the bdense A-table (the ring
+            # tables fully describe the aggregation) — charging them
+            # would push ring plans into remat for phantom bytes
+            extra_table_bytes=(extra_table_bytes
+                               if halo == "gather" else 0))
     for name, halo, feats, remat in cands:
         if est[name] <= budget:
             return MemoryPlan(

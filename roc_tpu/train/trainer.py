@@ -251,6 +251,19 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
     from ..core.memory import choose_memory_plan
     dims = [model._ops[0].dim] + [op.dim for op in model._ops
                                   if op.kind == "linear"]
+    # explicit bdense keeps an A-table resident next to the model;
+    # its worst case is the planner's device-byte cap.  'auto' does
+    # NOT pre-charge it (the probe usually rejects, and charging it
+    # would push marginal uniform-graph configs into remat for
+    # nothing); an uncapped budget is unmodelable — the occupancy
+    # echo is the warning there.  Attention/MAX models never keep the
+    # table either: resolve_attention_impl (which runs AFTER the
+    # autopilot, because it must see the chosen halo) rewrites their
+    # impl away from bdense.
+    keeps_bdense = (config.aggr_impl == "bdense"
+                    and not model.uses_attention()
+                    and not model.uses_max_aggregation())
+    a_tab = (config.bdense_a_budget or 0) if keeps_bdense else 0
     plan = choose_memory_plan(
         dataset.graph.num_nodes, dataset.graph.num_edges, dims,
         num_parts=num_parts,
@@ -258,7 +271,8 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
         hbm_bytes=config.hbm_bytes,
         head_streamable=(model.streamable_head() is not None
                          or model.streamable_agg_head() is not None),
-        remat_policy=config.remat_policy)
+        remat_policy=config.remat_policy,
+        extra_table_bytes=a_tab)
     if config.verbose:
         print(plan.echo(), file=sys.stderr)
     return dataclasses.replace(

@@ -195,6 +195,28 @@ def test_choose_memory_plan_tiers():
                                 halo="ring")
             < estimate_plan_bytes(10**6, 10**7, dims, num_parts=8,
                                   halo="gather"))
+    # impl-resident tables (the bdense A-budget) are charged: the same
+    # config that fits plain flips to remat once the A-table bytes
+    # are on the books
+    base = estimate_plan_bytes(10**6, 10**7, dims)
+    assert estimate_plan_bytes(
+        10**6, 10**7, dims, extra_table_bytes=2 << 30) \
+        == base + (2 << 30)
+    p_no = choose_memory_plan(232_965, 114_848_857, dims,
+                              hbm_bytes=6 << 30)
+    p_bd = choose_memory_plan(232_965, 114_848_857, dims,
+                              hbm_bytes=6 << 30,
+                              extra_table_bytes=4 << 30)
+    assert not p_no.remat and p_bd.remat
+    # ring candidates are never charged (ring runs build no A-table):
+    # same A-charge, multi-part, budget that only ring can meet
+    p_ring = choose_memory_plan(4_000_000, 60_000_000, dims,
+                                num_parts=8, hbm_bytes=1 << 30,
+                                extra_table_bytes=4 << 30)
+    assert p_ring.halo == "ring"
+    assert p_ring.candidates["ring/hbm"] == \
+        choose_memory_plan(4_000_000, 60_000_000, dims, num_parts=8,
+                           hbm_bytes=1 << 30).candidates["ring/hbm"]
 
 
 def test_autopilot_trains_oversized_graph_without_flags():
