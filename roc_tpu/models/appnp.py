@@ -1,0 +1,52 @@
+"""APPNP model family: Approximate Personalized Propagation of Neural
+Predictions (Gasteiger et al., ICLR'19).
+
+``H = MLP(X); Z_0 = H; Z_{k+1} = (1 - alpha) * S Z_k + alpha * H``
+with ``S = D^-1/2 A D^-1/2`` (self edges pre-added — the reference's
+GCN normalization, ``gnn.cc:78-91``) and a FIXED teleport ``alpha``.
+The reference has no such model; APPNP completes the zoo with the
+decoupled predict-then-propagate family: all parameters live in the
+MLP, so depth-k propagation adds NO weights and cannot oversmooth the
+way a k-layer GCN does (the teleport keeps every hop anchored to the
+prediction H).
+
+On TPU the propagation is k ``scatter_gather`` ops through whatever
+aggregation layout the trainer resolved (sectioned / bdense / ell —
+the loop body is identical to GCN's hot path), combined per hop by
+the builder's fixed-scalar ``lerp`` op — XLA fuses the lerp into the
+aggregation output, so a hop costs the same as an SGC hop.
+
+``layers`` follows the CLI convention: ``layers[0]`` input feature
+dim, ``layers[-1]`` class count, intermediate entries are the MLP's
+ReLU-separated hidden widths.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from .builder import Model
+from ..ops.dense import AC_MODE_NONE
+
+
+def build_appnp(layers: Sequence[int], k: int = 10,
+                alpha: float = 0.1,
+                dropout_rate: float = 0.5) -> Model:
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    model = Model(in_dim=layers[0])
+    t = model.input()
+    n = len(layers)
+    for i in range(1, n):
+        t = model.dropout(t, dropout_rate)
+        t = model.linear(t, layers[i], AC_MODE_NONE)
+        if i != n - 1:
+            t = model.relu(t)
+    h = t
+    for _ in range(k):
+        t = model.indegree_norm(t)
+        t = model.scatter_gather(t)
+        t = model.indegree_norm(t)
+        t = model.lerp(t, h, alpha)
+    model.softmax_cross_entropy(t)
+    return model

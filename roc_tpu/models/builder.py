@@ -460,6 +460,15 @@ class Model:
         assert a.dim == b.dim
         return self._append("mul", (a.idx, b.idx), a.dim)
 
+    def lerp(self, a: TensorHandle, b: TensorHandle,
+             alpha: float) -> TensorHandle:
+        """``(1 - alpha) * a + alpha * b`` with a FIXED scalar — the
+        APPNP teleport combine (models/appnp.py).  Distinct from
+        :meth:`scale_add`, whose scalar is a learnable parameter."""
+        assert a.dim == b.dim
+        return self._append("lerp", (a.idx, b.idx), a.dim,
+                            attrs={"alpha": float(alpha)})
+
     def softmax_cross_entropy(self, t: TensorHandle) -> TensorHandle:
         """Marks ``t`` as the logits fed to the masked CE loss (labels and
         mask arrive as apply() arguments, unlike the reference which binds
@@ -654,6 +663,10 @@ class Model:
                            + eps * vals[op.inputs[1]])
             elif op.kind == "mul":
                 vals[i] = vals[op.inputs[0]] * vals[op.inputs[1]]
+            elif op.kind == "lerp":
+                al = op.attrs["alpha"]
+                vals[i] = ((1.0 - al) * vals[op.inputs[0]]
+                           + al * vals[op.inputs[1]])
             else:
                 raise ValueError(f"unknown op kind {op.kind}")
         out_idx = self._loss_op if self._loss_op is not None else -1

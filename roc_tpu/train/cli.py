@@ -47,15 +47,21 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("-verbose", "-v", action="store_true")
     # TPU-era flags
     ap.add_argument("--model",
-                    choices=["gcn", "sage", "gin", "gat", "sgc"],
+                    choices=["gcn", "sage", "gin", "gat", "sgc",
+                             "appnp"],
                     default="gcn")
     ap.add_argument("--heads", type=int, default=1,
                     help="attention heads for --model gat (hidden "
                          "dims must divide by it; output layer stays "
                          "single-head)")
     ap.add_argument("--hops", type=int, default=2,
-                    help="for --model sgc: propagation depth k "
-                         "(logits = softmax(S^k X W))")
+                    help="for --model sgc/appnp: propagation depth k "
+                         "(sgc: logits = softmax(S^k X W); appnp: k "
+                         "teleport-anchored hops after the MLP — "
+                         "appnp's classic setting is 10)")
+    ap.add_argument("--alpha", type=float, default=None,
+                    help="for --model appnp: teleport probability "
+                         "(Z <- (1-alpha) S Z + alpha H; default 0.1)")
     ap.add_argument("--learn-eps", action="store_true",
                     help="for --model gin: learnable per-layer "
                          "epsilon self-weight (zero-init GIN-0) "
@@ -162,6 +168,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: --learn-eps applies to --model gin only",
               file=sys.stderr)
         return 2
+    if args.alpha is not None and args.model != "appnp":
+        # None sentinel: ANY explicit --alpha on a non-appnp model is
+        # the misuse this guard exists for, the default value included
+        print("error: --alpha applies to --model appnp only",
+              file=sys.stderr)
+        return 2
+    if args.model == "appnp":
+        if args.alpha is None:
+            args.alpha = 0.1
+        if not 0.0 <= args.alpha <= 1.0:
+            print("error: --alpha must be in [0, 1]", file=sys.stderr)
+            return 2
     if args.model == "gat":
         if args.heads < 1:
             print("error: --heads must be >= 1", file=sys.stderr)
@@ -194,14 +212,17 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
           f"impl={args.impl}", file=sys.stderr)
 
+    from ..models.appnp import build_appnp
     from ..models.sgc import build_sgc
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
-             "gat": build_gat, "sgc": build_sgc}
+             "gat": build_gat, "sgc": build_sgc, "appnp": build_appnp}
     kwargs = {"heads": args.heads} if args.model == "gat" else {}
     if args.model == "gin" and args.learn_eps:
         kwargs["learn_eps"] = True
-    if args.model == "sgc":
+    if args.model in ("sgc", "appnp"):
         kwargs["k"] = args.hops
+    if args.model == "appnp":
+        kwargs["alpha"] = args.alpha
     model = build[args.model](layers, dropout_rate=args.dropout,
                               **kwargs)
     dt, cdt = resolve_dtypes(args.dtype)

@@ -131,3 +131,18 @@ def test_cli_sgc_model_trains():
     rc = _run(["--model", "sgc", "--hops", "2", "-layers", "12-4",
                "-e", "3", "-lr", "0.2"])
     assert rc == 0
+
+
+def test_cli_appnp_model_trains_and_validates():
+    """--model appnp end-to-end, and --alpha misuse fails fast (before
+    any dataset load): on a non-appnp model, and out of [0, 1]."""
+    rc = _run(["--model", "appnp", "--hops", "3", "--alpha", "0.2",
+               "-layers", "12-8-4", "-e", "3", "-lr", "0.05"])
+    assert rc == 0
+    assert _run(["--model", "gcn", "--alpha", "0.3",
+                 "-layers", "12-4", "-e", "1"]) == 2
+    # the default VALUE passed explicitly is still misuse (sentinel)
+    assert _run(["--model", "gcn", "--alpha", "0.1",
+                 "-layers", "12-4", "-e", "1"]) == 2
+    assert _run(["--model", "appnp", "--alpha", "1.5",
+                 "-layers", "12-4", "-e", "1"]) == 2
