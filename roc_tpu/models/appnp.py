@@ -34,6 +34,10 @@ def build_appnp(layers: Sequence[int], k: int = 10,
                 dropout_rate: float = 0.5) -> Model:
     if not 0.0 <= alpha <= 1.0:
         raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    if k < 1:
+        raise ValueError(
+            f"k must be >= 1 (k=0 is a bare MLP with no propagation "
+            f"— surely not what an APPNP user asked for), got {k}")
     model = Model(in_dim=layers[0])
     t = model.input()
     n = len(layers)

@@ -54,11 +54,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="attention heads for --model gat (hidden "
                          "dims must divide by it; output layer stays "
                          "single-head)")
-    ap.add_argument("--hops", type=int, default=2,
+    ap.add_argument("--hops", type=int, default=None,
                     help="for --model sgc/appnp: propagation depth k "
-                         "(sgc: logits = softmax(S^k X W); appnp: k "
-                         "teleport-anchored hops after the MLP — "
-                         "appnp's classic setting is 10)")
+                         "(sgc: logits = softmax(S^k X W), default 2; "
+                         "appnp: k teleport-anchored hops after the "
+                         "MLP, default 10 — the papers' classic "
+                         "settings)")
     ap.add_argument("--alpha", type=float, default=None,
                     help="for --model appnp: teleport probability "
                          "(Z <- (1-alpha) S Z + alpha H; default 0.1)")
@@ -174,6 +175,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: --alpha applies to --model appnp only",
               file=sys.stderr)
         return 2
+    if args.hops is not None and args.model not in ("sgc", "appnp"):
+        # same sentinel policy as --alpha/--heads/--learn-eps: a
+        # propagation depth on a fixed-depth model must fail, not be
+        # silently discarded
+        print("error: --hops applies to --model sgc/appnp only",
+              file=sys.stderr)
+        return 2
+    if args.model in ("sgc", "appnp"):
+        if args.hops is None:
+            args.hops = 2 if args.model == "sgc" else 10
+        if args.hops < 1:
+            print("error: --hops must be >= 1", file=sys.stderr)
+            return 2
     if args.model == "appnp":
         if args.alpha is None:
             args.alpha = 0.1

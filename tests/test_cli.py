@@ -144,5 +144,10 @@ def test_cli_appnp_model_trains_and_validates():
     # the default VALUE passed explicitly is still misuse (sentinel)
     assert _run(["--model", "gcn", "--alpha", "0.1",
                  "-layers", "12-4", "-e", "1"]) == 2
+    # --hops rides the same sentinel policy
+    assert _run(["--model", "gcn", "--hops", "2",
+                 "-layers", "12-4", "-e", "1"]) == 2
+    assert _run(["--model", "appnp", "--hops", "0",
+                 "-layers", "12-4", "-e", "1"]) == 2
     assert _run(["--model", "appnp", "--alpha", "1.5",
                  "-layers", "12-4", "-e", "1"]) == 2
