@@ -28,6 +28,10 @@ from ..ops.dense import AC_MODE_NONE
 
 def build_sgc(layers: Sequence[int], k: int = 2,
               dropout_rate: float = 0.0) -> Model:
+    if k < 1:
+        raise ValueError(
+            f"k must be >= 1 (k=0 is a propagation-free linear model "
+            f"— surely not what an SGC user asked for), got {k}")
     model = Model(in_dim=layers[0])
     t = model.input()
     for _ in range(k):

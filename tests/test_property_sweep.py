@@ -1,9 +1,10 @@
 """Cross-implementation equivalence sweep over randomized graphs.
 
-The aggregation impls (segment / blocked / scan / ell / sectioned)
-must agree on ANY graph — including the structures that historically
-broke layouts: zero-degree rows, hub rows (bucket width >> mean),
-single-node components, and empty-ish partitions.  The fixed fixtures
+The aggregation impls (segment / blocked / scan / ell / sectioned /
+bdense incl. grouped+u4-packed) must agree on ANY graph — including
+the structures that historically broke layouts: zero-degree rows,
+hub rows (bucket width >> mean), single-node components, and
+empty-ish partitions.  The fixed fixtures
 elsewhere pin one shape each; this sweep randomizes."""
 
 import jax
@@ -50,8 +51,19 @@ def test_aggregation_impls_agree_on_stress_graphs(seed):
         gctx = make_graph_context(ds, aggr_impl=impl, chunk=64)
         outs[impl] = np.asarray(
             model.apply(params, feats, gctx, train=False))
+    # block-dense variants: min_fill=1 forces tiles on any graph, the
+    # planted hub's duplicate edges exercise uint8/u4 multiplicity
+    # saturation and the packing fallback, group=4 the padded-run
+    # reduction
+    for label, kw in (("bdense", {}), ("bdense_g4",
+                                       {"bdense_group": 4})):
+        gctx = make_graph_context(ds, aggr_impl="bdense", chunk=64,
+                                  bdense_min_fill=1, **kw)
+        assert gctx.bd_a is not None, label
+        outs[label] = np.asarray(
+            model.apply(params, feats, gctx, train=False))
     ref = outs["segment"]
-    for impl in IMPLS[1:]:
+    for impl in list(IMPLS[1:]) + ["bdense", "bdense_g4"]:
         np.testing.assert_allclose(outs[impl], ref, rtol=2e-4,
                                    atol=2e-5, err_msg=impl)
 
