@@ -56,9 +56,17 @@ CONFIGS = {
     # doubled by autodiff).  The uniform flat8 layout exists for
     # exactly this config (HLO 4849 -> 511 lines, compile_probe.py);
     # with impl left at 'auto' the trainer now routes E=126M attention
-    # to 'attn_flat8'.  On-chip epoch time pending a tunnel window.
+    # to 'attn_flat8'.  2026-07-31: the flat8 numerator carry OOMed by
+    # 885M at this V/F (fixed by the dh-chunked numerator,
+    # resolve_dh_chunk); re-measure pending a tunnel window.
     "7": dict(model="gat", nodes=2_449_029, edges=126_000_000,
               layers=(100, 256, 47)),
+    # 8: APPNP at the arxiv shape (beyond reference) — k teleport-
+    # anchored propagation hops over the trainer's resolved layout;
+    # the hop loop is GCN's hot path with a fused lerp, so epoch time
+    # ~ k/2 x the 2-hop SAGE row above plus the (cheap) MLP
+    "8": dict(model="appnp", nodes=169_343, edges=4_600_000,
+              layers=(128, 256, 40)),
 }
 _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "model_zoo.jsonl")
@@ -73,6 +81,7 @@ def run(cfg_key: str, epochs: int, impl: str,
     from roc_tpu.core.graph import Dataset, random_csr
     from roc_tpu.models.gat import build_gat
     from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.models.appnp import build_appnp
     from roc_tpu.models.gin import build_gin
     from roc_tpu.models.sage import build_sage
     from roc_tpu.train.trainer import TrainConfig, Trainer
@@ -114,8 +123,10 @@ def run(cfg_key: str, epochs: int, impl: str,
     print(f"# data gen {time.time()-t0:.0f}s", file=sys.stderr)
 
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
-             "gat": build_gat}
+             "gat": build_gat, "appnp": build_appnp}
     kwargs = {"heads": heads} if c["model"] == "gat" else {}
+    if c["model"] == "appnp":
+        kwargs["k"] = 10  # the paper's classic depth (cli.py default)
     model = build[c["model"]](layers, dropout_rate=0.5, **kwargs)
     # GIN aggregates raw F-wide features (dropout output feeds
     # scatter_gather directly), which the ELL-family impls handle;
