@@ -359,6 +359,23 @@ def test_auto_impl_probes_structure(monkeypatch):
     assert impl_mp == "sectioned" and cen_mp is None
 
 
+def test_auto_probe_without_native_stays_sectioned(monkeypatch):
+    """No librocio -> the probe declines (None) and 'auto' keeps the
+    arithmetic resolution — never the minutes-long numpy census."""
+    import roc_tpu.native as native_mod
+    from roc_tpu.core import ell as ell_mod
+    from roc_tpu.ops import blockdense as bd
+    from roc_tpu.train.trainer import resolve_auto_impl_probed
+    monkeypatch.setattr(native_mod, "available", lambda: False)
+    monkeypatch.setattr(bd, "BDENSE_AUTO_MIN_EDGES", 10_000)
+    monkeypatch.setattr(ell_mod, "sectioned_bounds",
+                        lambda device_kind=None: (1_000, 10**9))
+    comm = planted_community_csr(2048, 60_000, community_rows=512,
+                                 intra_frac=0.9, shuffle=False, seed=1)
+    impl, census = resolve_auto_impl_probed(comm, bdense_min_fill=64)
+    assert impl == "sectioned" and census is None
+
+
 def test_group_padding_respects_a_budget():
     """With group>1 the budget caps the PADDED table: the selection
     must account for alignment blocks up front, never exceed the byte
