@@ -1,0 +1,73 @@
+"""GCNII model family: deep GCN via initial residual + identity
+mapping (Chen et al., ICML'20).
+
+Per layer l (1-indexed), with ``S = D^-1/2 A D^-1/2`` (self edges
+pre-added — the reference's GCN normalization, ``gnn.cc:78-91``)::
+
+    P_l = S H_{l-1}                       # propagation
+    M_l = (1 - alpha) P_l + alpha H_0     # initial residual
+    H_l = relu((1 - beta_l) M_l + beta_l M_l W_l)   # identity map
+
+with ``beta_l = log(lam / l + 1)`` decaying over depth.  The two
+mechanisms are what lets GCNII stack 16-64 layers without
+oversmoothing, where the reference's plain stack degrades past ~4
+(its deep-stack answer is the dense residual, ``gnn.cc:86-90``).
+The reference has no such model; GCNII completes the zoo's deep end.
+
+Both combines are the builder's fixed-scalar ``lerp`` op, so a layer
+is GCN's hot aggregation path plus one extra [V, H] matmul — XLA
+fuses the lerps into their producers.
+
+``layers`` follows the CLI convention ``F-H-...-H-C``: layers[0] is
+the input feature dim, layers[-1] the class count, and each
+intermediate entry one GCNII layer (all must share one width H — the
+initial residual adds H_0 into every layer).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+from .builder import Model
+from ..ops.dense import AC_MODE_NONE
+
+
+def build_gcn2(layers: Sequence[int], alpha: float = 0.1,
+               lam: float = 0.5,
+               dropout_rate: float = 0.5) -> Model:
+    if len(layers) < 3:
+        raise ValueError(
+            "GCNII needs at least one hidden layer (F-H-C); for a "
+            "propagation-free linear model use --model sgc")
+    hidden = layers[1]
+    if any(h != hidden for h in layers[1:-1]):
+        raise ValueError(
+            f"GCNII hidden widths must all match (the initial "
+            f"residual adds H_0 into every layer), got {layers[1:-1]}")
+    if not 0.0 <= alpha <= 1.0:
+        raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+    if lam <= 0.0:
+        raise ValueError(f"lam must be > 0, got {lam}")
+    model = Model(in_dim=layers[0])
+    t = model.input()
+    # input projection -> H_0
+    t = model.dropout(t, dropout_rate)
+    t = model.linear(t, hidden, AC_MODE_NONE)
+    t = model.relu(t)
+    h0 = t
+    n_layers = len(layers) - 2
+    for l in range(1, n_layers + 1):
+        beta = math.log(lam / l + 1.0)
+        t = model.dropout(t, dropout_rate)
+        t = model.indegree_norm(t)
+        t = model.scatter_gather(t)
+        t = model.indegree_norm(t)
+        t = model.lerp(t, h0, alpha)          # initial residual
+        w = model.linear(t, hidden, AC_MODE_NONE)
+        t = model.lerp(t, w, beta)            # identity mapping
+        t = model.relu(t)
+    t = model.dropout(t, dropout_rate)
+    t = model.linear(t, layers[-1], AC_MODE_NONE)
+    model.softmax_cross_entropy(t)
+    return model

@@ -48,7 +48,7 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     # TPU-era flags
     ap.add_argument("--model",
                     choices=["gcn", "sage", "gin", "gat", "sgc",
-                             "appnp"],
+                             "appnp", "gcn2"],
                     default="gcn")
     ap.add_argument("--heads", type=int, default=1,
                     help="attention heads for --model gat (hidden "
@@ -61,8 +61,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "MLP, default 10 — the papers' classic "
                          "settings)")
     ap.add_argument("--alpha", type=float, default=None,
-                    help="for --model appnp: teleport probability "
-                         "(Z <- (1-alpha) S Z + alpha H; default 0.1)")
+                    help="for --model appnp/gcn2: teleport / initial-"
+                         "residual strength (default 0.1)")
+    ap.add_argument("--lam", type=float, default=None,
+                    help="for --model gcn2: identity-mapping decay "
+                         "(beta_l = log(lam/l + 1); default 0.5)")
     ap.add_argument("--learn-eps", action="store_true",
                     help="for --model gin: learnable per-layer "
                          "epsilon self-weight (zero-init GIN-0) "
@@ -169,10 +172,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: --learn-eps applies to --model gin only",
               file=sys.stderr)
         return 2
-    if args.alpha is not None and args.model != "appnp":
-        # None sentinel: ANY explicit --alpha on a non-appnp model is
-        # the misuse this guard exists for, the default value included
-        print("error: --alpha applies to --model appnp only",
+    if args.alpha is not None and args.model not in ("appnp", "gcn2"):
+        # None sentinel: ANY explicit --alpha on a model without the
+        # knob is the misuse this guard exists for, the default value
+        # included
+        print("error: --alpha applies to --model appnp/gcn2 only",
+              file=sys.stderr)
+        return 2
+    if args.lam is not None and args.model != "gcn2":
+        print("error: --lam applies to --model gcn2 only",
               file=sys.stderr)
         return 2
     if args.hops is not None and args.model not in ("sgc", "appnp"):
@@ -188,11 +196,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.hops < 1:
             print("error: --hops must be >= 1", file=sys.stderr)
             return 2
-    if args.model == "appnp":
+    if args.model in ("appnp", "gcn2"):
         if args.alpha is None:
             args.alpha = 0.1
         if not 0.0 <= args.alpha <= 1.0:
             print("error: --alpha must be in [0, 1]", file=sys.stderr)
+            return 2
+    if args.model == "gcn2":
+        if args.lam is None:
+            args.lam = 0.5
+        if args.lam <= 0.0:
+            print("error: --lam must be > 0", file=sys.stderr)
+            return 2
+        # structural -layers checks up front (same policy as gat's
+        # heads divisibility: fail BEFORE the dataset load, with the
+        # clean exit-2 contract, not a build_gcn2 traceback after it)
+        if len(layers) < 3:
+            print("error: gcn2 needs at least one hidden layer "
+                  "(F-H-C)", file=sys.stderr)
+            return 2
+        if any(h != layers[1] for h in layers[1:-1]):
+            print(f"error: gcn2 hidden widths must all match (the "
+                  f"initial residual adds H_0 into every layer), got "
+                  f"{layers[1:-1]}", file=sys.stderr)
             return 2
     if args.model == "gat":
         if args.heads < 1:
@@ -227,16 +253,20 @@ def main(argv: Optional[List[str]] = None) -> int:
           f"impl={args.impl}", file=sys.stderr)
 
     from ..models.appnp import build_appnp
+    from ..models.gcn2 import build_gcn2
     from ..models.sgc import build_sgc
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
-             "gat": build_gat, "sgc": build_sgc, "appnp": build_appnp}
+             "gat": build_gat, "sgc": build_sgc, "appnp": build_appnp,
+             "gcn2": build_gcn2}
     kwargs = {"heads": args.heads} if args.model == "gat" else {}
     if args.model == "gin" and args.learn_eps:
         kwargs["learn_eps"] = True
     if args.model in ("sgc", "appnp"):
         kwargs["k"] = args.hops
-    if args.model == "appnp":
+    if args.model in ("appnp", "gcn2"):
         kwargs["alpha"] = args.alpha
+    if args.model == "gcn2":
+        kwargs["lam"] = args.lam
     model = build[args.model](layers, dropout_rate=args.dropout,
                               **kwargs)
     dt, cdt = resolve_dtypes(args.dtype)

@@ -151,3 +151,19 @@ def test_cli_appnp_model_trains_and_validates():
                  "-layers", "12-4", "-e", "1"]) == 2
     assert _run(["--model", "appnp", "--alpha", "1.5",
                  "-layers", "12-4", "-e", "1"]) == 2
+
+
+def test_cli_gcn2_model_trains_and_validates():
+    """--model gcn2 end-to-end (deep stack), and --lam / hidden-width
+    / depth misuse fails fast (exit 2, before any dataset load)."""
+    rc = _run(["--model", "gcn2", "-layers", "12-16-16-16-4",
+               "-e", "3", "-lr", "0.05"])
+    assert rc == 0
+    assert _run(["--model", "gcn", "--lam", "0.5",
+                 "-layers", "12-4", "-e", "1"]) == 2
+    assert _run(["--model", "gcn2", "--lam", "0",
+                 "-layers", "12-16-4", "-e", "1"]) == 2
+    # structural -layers misuse: mismatched widths / no hidden layer
+    assert _run(["--model", "gcn2", "-layers", "12-16-24-4",
+                 "-e", "1"]) == 2
+    assert _run(["--model", "gcn2", "-layers", "12-4", "-e", "1"]) == 2
