@@ -67,6 +67,13 @@ CONFIGS = {
     # ~ k/2 x the 2-hop SAGE row above plus the (cheap) MLP
     "8": dict(model="appnp", nodes=169_343, edges=4_600_000,
               layers=(128, 256, 40)),
+    # 9: GCNII at the arxiv shape, 8 propagation layers (beyond
+    # reference) — the deep-stack family; per layer one aggregation +
+    # one [V, 256] matmul, so ~4x the 2-hop SAGE row's aggregation
+    # count
+    "9": dict(model="gcn2",
+              nodes=169_343, edges=4_600_000,
+              layers=(128,) + (256,) * 8 + (40,)),
 }
 _OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                     "model_zoo.jsonl")
@@ -82,6 +89,7 @@ def run(cfg_key: str, epochs: int, impl: str,
     from roc_tpu.models.gat import build_gat
     from roc_tpu.models.gcn import build_gcn
     from roc_tpu.models.appnp import build_appnp
+    from roc_tpu.models.gcn2 import build_gcn2
     from roc_tpu.models.gin import build_gin
     from roc_tpu.models.sage import build_sage
     from roc_tpu.train.trainer import TrainConfig, Trainer
@@ -123,7 +131,8 @@ def run(cfg_key: str, epochs: int, impl: str,
     print(f"# data gen {time.time()-t0:.0f}s", file=sys.stderr)
 
     build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
-             "gat": build_gat, "appnp": build_appnp}
+             "gat": build_gat, "appnp": build_appnp,
+             "gcn2": build_gcn2}
     kwargs = {"heads": heads} if c["model"] == "gat" else {}
     if c["model"] == "appnp":
         kwargs["k"] = 10  # the paper's classic depth (cli.py default)
@@ -182,7 +191,8 @@ def run(cfg_key: str, epochs: int, impl: str,
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--config", default="3", choices=list(CONFIGS))
+    ap.add_argument("--config", default="3",
+                    choices=list(CONFIGS))
     ap.add_argument("--epochs", type=int, default=5)
     ap.add_argument("--impl", default="auto")
     ap.add_argument("--dtype", default="float32",
