@@ -239,3 +239,31 @@ def test_karate_real_data_cli_convergence_gate(tmp_path, capsys):
     accs = re.findall(r"test_accuracy:\s*([0-9.]+)%", lines[-1])
     assert accs, lines[-1]
     assert float(accs[0]) >= 80.0, lines[-1]
+
+
+def test_karate_real_data_new_families_converge(tmp_path, capsys):
+    """The beyond-reference families recover the real club fission
+    too: REAL data through APPNP (teleport propagation from 2 labeled
+    leaders is exactly personalized PageRank's home turf) and GCNII
+    (deep stack on a 34-node graph — the oversmoothing stress case)."""
+    out = os.path.join(tmp_path, "d", "karate")
+    r = subprocess.run(
+        [sys.executable, os.path.join(_SCRIPTS, "convert_dataset.py"),
+         "--dataset", "karate", "--out", out],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr
+    from roc_tpu.train import cli
+    import re
+    for extra in (["--model", "appnp", "--hops", "10",
+                   "--alpha", "0.1", "-layers", "34-16-2"],
+                  ["--model", "gcn2",
+                   "-layers", "34-16-16-16-16-2"]):
+        rc = cli.main(["--cpu", "--no-compile-cache", "-file", out,
+                       "-lr", "0.02", "-decay", "5e-4", "-dropout",
+                       "0.0", "-e", "150", "--eval-every", "150",
+                       "--impl", "ell"] + extra)
+        assert rc == 0, extra
+        lines = [ln for ln in capsys.readouterr().out.splitlines()
+                 if ln.startswith("[INFER]")]
+        accs = re.findall(r"test_accuracy:\s*([0-9.]+)%", lines[-1])
+        assert accs and float(accs[0]) >= 80.0, (extra, lines[-1])
