@@ -45,6 +45,14 @@ def main():
     ap.add_argument("--a-budget", type=int, default=2 << 30,
                     help="uint8 A-table byte cap (0 = uncapped, same "
                          "convention as micro_agg.py --a-budget)")
+    ap.add_argument("--group", type=int, default=1,
+                    help="pad_plan_groups alignment (the grouped "
+                         "output-tile reduction); occupancy then "
+                         "reports pad_blocks and the padded a_bytes")
+    ap.add_argument("--pack", action="store_true",
+                    help="apply the trainer's plan_blocks_packed "
+                         "policy (u4 packing + 2x-budget planning) "
+                         "instead of the raw uint8 plan")
     ap.add_argument("--tag", default=None,
                     help="JSON key (default: derived from the spec)")
     args = ap.parse_args()
@@ -59,11 +67,14 @@ def main():
     if reorder_s:
         print(f"# {args.reorder} reorder: {reorder_s:.1f}s")
 
-    from roc_tpu.ops.blockdense import plan_blocks
+    from roc_tpu.ops.blockdense import (BLOCK, plan_blocks,
+                                        plan_blocks_packed)
     t0 = time.time()
-    plan = plan_blocks(g.row_ptr, g.col_idx, g.num_nodes,
-                       min_fill=args.min_fill,
-                       a_budget_bytes=args.a_budget or None)
+    planner = plan_blocks_packed if args.pack else plan_blocks
+    plan = planner(g.row_ptr, g.col_idx, g.num_nodes,
+                   min_fill=args.min_fill,
+                   a_budget_bytes=args.a_budget or None,
+                   group=args.group)
     plan_s = time.time() - t0
 
     row = dict(plan.occupancy(), V=g.num_nodes, E=g.num_edges,
@@ -72,6 +83,10 @@ def main():
                graph=args.graph,
                reorder=args.reorder,
                reorder_s=round(reorder_s, 1))
+    if args.group > 1:
+        row["group"] = args.group
+    if args.pack:
+        row["a_u4"] = bool(plan.a_blocks.shape[-1] == BLOCK // 2)
     # non-default plan knobs join the derived key: rows measured under
     # different min_fill/a_budget must never overwrite each other
     tag = args.tag or (args.graph.replace(":", "")
@@ -81,7 +96,13 @@ def main():
                           else f"_f{args.min_fill}")
                        + ("" if args.a_budget == 2 << 30
                           else "_bunc" if not args.a_budget
-                          else f"_b{args.a_budget >> 30}g"))
+                          else f"_b{args.a_budget >> 30}g")
+                       + ("" if args.group == 1 else f"_g{args.group}")
+                       # suffix by the packing OUTCOME, not the knob:
+                       # an unpackable graph records as '_pack' (with
+                       # a_u4: false), never as a phantom u4 row
+                       + ("" if not args.pack
+                          else "_u4" if row["a_u4"] else "_pack"))
     print(tag, json.dumps(row, sort_keys=True))
 
     data = {}
