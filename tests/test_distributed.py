@@ -77,6 +77,32 @@ def test_distributed_matches_single_device(dataset, num_parts):
                                rtol=1e-3)
 
 
+def test_distributed_lerp_families_match_single(dataset):
+    """APPNP and GCNII (the fixed-scalar lerp families) reproduce
+    their single-device trajectories under the 4-part sharded step —
+    lerp composes with the halo/psum machinery like any elementwise
+    op, but nothing else exercises it multi-part with real training."""
+    from roc_tpu.models.appnp import build_appnp
+    from roc_tpu.models.gcn2 import build_gcn2
+    builds = (
+        lambda: build_appnp([dataset.in_dim, 16, dataset.num_classes],
+                            k=3, alpha=0.2, dropout_rate=0.0),
+        lambda: build_gcn2([dataset.in_dim, 16, 16,
+                            dataset.num_classes], dropout_rate=0.0),
+    )
+    for build in builds:
+        model = build()
+        cfg = _no_dropout_cfg()
+        single = Trainer(model, dataset, cfg)
+        dist = DistributedTrainer(model, dataset, 4, cfg)
+        single.train()
+        dist.train()
+        for k in single.params:
+            np.testing.assert_allclose(np.asarray(single.params[k]),
+                                       np.asarray(dist.params[k]),
+                                       rtol=2e-4, atol=2e-5)
+
+
 def test_distributed_blocked_impl(dataset):
     """blocked aggregation under shard_map matches segment."""
     model = build_gcn([dataset.in_dim, 16, dataset.num_classes],
