@@ -101,6 +101,16 @@ def test_distributed_lerp_families_match_single(dataset):
             np.testing.assert_allclose(np.asarray(single.params[k]),
                                        np.asarray(dist.params[k]),
                                        rtol=2e-4, atol=2e-5)
+    # the O(V/P)-memory ring halo composes with lerp too (additive
+    # aggregation only — attention rejects it, these must not)
+    ring = DistributedTrainer(builds[0](), dataset, 4,
+                              _no_dropout_cfg(halo="ring"))
+    ring.train()
+    base = Trainer(builds[0](), dataset, _no_dropout_cfg())
+    base.train()
+    np.testing.assert_allclose(ring.evaluate()["train_loss"],
+                               base.evaluate()["train_loss"],
+                               rtol=1e-3)
 
 
 def test_distributed_blocked_impl(dataset):
