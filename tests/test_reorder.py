@@ -42,6 +42,24 @@ def test_reorder_preserves_graph_structure():
         assert np.all(np.diff(row) >= 0)
 
 
+def test_reorder_preserves_edge_multiplicity():
+    """Duplicate edges (multigraph multiplicities — the planted
+    generators emit them) survive relabeling exactly; the edge-set
+    isomorphism test above collapses them, this one counts."""
+    from roc_tpu.core.graph import Graph
+    from roc_tpu.core.reorder import apply_graph_order
+    row_ptr = np.array([0, 3, 5, 6], dtype=np.int64)
+    col_idx = np.array([1, 1, 2, 0, 0, 0], dtype=np.int32)
+    g = Graph(row_ptr=row_ptr, col_idx=col_idx)
+    perm = np.array([2, 0, 1], dtype=np.int64)  # new_id -> old_id
+    out = apply_graph_order(g, perm)
+    # old row 2 -> new row 0: [0] -> rank[0] = 1
+    # old row 0 -> new row 1: [1,1,2] -> [rank1, rank1, rank2] = [2,2,0] sorted [0,2,2]
+    # old row 1 -> new row 2: [0,0] -> [1,1]
+    np.testing.assert_array_equal(out.row_ptr, [0, 1, 4, 6])
+    np.testing.assert_array_equal(out.col_idx, [1, 0, 2, 2, 1, 1])
+
+
 def test_training_metrics_invariant_under_reorder():
     """Same seed, dropout off: train/val/test metrics agree between
     the original and reordered datasets (the objective is a sum over
