@@ -19,6 +19,9 @@ from .models.gcn import build_gcn
 from .models.sage import build_sage
 from .models.gin import build_gin
 from .models.gat import build_gat
+from .models.sgc import build_sgc
+from .models.appnp import build_appnp
+from .models.gcn2 import build_gcn2
 from .train.optimizer import (AdamConfig, AdamState, adam_init,
                               adam_update, decayed_lr)
 from .utils.checkpoint import (checkpoint_trainer, load_checkpoint,
