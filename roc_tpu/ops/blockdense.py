@@ -52,6 +52,11 @@ _CHUNK_BLOCKS = 256  # blocks per scan step: bounds the [C,128,F] transient
 BDENSE_AUTO_MIN_EDGES = 5_000_000
 BDENSE_AUTO_MIN_FRAC = 0.15
 
+# largest edge multiplicity a u4-packed A-table can hold — the ONE
+# place the 4-bit limit lives (pack_a_u4 and both stacked builders'
+# packability decisions consume it)
+U4_MAX = 15
+
 
 @dataclass
 class BlockPlan:
@@ -425,12 +430,15 @@ def pack_a_u4(plan: BlockPlan) -> Optional[BlockPlan]:
 
     The kernel detects packing from the trailing axis
     (``BLOCK // 2``) and unpacks in-register per chunk.  Applied on
-    the single-device path (make_graph_context / micro_agg); the
-    stacked distributed/multihost builders keep uint8 — their
-    SPMD-uniform shapes would need a cross-part/host agreement on
-    packability that isn't worth the collective yet."""
-    if plan.n_blocks == 0 or plan.a_blocks.max() > 15:
+    the single-device path (make_graph_context / micro_agg) and by
+    the stacked distributed/multihost builders — all parts pack or
+    none (one uniform SPMD trailing width; multihost agrees the
+    global max multiplicity via one extra O(P) collective)."""
+    if plan.n_blocks and plan.a_blocks.max() > U4_MAX:
         return None
+    # an EMPTY plan packs too (to [0, 128, 64]): the stacked
+    # distributed builders need one uniform trailing width across
+    # parts, and a zero-block part must not force uint8 on the rest
     a = plan.a_blocks
     packed = (a[..., 0::2] | (a[..., 1::2] << 4)).astype(np.uint8)
     return replace(plan, a_blocks=packed)

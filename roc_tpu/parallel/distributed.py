@@ -254,28 +254,51 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
             # device runs the same program (SPMD uniformity, exactly
             # the sectioned tables' padding-chunk scheme).
             from ..core.ell import clean_part_ptr
-            from ..ops.blockdense import BLOCK, plan_blocks
+            from ..ops.blockdense import (BLOCK, U4_MAX, pack_a_u4,
+                                          plan_blocks)
             src_rows = pg.num_parts * pg.part_nodes
-            plans = []
-            for p in range(pg.num_parts):
-                ptr = clean_part_ptr(pg.part_row_ptr[p],
-                                     pg.real_nodes[p], pg.part_nodes)
-                cols = col_padded[p][:int(ptr[-1])]
-                # group>1 plans arrive per-part group-aligned, so the
-                # stacked tail padding below extends in WHOLE
-                # dummy-dst groups (nb and nblk_max both multiples)
-                plans.append(plan_blocks(
-                    ptr, cols, pg.part_nodes,
-                    min_fill=bdense_min_fill,
-                    a_budget_bytes=bdense_a_budget,
-                    num_cols=src_rows, group=bdense_group))
+            ptrs = [clean_part_ptr(pg.part_row_ptr[p],
+                                   pg.real_nodes[p], pg.part_nodes)
+                    for p in range(pg.num_parts)]
+
+            def _mk(budget):
+                # group>1 plans arrive per-part group-aligned, so
+                # the stacked tail padding below extends in WHOLE
+                # dummy-dst groups (nb and nblk_max multiples)
+                return [plan_blocks(
+                    ptrs[p], col_padded[p][:int(ptrs[p][-1])],
+                    pg.part_nodes, min_fill=bdense_min_fill,
+                    a_budget_bytes=budget,
+                    num_cols=src_rows, group=bdense_group)
+                    for p in range(pg.num_parts)]
+
+            # same 2x-budget-then-pack policy as plan_blocks_packed,
+            # decided ACROSS parts: the stacked table needs one
+            # uniform trailing width, so pack all parts or none
+            # (pack_a_u4 packs empty parts too).  The unpackable AND
+            # over-budget case re-runs the census — accepted: it
+            # needs multi-edge hubs past 4 bits plus a saturated
+            # budget, and the native census is seconds even at
+            # Reddit scale
+            plans = _mk(bdense_a_budget * 2
+                        if bdense_a_budget is not None else None)
+            packable = all(pl.n_blocks == 0
+                           or int(pl.a_blocks.max()) <= U4_MAX
+                           for pl in plans)
+            if packable:
+                plans = [pack_a_u4(pl) for pl in plans]
+            elif bdense_a_budget is not None and any(
+                    pl.a_blocks.nbytes > bdense_a_budget
+                    for pl in plans):
+                plans = _mk(bdense_a_budget)
             bd_occupancy = tuple(pl.occupancy() for pl in plans)
             nblk_max = max(pl.n_blocks for pl in plans)
             if nblk_max:
                 bd_vpad = plans[0].vpad
                 bd_src_vpad = plans[0].src_vpad
                 n_dst_tiles = bd_vpad // BLOCK
-                a = np.zeros((pg.num_parts, nblk_max, BLOCK, BLOCK),
+                a_w = BLOCK // 2 if packable else BLOCK
+                a = np.zeros((pg.num_parts, nblk_max, BLOCK, a_w),
                              dtype=np.uint8)
                 sblk = np.zeros((pg.num_parts, nblk_max),
                                 dtype=np.int32)

@@ -374,27 +374,55 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
         # O(P) collectives the sectioned/ring branches use; no
         # whole-graph pass.
         from ..core.ell import clean_part_ptr
-        from ..ops.blockdense import BLOCK, plan_blocks
+        from ..ops.blockdense import (BLOCK, U4_MAX, pack_a_u4,
+                                      plan_blocks)
         src_rows = P * pn
         ptrs = {p: clean_part_ptr(pg.part_row_ptr[p], pg.real_nodes[p],
                                   pn) for p in local}
-        # group>1 plans arrive per-part group-aligned BEFORE the
-        # nblk_max collective: every host's count is a group multiple,
-        # so the uniform stacked tail below pads in whole dummy-dst
-        # groups
-        plans = {p: plan_blocks(
-            ptrs[p], cols[p][:int(ptrs[p][-1])], pn,
-            min_fill=bdense_min_fill, a_budget_bytes=bdense_a_budget,
-            num_cols=src_rows, group=bdense_group) for p in local}
-        bd_occupancy = tuple(plans[p].occupancy() for p in local)
-        # uniform per-part block count: global max via the O(P)
-        # stats collective (the sum slot is unused here)
+
+        def _mk(budget):
+            # group>1 plans arrive per-part group-aligned BEFORE the
+            # nblk_max collective: every host's count is a group
+            # multiple, so the uniform stacked tail below pads in
+            # whole dummy-dst groups
+            return {p: plan_blocks(
+                ptrs[p], cols[p][:int(ptrs[p][-1])], pn,
+                min_fill=bdense_min_fill, a_budget_bytes=budget,
+                num_cols=src_rows, group=bdense_group) for p in local}
+
+        # the 2x-budget-then-pack policy (plan_blocks_packed), decided
+        # GLOBALLY: one more O(P) collective agrees the max slot
+        # multiplicity, so every host packs (or not) identically and
+        # the SPMD table keeps one trailing width.  Branches below
+        # depend only on globally-reduced values — every host runs
+        # the SAME collective sequence.
+        plans = _mk(bdense_a_budget * 2
+                    if bdense_a_budget is not None else None)
         nblk_max, _ = _allreduce_part_stats(
             mesh, local, {p: (plans[p].n_blocks, 0) for p in local})
+        max_mult, _ = _allreduce_part_stats(
+            mesh, local,
+            {p: (int(plans[p].a_blocks.max())
+                 if plans[p].n_blocks else 0, 0) for p in local})
+        packable = max_mult <= U4_MAX
+        if packable:
+            # pack_a_u4 packs EMPTY parts too — a zero-block part on
+            # one host must still stack at the uniform u4 width
+            plans = {p: pack_a_u4(plans[p]) for p in local}
+        elif bdense_a_budget is not None and \
+                nblk_max * BLOCK * BLOCK > bdense_a_budget:
+            # some part over the true cap and packing can't save it:
+            # re-plan at 1x and re-agree the uniform block count
+            plans = _mk(bdense_a_budget)
+            nblk_max, _ = _allreduce_part_stats(
+                mesh, local,
+                {p: (plans[p].n_blocks, 0) for p in local})
+        bd_occupancy = tuple(plans[p].occupancy() for p in local)
         if nblk_max:
             bd_vpad = plans[local[0]].vpad
             bd_src_vpad = plans[local[0]].src_vpad
             n_dst_tiles = bd_vpad // BLOCK
+            a_w = BLOCK // 2 if packable else BLOCK
 
             def bd_field(get, fill, np_dtype, extra=()):
                 def build(p):
@@ -408,8 +436,8 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
             # tile — numerically inert, same scheme as shard_dataset
             bd_tabs = (
                 put_parts(bd_field(lambda pl: pl.a_blocks, 0, np.uint8,
-                                   (BLOCK, BLOCK)),
-                          (nblk_max, BLOCK, BLOCK), np.uint8),
+                                   (BLOCK, a_w)),
+                          (nblk_max, BLOCK, a_w), np.uint8),
                 put_parts(bd_field(lambda pl: pl.src_blk, 0, np.int32),
                           (nblk_max,), np.int32),
                 put_parts(bd_field(lambda pl: pl.dst_blk, n_dst_tiles,

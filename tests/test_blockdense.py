@@ -558,6 +558,101 @@ def test_bdense_distributed_matches_single_device(group):
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_bdense_distributed_packs_with_zero_block_parts():
+    """A packable graph where some partitions plan ZERO dense tiles
+    must still stack the u4 table (a zero-block part's empty A packs
+    to the uniform trailing width instead of forcing uint8 or
+    crashing the stack) and train exactly."""
+    from roc_tpu.core.graph import Dataset, from_edge_list
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    rng = np.random.RandomState(5)
+    V = 256
+    # one CONCENTRATED community (rows 0-63: ~1500 edges in a single
+    # tile, far past min_fill) + SCATTERED edges over the rest (fill
+    # per tile ~50, under min_fill): the edge-balanced partitioner
+    # gives every part similar edge counts, but only the parts
+    # holding community rows plan dense tiles
+    dense_s = rng.randint(0, 64, 4000)
+    dense_d = rng.randint(0, 64, 4000)
+    scat_s = rng.randint(0, V, 300)
+    scat_d = rng.randint(64, V, 300)
+    src = np.concatenate([dense_s, scat_s, np.arange(V)])
+    dst = np.concatenate([dense_d, scat_d, np.arange(V)])
+    g = from_edge_list(src, dst, V)
+    ds = Dataset(graph=g,
+                 features=rng.rand(V, 8).astype(np.float32),
+                 labels=rng.randint(0, 3, V).astype(np.int32),
+                 mask=np.ones(V, np.int32), num_classes=3)
+    kw = dict(verbose=False, eval_every=1 << 30, dropout_rate=0.0,
+              symmetric=False, epochs=2, learning_rate=0.05,
+              chunk=64)   # partition geometry the fixture's split
+    td = DistributedTrainer(build_gcn([8, 8, 3], dropout_rate=0.0),
+                            ds, 4,
+                            TrainConfig(aggr_impl="bdense",
+                                        bdense_min_fill=300, **kw))
+    occ = td.data.bd_occupancy
+    assert any(o["n_blocks"] == 0 for o in occ), \
+        "fixture must leave some partition without dense tiles"
+    assert any(o["n_blocks"] > 0 for o in occ)
+    assert td.data.bd_tabs[0].shape[-1] == 64  # u4 despite empties
+    ts = DistributedTrainer(build_gcn([8, 8, 3], dropout_rate=0.0),
+                            ds, 4, TrainConfig(aggr_impl="segment",
+                                               **kw))
+    td.train()
+    ts.train()
+    for k in ts.params:
+        np.testing.assert_allclose(np.asarray(td.params[k]),
+                                   np.asarray(ts.params[k]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_bdense_distributed_unpackable_stays_uint8_and_exact():
+    """A >15-multiplicity graph must stack uint8 tables (no silent
+    saturation) and still train to the segment reference."""
+    from roc_tpu.core.graph import Dataset, Graph
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    rng = np.random.RandomState(3)
+    V = 64
+    # a hub destination with 40 copies of one source edge (mult > 15)
+    src = np.concatenate([np.full(40, 7), rng.randint(0, V, 400),
+                          np.arange(V)]).astype(np.int64)
+    dst = np.concatenate([np.full(40, 3), rng.randint(0, V, 400),
+                          np.arange(V)]).astype(np.int64)
+    from roc_tpu.core.graph import from_edge_list
+    g = from_edge_list(src, dst, V)
+    ds = Dataset(graph=g,
+                 features=rng.rand(V, 8).astype(np.float32),
+                 labels=rng.randint(0, 3, V).astype(np.int32),
+                 mask=np.ones(V, np.int32), num_classes=3)
+    cfg = TrainConfig(aggr_impl="bdense", bdense_min_fill=1,
+                      verbose=False, eval_every=1 << 30,
+                      dropout_rate=0.0, symmetric=False, epochs=2,
+                      learning_rate=0.05)
+    td = DistributedTrainer(build_gcn([8, 8, 3], dropout_rate=0.0),
+                            ds, 4, cfg)
+    assert td.data.bd_tabs[0].shape[-1] == 128  # uint8, not packed
+    ts = DistributedTrainer(build_gcn([8, 8, 3], dropout_rate=0.0),
+                            ds, 4,
+                            TrainConfig(aggr_impl="segment",
+                                        verbose=False,
+                                        eval_every=1 << 30,
+                                        dropout_rate=0.0,
+                                        symmetric=False, epochs=2,
+                                        learning_rate=0.05))
+    td.train()
+    ts.train()
+    for k in ts.params:
+        np.testing.assert_allclose(np.asarray(td.params[k]),
+                                   np.asarray(ts.params[k]),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_bdense_distributed_group_mismatch_fails_fast():
     """Injected data built with one bdense_group must be rejected by a
     config wanting another — a silent mismatch would reduce across
@@ -638,6 +733,10 @@ def test_bdense_multihost_local_build_matches_global_and_trains(group):
     assert len(loc.bd_tabs) == 3 == len(glo.bd_tabs), \
         "fixture must yield dense tiles in both builders"
     assert loc.bd_group == group == glo.bd_group
+    # the packable fixture stacks u4 tables in BOTH builders (the
+    # multihost packing decision rides the max-multiplicity
+    # collective; a width mismatch here means the agreement broke)
+    assert loc.bd_tabs[0].shape[-1] == 64 == glo.bd_tabs[0].shape[-1]
     for a, b in zip(loc.bd_tabs, glo.bd_tabs):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert (loc.bd_vpad, loc.bd_src_vpad) == (glo.bd_vpad,
