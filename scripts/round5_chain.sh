@@ -1,0 +1,27 @@
+#!/bin/sh
+# Round-5 measurement queue — the on-chip runs staged behind the
+# 2026-07-31 relay outage (BASELINE.md "Round-5 additions").  Run
+# whole or per-step on a live chip; each step records its own
+# artifacts (benchmarks/*.jsonl / measured_baselines.json).
+cd "$(dirname "$0")/.."
+set -x
+# 1. staged headline refresh (promotion material for BENCH)
+python bench.py
+# 2. grouped + u4 micro race, full Reddit V/E, community substrate
+python benchmarks/micro_agg.py --graph planted:16384 --reorder lpa \
+  --dtype mixed \
+  --impls sectioned,bdense:32,bdense:32:8,bdense:32:16,bdense:32:32 \
+  --a-budget $((6<<30)) --iters 10
+# 3. products-scale GAT via the dh-chunked flat8 layout
+python benchmarks/model_zoo.py --config 7 --dtype mixed --remat --epochs 5
+# 4. APPNP / GCNII at arxiv shape
+python benchmarks/model_zoo.py --config 8 --dtype mixed --epochs 5
+python benchmarks/model_zoo.py --config 9 --dtype mixed --epochs 5
+# 5. full-epoch community race (bdense first; sectioned is the known
+#    cold-compile risk and runs second)
+python benchmarks/epoch_community.py --min-fill 32 --a-budget $((6<<30)) \
+  --bdense-group 16 --impls bdense,sectioned
+# 6. bdense convergence gate at scale (auto-probe pipeline)
+python benchmarks/convergence_scale.py --order label
+# 7. widened-GIN re-measure (config-5 boundary; budget the compile)
+timeout 5400 python benchmarks/model_zoo.py --config 5 --dtype mixed --epochs 5
