@@ -97,11 +97,20 @@ def main() -> int:
 
     dtype, compute_dtype = resolve_dtypes(args.dtype)
     rows = {}
-    for impl in args.impls.split(","):
+    for spec in args.impls.split(","):
+        # 'IMPL+fuse' races the fused-normalization path (table-baked
+        # D^-1/2 + fused epilogue) against the bare 'IMPL' row — the
+        # epoch-level form of micro_agg.py's chain-/fused- rows
+        impl, _, fuse_tag = spec.partition("+")
+        if fuse_tag not in ("", "fuse"):
+            print(f"# unknown impl spec {spec!r} (IMPL or IMPL+fuse)",
+                  file=sys.stderr)
+            continue
         cfg = TrainConfig(learning_rate=0.01, weight_decay=1e-4,
                           decay_rate=0.97, decay_steps=100,
                           aggr_impl=impl, dtype=dtype,
                           compute_dtype=compute_dtype,
+                          aggr_fuse="on" if fuse_tag else "off",
                           bdense_min_fill=args.min_fill,
                           bdense_a_budget=args.a_budget or None,
                           bdense_group=args.bdense_group,
@@ -121,13 +130,15 @@ def main() -> int:
         row = {"compile_s": round(compile_s, 1),
                "epoch_ms": round(float(np.median(times)), 2),
                "epoch_ms_all": [round(t, 1) for t in times]}
+        if fuse_tag:
+            row["aggr_fuse"] = "on"
         if impl == "bdense":
             row["min_fill"] = args.min_fill
             row["a_budget"] = args.a_budget
             if args.bdense_group > 1:
                 row["bdense_group"] = args.bdense_group
-        rows[impl] = row
-        print(f"# {impl}: epoch {row['epoch_ms']} ms "
+        rows[spec] = row
+        print(f"# {spec}: epoch {row['epoch_ms']} ms "
               f"(compile {compile_s:.0f}s)", file=sys.stderr)
         del trainer
 

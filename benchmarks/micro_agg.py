@@ -102,15 +102,151 @@ def main():
             t0 = time.time()
             ell = ell_from_graph(g.row_ptr, g.col_idx, V)
             ell_cache["prep"] = time.time() - t0
+            ell_cache["table"] = ell
             ell_cache["t"] = (
                 tuple(jnp.asarray(i[0]) for i in ell.idx),
                 jnp.asarray(ell.row_pos[0]))
         return ell_cache["t"], ell_cache["prep"]
 
+    # the fused-normalization race (chain-IMPL vs fused-IMPL rows):
+    # d = deg^-1/2 over the dst-major CSR, the same vector the GCN
+    # sandwich applies on both sides
+    from roc_tpu.ops.norm import inv_sqrt_degree_np
+    d_np = inv_sqrt_degree_np(np.diff(g.row_ptr))
+    d_ext = np.concatenate([d_np, np.zeros(1, np.float32)])
+    dj = jnp.asarray(d_np, dtype=dtype)
+    dj_ext = jnp.asarray(d_ext, dtype=dtype)
+    dj32 = jnp.asarray(d_np)  # fp32, for the pallas epilogue kernel
+
     for spec in args.impls.split(","):
         parts = spec.split(":")
         impl = parts[0]
         chunk = int(parts[1]) if len(parts) > 1 else 1024
+        if impl.startswith(("chain-", "fused-")):
+            # the fused-normalization race (ISSUE 1): 'chain-X' runs
+            # the UNFUSED GCN sandwich relu(d * agg_X(d * x)) as the
+            # model's separate ops would; 'fused-X' runs the same
+            # chain with the D^-1/2 scales baked into the tables
+            # (ell/sectioned weight tables, bdense in-register tile
+            # scales, the hand-written kernel trio for pallas).
+            # Specs: {chain,fused}-{ell,sectioned,bdense,pallas};
+            # bdense takes :MINFILL[:GROUP] like the plain row.
+            mode, base = impl.split("-", 1)
+            t0 = time.time()
+            try:
+                if base in ("ell", "pallas"):
+                    (idx, pos), _ = get_ell()
+                    if base == "pallas":
+                        from roc_tpu.kernels.ell_spmm import \
+                            ell_aggregate_pallas
+                        from roc_tpu.kernels.graphnorm import (
+                            fused_ell_aggregate_pallas,
+                            indegree_norm_pallas, scale_act_pallas)
+                        degj = jnp.asarray(np.concatenate(
+                            [np.diff(g.row_ptr).astype(np.int32),
+                             np.zeros(1, np.int32)]))
+                        if mode == "fused":
+                            def run_fn(x, i, p):
+                                xs = indegree_norm_pallas(x, degj)
+                                return fused_ell_aggregate_pallas(
+                                    xs, i, p, V, dj32, act="relu")
+                        else:
+                            def run_fn(x, i, p):
+                                y = ell_aggregate_pallas(
+                                    x * dj_ext[:, None], i, p, V)
+                                return jax.nn.relu(y * dj[:, None])
+                        f = jax.jit(run_fn)
+                        run = lambda: f(feats, idx, pos)
+                    elif mode == "fused":
+                        from roc_tpu.core.ell import ell_weight_tables
+                        tab = ell_cache["table"]
+                        w = tuple(jnp.asarray(a[0]) for a in
+                                  ell_weight_tables(tab, d_np[None, :],
+                                                    d_np))
+                        f = jax.jit(lambda x, i, p, ww: jax.nn.relu(
+                            aggregate_ell(x, i, p, V, ell_w=ww)))
+                        run = lambda: f(feats, idx, pos, w)
+                    else:
+                        f = jax.jit(lambda x, i, p: jax.nn.relu(
+                            aggregate_ell(x * dj_ext[:, None], i, p, V)
+                            * dj[:, None]))
+                        run = lambda: f(feats, idx, pos)
+                elif base == "sectioned":
+                    from roc_tpu.core.ell import sectioned_from_graph
+                    from roc_tpu.ops.aggregate import aggregate_ell_sect
+                    sect = sectioned_from_graph(
+                        g.row_ptr, g.col_idx, V, seg_rows=args.seg_rows)
+                    sidx, sdst, meta = sect.as_jax()
+                    if mode == "fused":
+                        w = tuple(jnp.asarray(a) for a in
+                                  sect.weight_tables(d_np, d_np))
+                        f = jax.jit(lambda x, i, dd, ww: jax.nn.relu(
+                            aggregate_ell_sect(x, i, dd, meta, V,
+                                               sect_w=ww)))
+                        run = lambda: f(feats, sidx, sdst, w)
+                    else:
+                        f = jax.jit(lambda x, i, dd: jax.nn.relu(
+                            aggregate_ell_sect(x * dj_ext[:, None], i,
+                                               dd, meta, V)
+                            * dj[:, None]))
+                        run = lambda: f(feats, sidx, sdst)
+                elif base == "bdense":
+                    from roc_tpu.core.ell import sectioned_from_graph
+                    from roc_tpu.ops.aggregate import aggregate_ell_sect
+                    from roc_tpu.ops.blockdense import (
+                        aggregate_block_dense, plan_blocks_packed)
+                    min_fill = int(parts[1]) if len(parts) > 1 else 64
+                    group = int(parts[2]) if len(parts) > 2 else 1
+                    plan = plan_blocks_packed(
+                        g.row_ptr, g.col_idx, V, min_fill=min_fill,
+                        a_budget_bytes=args.a_budget or None,
+                        group=group)
+                    sect = sectioned_from_graph(plan.res_row_ptr,
+                                                plan.res_col, V)
+                    sidx, sdst, meta = sect.as_jax()
+                    ab, sb, db = (jnp.asarray(plan.a_blocks),
+                                  jnp.asarray(plan.src_blk),
+                                  jnp.asarray(plan.dst_blk))
+                    if mode == "fused":
+                        dd_pad = np.zeros(plan.vpad, np.float32)
+                        dd_pad[:V] = d_np
+                        ddj = jnp.asarray(dd_pad)
+                        w = tuple(jnp.asarray(a) for a in
+                                  sect.weight_tables(d_np, d_np))
+
+                        def run_fn(x, a, s, d, i, dd, ww):
+                            y = aggregate_block_dense(
+                                x, a, s, d, V, plan.vpad, group=group,
+                                out_dtype=x.dtype, scale_dst=ddj,
+                                scale_src=ddj)
+                            return jax.nn.relu(
+                                y + aggregate_ell_sect(x, i, dd, meta,
+                                                       V, sect_w=ww))
+                        f = jax.jit(run_fn)
+                        run = lambda: f(feats, ab, sb, db, sidx, sdst, w)
+                    else:
+                        def run_fn(x, a, s, d, i, dd):
+                            xs = x * dj_ext[:, None]
+                            y = aggregate_block_dense(
+                                xs, a, s, d, V, plan.vpad, group=group,
+                                out_dtype=x.dtype)
+                            y = y + aggregate_ell_sect(xs, i, dd,
+                                                       meta, V)
+                            return jax.nn.relu(y * dj[:, None])
+                        f = jax.jit(run_fn)
+                        run = lambda: f(feats, ab, sb, db, sidx, sdst)
+                else:
+                    print(f"{spec:16s} REJECTED: unknown base impl "
+                          f"{base!r} for {mode}- spec")
+                    continue
+                prep = time.time() - t0
+                ms = bench(run, args.iters)
+                print(f"{spec:16s} {ms:9.2f} ms   {gb/ms*1e3:7.1f} GB/s "
+                      f"(prep {prep:.1f}s)")
+            except Exception as e:  # noqa: BLE001 - report and continue
+                print(f"{spec:16s} FAILED: {type(e).__name__}: "
+                      f"{str(e)[:200]}")
+            continue
         if impl == "sectioned":
             # sectioned:ROWS overrides the section size (in source
             # rows) — the dtype-aware sweep: bf16 tables are half the

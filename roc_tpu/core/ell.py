@@ -72,6 +72,32 @@ class EllTable:
                         row_id=tuple(a[p:p + 1] for a in self.row_id))
 
 
+def ell_weight_tables(table: EllTable, d_dst: np.ndarray,
+                      d_src: np.ndarray) -> Tuple[np.ndarray, ...]:
+    """Baked fused-normalization weights for an :class:`EllTable` —
+    one fp32 array per bucket, shaped like ``idx``:
+    ``w[p, r, j] = d_dst[p, row_id[p, r]] * d_src[idx[p, r, j]]``
+    (the per-edge entries of ``D^-1/2 A D^-1/2`` in ELL layout, so
+    the fused aggregation needs ZERO runtime normalization —
+    ops/aggregate.py aggregate_ell ``ell_w``).
+
+    d_dst: [P, part_nodes] inv-sqrt in-degrees of local output rows.
+    d_src: [gathered_rows] the same in gathered-source coordinates
+      (single-device: == d_dst[0]; distributed: the padded global
+      layout).  Padding bucket rows (``row_id == part_nodes``) and
+      padding entries (``idx == gathered_rows`` dummy) weigh 0.
+    """
+    d_dst = np.asarray(d_dst, dtype=np.float32)
+    P = table.num_parts
+    dd = np.concatenate([d_dst, np.zeros((P, 1), np.float32)], axis=1)
+    ds = np.concatenate([np.asarray(d_src, dtype=np.float32),
+                         np.zeros(1, np.float32)])
+    parts = np.arange(P)[:, None]
+    return tuple(
+        (dd[parts, rid][:, :, None] * ds[idx]).astype(np.float32)
+        for idx, rid in zip(table.idx, table.row_id))
+
+
 def row_widths(deg: np.ndarray, min_width: int) -> np.ndarray:
     """Per-row bucket width: smallest power-of-two >= degree (floored at
     ``min_width``); 0 for empty rows.  Widths are unbounded: a hub row
@@ -293,6 +319,42 @@ class SectionedEll:
         return (tuple(jnp.asarray(a) for a in self.idx),
                 tuple(jnp.asarray(a) for a in self.sub_dst),
                 tuple(zip(self.sec_starts, self.sec_sizes)))
+
+    def weight_tables(self, d_dst: np.ndarray,
+                      d_src: np.ndarray) -> Tuple[np.ndarray, ...]:
+        """Baked fused-normalization weights — one fp32 array per
+        section, shaped like ``idx``: ``w = d_dst[sub_dst] *
+        d_src[start + idx]`` (the ``D^-1/2 A D^-1/2`` entries in
+        sectioned layout; ops/aggregate.py aggregate_ell_sect
+        ``sect_w``).
+
+        d_dst: [num_rows] inv-sqrt in-degrees of the output rows, or
+          stacked [P, num_rows] for per-part tables built by
+          :func:`sectioned_from_padded_parts`.
+        d_src: [src_rows] the same over source coordinates (gathered
+          layout when they differ).  Chunk-padding sub-rows
+          (``sub_dst == num_rows``) and padded entries (section-local
+          dummy id == section size) weigh 0.
+        """
+        d_dst = np.asarray(d_dst, dtype=np.float32)
+        d_src = np.asarray(d_src, dtype=np.float32)
+        stacked = d_dst.ndim == 2
+        zpad = (np.zeros((d_dst.shape[0], 1), np.float32) if stacked
+                else np.zeros(1, np.float32))
+        dd = np.concatenate([d_dst, zpad], axis=-1)
+        out = []
+        for st, sz, idx, sdst in zip(self.sec_starts, self.sec_sizes,
+                                     self.idx, self.sub_dst):
+            ds = np.concatenate([d_src[st:st + sz],
+                                 np.zeros(1, np.float32)])
+            if stacked:
+                parts = np.arange(d_dst.shape[0])[:, None, None]
+                wd = dd[parts, sdst]
+            else:
+                wd = dd[sdst]
+            out.append((wd[..., None]
+                        * ds[idx.astype(np.int64)]).astype(np.float32))
+        return tuple(out)
 
     def with_idx_dtype(self, dtype) -> "SectionedEll":
         """Same layout with the index tables narrowed to ``dtype``

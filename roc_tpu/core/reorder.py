@@ -170,6 +170,15 @@ def lpa_order(graph: Graph, max_iters: int = 16) -> np.ndarray:
 ORDERINGS = {"bfs": bfs_order, "lpa": lpa_order}
 
 
+def single_key_fits_int64(num_nodes: int) -> bool:
+    """True when the ``new_dst * V + new_src`` edge-relabel key stays
+    inside int64 — the guard :func:`apply_graph_order` consults before
+    taking the single-key fast path (max key value is
+    ``(V-1) * V + (V-1) == V^2 - 1``)."""
+    v = int(num_nodes)
+    return v == 0 or v <= (np.iinfo(np.int64).max // v)
+
+
 def apply_graph_order(graph: Graph, perm: np.ndarray) -> Graph:
     """CSR with vertices relabeled so ``new_id = rank(old_id)``
     (``perm[new_id] == old_id``); per-row neighbor lists re-sorted
@@ -183,13 +192,26 @@ def apply_graph_order(graph: Graph, perm: np.ndarray) -> Graph:
     new_deg = deg[perm]
     new_row_ptr = np.zeros(V + 1, dtype=np.int64)
     np.cumsum(new_deg, out=new_row_ptr[1:])
+    old_dst = np.repeat(np.arange(V, dtype=np.int64), deg)
+    if not single_key_fits_int64(V):
+        # V^2 past int64 (V > ~3.03e9): the single-key relabel would
+        # overflow SILENTLY and corrupt the CSR (round-5 advisor: the
+        # limit used to live only in a comment).  No fallback exists
+        # that could help — Graph stores int32 columns, which caps
+        # representable graphs at V < 2^31 (where V^2 < 2^62 always
+        # fits), so reaching this branch means the input was already
+        # outside the container's domain: fail LOUDLY.
+        raise ValueError(
+            f"apply_graph_order: V={V:,} exceeds the single-key int64 "
+            f"relabel range (V^2 overflows) — and the int32 col_idx "
+            f"Graph layout itself, which caps V below 2^31; relabel "
+            f"such graphs with an int64 edge pipeline before loading")
     # vectorized edge relabel: one SINGLE-KEY sort of
     # new_dst * V + new_src (fits int64 up to V ~ 3e9 edges^1/2; the
     # row id recovers by div, the column by mod) — measured ~4x
     # faster than the equivalent two-pass lexsort at Reddit scale,
     # and the sorted VALUES are the answer directly (no 115M-element
     # argsort gather)
-    old_dst = np.repeat(np.arange(V, dtype=np.int64), deg)
     key = rank[old_dst] * V + rank[graph.col_idx.astype(np.int64)]
     key.sort()   # value sort: stability is unobservable in the output
     new_col = (key % V).astype(np.int32)

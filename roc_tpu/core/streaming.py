@@ -210,10 +210,10 @@ def stream_prefix_to_host(graph: Graph, prefix_ops,
     session — this is the SGC-style precompute (A_hat^k X), after which
     epochs touch only the streamed head."""
     from ..models.builder import AGGR_AVG, AGGR_SUM
+    from ..ops.norm import inv_sqrt_degree_np
     x = np.asarray(feats_host, dtype=np.float32)
     deg = np.asarray(graph.in_degree, dtype=np.float32)
-    inv_sqrt = np.where(deg > 0, 1.0 / np.sqrt(np.maximum(deg, 1.0)),
-                        0.0).astype(np.float32)[:, None]
+    inv_sqrt = inv_sqrt_degree_np(graph.in_degree)[:, None]
     tiles = None
     for op in prefix_ops:
         if op.kind == "indegree_norm":
@@ -224,6 +224,17 @@ def stream_prefix_to_host(graph: Graph, prefix_ops,
             x = aggregate_to_host(graph, x, block_rows, tiles=tiles)
             if op.attrs.get("aggr", AGGR_SUM) == AGGR_AVG:
                 x = x / np.maximum(deg, 1.0)[:, None]
+        elif op.kind == "fused_aggregate":
+            # the fused norm -> sum -> norm [-> relu] op
+            # (models/builder.py fuse_norm_aggregate), unrolled
+            # host-side — this precompute runs once, so fusion buys
+            # nothing here and exactness is what matters
+            if tiles is None:
+                tiles = build_tile_plans(graph, block_rows)
+            x = aggregate_to_host(graph, x * inv_sqrt, block_rows,
+                                  tiles=tiles) * inv_sqrt
+            if op.attrs.get("activation", "none") != "none":
+                np.maximum(x, 0.0, out=x)
         else:  # pragma: no cover - guarded by streamable_agg_head
             raise NotImplementedError(op.kind)
     return x

@@ -1,4 +1,5 @@
-"""Pallas TPU kernel for in-degree normalization (GraphNorm).
+"""Pallas TPU kernels for in-degree normalization (GraphNorm) and the
+fused normalize-aggregate-activate chain.
 
 Reference: ``graphnorm_kernel.cu:45-55`` computes
 ``out[v, :] = in[v, :] / sqrt(indegree(v))`` from CSR row pointers;
@@ -12,6 +13,15 @@ On TPU the degrees are static per graph, so the kernel is a tiled
 broadcast scale: rows stream through VMEM in (block, lane-aligned)
 tiles, ``rsqrt`` runs on the VPU.  Zero-degree (padding) rows map to
 zero output, matching :func:`roc_tpu.ops.norm.inv_sqrt_degree`.
+
+**Fused epilogue** (:func:`scale_act_pallas`): the post-aggregation
+half of the GCN sandwich — ``act(y * d_dst)`` — in ONE tiled VMEM
+pass instead of the unfused chain's separate norm and relu ops.
+:func:`fused_ell_aggregate_pallas` composes the hand-written route
+end to end: pre-scale kernel -> one-launch ELL DMA aggregation
+(kernels/ell_spmm.py) -> fused scale(+activate) epilogue, so
+``aggr_impl='pallas'`` under ``aggr_fuse`` never leaves hand-written
+kernels for the whole normalize-aggregate-activate chain.
 """
 
 from __future__ import annotations
@@ -32,11 +42,14 @@ def _norm_kernel(deg_ref, x_ref, out_ref):
         out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("block",))
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
 def indegree_norm_pallas(x: jax.Array, in_degree: jax.Array,
-                         block: int = 1024) -> jax.Array:
+                         block: int = 1024,
+                         interpret: bool = False) -> jax.Array:
     """``x * rsqrt(max(in_degree, 1))[:, None]`` with rows tiled through
-    VMEM.  ``x``: [V, F]; ``in_degree``: int32 [V]."""
+    VMEM.  ``x``: [V, F]; ``in_degree``: int32 [V].  ``interpret``
+    runs the interpreter (CPU tests — jax dropped the global
+    force_tpu_interpret_mode switch)."""
     V, F = x.shape
     B = min(block, V)
     Vp = pl.cdiv(V, B) * B
@@ -56,5 +69,71 @@ def indegree_norm_pallas(x: jax.Array, in_degree: jax.Array,
         out_specs=pl.BlockSpec((B, F), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Vp, F), x.dtype),
+        interpret=interpret,
     )(deg2d, x)
     return out[:V]
+
+
+def _scale_act_kernel(scale_ref, x_ref, out_ref, *, act: str):
+    s = scale_ref[:].astype(jnp.float32)                     # [B, 1]
+    y = x_ref[:].astype(jnp.float32) * s
+    if act == "relu":
+        y = jnp.maximum(y, 0.0)
+    out_ref[:] = y.astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("act", "block", "interpret"))
+def scale_act_pallas(x: jax.Array, scale: jax.Array,
+                     act: str = "none", block: int = 1024,
+                     interpret: bool = False) -> jax.Array:
+    """Fused epilogue: ``act(x * scale[:, None])`` in one tiled VMEM
+    pass — the post-norm (a PRECOMPUTED fp32 ``d = deg^-1/2`` vector)
+    and the activation that the unfused chain spends two full [V, F]
+    HBM round trips on.  ``act``: 'none' | 'relu'."""
+    if act not in ("none", "relu"):
+        raise ValueError(f"unknown act {act!r}; expected 'none'|'relu'")
+    V, F = x.shape
+    B = min(block, V)
+    Vp = pl.cdiv(V, B) * B
+    if Vp != V:
+        x = jnp.pad(x, ((0, Vp - V), (0, 0)))
+        scale = jnp.pad(scale, (0, Vp - V))
+    s2d = scale.astype(jnp.float32).reshape(Vp, 1)
+    out = pl.pallas_call(
+        functools.partial(_scale_act_kernel, act=act),
+        grid=(Vp // B,),
+        in_specs=[
+            pl.BlockSpec((B, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, F), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((B, F), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((Vp, F), x.dtype),
+        interpret=interpret,
+    )(s2d, x)
+    return out[:V]
+
+
+def fused_ell_aggregate_pallas(full: jax.Array, ell_idx,
+                               ell_row_pos: jax.Array, num_rows: int,
+                               d_dst: jax.Array, act: str = "none",
+                               interpret: bool = False) -> jax.Array:
+    """Aggregate-and-scale tail of the hand-written fused chain:
+    the one-launch ELL DMA aggregation (kernels/ell_spmm.py) followed
+    by the :func:`scale_act_pallas` epilogue ``act(y * d_dst)``.
+
+    ``full`` must already carry the PRE-scaled features (the caller
+    runs :func:`indegree_norm_pallas` on the local rows before the
+    halo gather — under shard_map the pre-scale must happen in local
+    coordinates).  ``d_dst``: fp32 [num_rows] inv-sqrt degrees of the
+    output rows.  With ``act='none'`` this is the exact linear
+    operator ``D^-1/2 A D^-1/2`` the symmetric-vjp fused aggregation
+    wraps; ``act='relu'`` is the full forward-only chain the
+    benchmarks race."""
+    from .ell_spmm import ell_aggregate_pallas
+    y = ell_aggregate_pallas(full, ell_idx, ell_row_pos, num_rows,
+                             interpret=interpret)
+    return scale_act_pallas(y, d_dst, act=act, interpret=interpret)

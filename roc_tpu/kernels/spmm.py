@@ -59,10 +59,12 @@ def _seg_reduce_kernel(dst_ref, g_ref, out_ref, carry_ref):
     out_ref[:] = jnp.where(rows == pos, 0.0, L).astype(out_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("num_rows", "chunk"))
+@functools.partial(jax.jit,
+                   static_argnames=("num_rows", "chunk", "interpret"))
 def csr_spmm_pallas(feats: jax.Array, edge_src: jax.Array,
                     edge_dst: jax.Array, num_rows: int,
-                    chunk: int = 512) -> jax.Array:
+                    chunk: int = 512,
+                    interpret: bool = False) -> jax.Array:
     """``out[dst] = sum feats[src]`` over dst-sorted padded edges.
 
     Same contract as :func:`roc_tpu.ops.aggregate.aggregate_blocked`:
@@ -92,6 +94,7 @@ def csr_spmm_pallas(feats: jax.Array, edge_src: jax.Array,
             pl.BlockSpec(memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.VMEM),
         ),
+        interpret=interpret,
     )
 
     out0 = jnp.zeros((num_rows + C, F), dtype=feats.dtype)

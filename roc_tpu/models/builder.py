@@ -80,6 +80,22 @@ class GraphContext:
     aggr_impl: str = "segment"
     chunk: int = 512
     symmetric: bool = True
+    # Fused-normalization tables (aggr_fuse, see Model.fuse_norm_
+    # aggregate): per-edge weights ``w = d[dst] * d[src]`` with
+    # ``d = inv_sqrt_degree`` baked host-side into the aggregation
+    # tables (core/ell.py ell_weight_tables / SectionedEll.
+    # weight_tables, parallel/ring.py ring_weight_tables).  Shapes
+    # mirror the index tables they weight.  Empty = derive ``d`` from
+    # ``in_degree`` at trace time and pre/post-scale the features
+    # instead (exact same numbers, two extra fused multiplies).
+    ell_w: Tuple[jax.Array, ...] = ()
+    sect_w: Tuple[jax.Array, ...] = ()
+    ring_w: Optional[jax.Array] = None
+    # bdense in-register tile scales: (d_dst [vpad], d_src [src_vpad])
+    # fp32 — applied per [128, F] tile inside the einsum chunk body
+    # (ops/blockdense.py), keeping the integer A-tables (and their u4
+    # packing) intact
+    bd_scale: Tuple[jax.Array, ...] = ()
     # ELL layout (aggr_impl == "ell"): tuple of [rows_b, width_b] index
     # arrays + [num_rows] output permutation (core/ell.py)
     ell_idx: Tuple[jax.Array, ...] = ()
@@ -126,16 +142,20 @@ class GraphContext:
     ring_idx: Tuple[jax.Array, ...] = ()
     axis_name: str = "parts"
 
+    def _gathered_with_zero(self, x: jax.Array) -> jax.Array:
+        """Halo exchange + the appended dummy zero source row that
+        padding table entries point at."""
+        full = self.gather_features(x)
+        zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
+        return jnp.concatenate([full, zero], axis=0)
+
     def _sum_fwd(self, x: jax.Array) -> jax.Array:
         """Halo exchange + local CSR sum: ``out = A_p @ gather(x)``."""
         if self.halo == "ring":
             from ..parallel.ring import ring_aggregate
             return ring_aggregate(x, self.ring_idx[0], self.ring_idx[1],
                                   axis_name=self.axis_name)
-        full = self.gather_features(x)
-        # append the dummy zero source row that padding edges point at
-        zero = jnp.zeros((1, full.shape[1]), dtype=full.dtype)
-        full = jnp.concatenate([full, zero], axis=0)
+        full = self._gathered_with_zero(x)
         if self.aggr_impl == "ell":
             return aggregate_ell(full, self.ell_idx, self.ell_row_pos,
                                  self.num_rows)
@@ -192,6 +212,102 @@ class GraphContext:
 
         def bwd(_, g):
             return (self._sum_fwd(g),)
+
+        agg.defvjp(fwd, bwd)
+        return agg(x)
+
+    def _fused_sum_fwd(self, x: jax.Array) -> jax.Array:
+        """One-pass ``D^-1/2 A D^-1/2 x`` (the GCN sandwich of
+        norm -> sum-aggregate -> norm folded into the aggregation,
+        Model.fuse_norm_aggregate): table-driven impls read the baked
+        per-edge weights when present (zero runtime normalization);
+        otherwise ``d = inv_sqrt_degree(in_degree)`` is derived at
+        trace time and the features are scaled once before / the
+        output once after the plain sum — the same numbers as the
+        unfused chain, still inside ONE op so the multiplies fuse
+        into the aggregation's reads/writes."""
+        from ..ops.norm import inv_sqrt_degree
+        if self.halo == "ring":
+            from ..parallel.ring import ring_aggregate
+            if self.ring_w is not None:
+                return ring_aggregate(
+                    x, self.ring_idx[0], self.ring_idx[1],
+                    axis_name=self.axis_name, weights=self.ring_w)
+            d = inv_sqrt_degree(self.in_degree).astype(x.dtype)
+            out = ring_aggregate(x * d[:, None], self.ring_idx[0],
+                                 self.ring_idx[1],
+                                 axis_name=self.axis_name)
+            return out * d[:, None]
+        if self.aggr_impl == "ell" and self.ell_w:
+            full = self._gathered_with_zero(x)
+            return aggregate_ell(full, self.ell_idx, self.ell_row_pos,
+                                 self.num_rows, ell_w=self.ell_w)
+        if self.aggr_impl == "sectioned" and self.sect_w:
+            full = self._gathered_with_zero(x)
+            return aggregate_ell_sect(full, self.sect_idx,
+                                      self.sect_sub_dst, self.sect_meta,
+                                      self.num_rows, sect_w=self.sect_w)
+        if self.aggr_impl == "bdense" and self.bd_scale:
+            from ..ops.blockdense import aggregate_block_dense
+            full = self._gathered_with_zero(x)
+            out = None
+            if self.bd_a is not None:
+                out = aggregate_block_dense(
+                    full, self.bd_a, self.bd_src, self.bd_dst,
+                    self.num_rows, self.bd_vpad,
+                    out_dtype=full.dtype,
+                    src_vpad=self.bd_src_vpad,
+                    group=self.bd_group,
+                    scale_dst=self.bd_scale[0],
+                    scale_src=self.bd_scale[1])
+            if self.sect_idx:
+                res = aggregate_ell_sect(
+                    full, self.sect_idx, self.sect_sub_dst,
+                    self.sect_meta, self.num_rows, sect_w=self.sect_w)
+                out = res if out is None else out + res
+            if out is None:  # zero-edge graph
+                out = jnp.zeros((self.num_rows, full.shape[1]),
+                                dtype=full.dtype)
+            return out
+        if self.aggr_impl == "pallas":
+            # the hand-written route (kernels/graphnorm.py): pre-scale
+            # kernel on the LOCAL rows -> halo gather -> one-launch
+            # ELL DMA kernel -> fused scale epilogue kernel.  The
+            # activation rides outside the linear operator so the
+            # symmetric vjp below stays exact.
+            from ..kernels.graphnorm import (fused_ell_aggregate_pallas,
+                                             indegree_norm_pallas)
+            interp = _on_cpu()
+            full = self._gathered_with_zero(
+                indegree_norm_pallas(x, self.in_degree,
+                                     interpret=interp))
+            return fused_ell_aggregate_pallas(
+                full, self.ell_idx, self.ell_row_pos, self.num_rows,
+                inv_sqrt_degree(self.in_degree), interpret=interp)
+        # gather-based impls (segment/blocked/scan): scale features
+        # once per fused op, sum, scale the output
+        d = inv_sqrt_degree(self.in_degree).astype(x.dtype)
+        out = self._sum_fwd(x * d[:, None])
+        return out * d[:, None]
+
+    def aggregate_fused(self, x: jax.Array) -> jax.Array:
+        """Fused ``S x`` with ``S = D^-1/2 A D^-1/2``.  S is symmetric
+        whenever A is (diagonal scale on both sides), so the backward
+        reuses the forward exactly like :meth:`aggregate_sum` —
+        including the shard-level identity row-slice_p(S^T g) = S_p g.
+        ``symmetric=False`` falls back to exact autodiff."""
+        if not self.symmetric:
+            return self._fused_sum_fwd(x)
+
+        @jax.custom_vjp
+        def agg(x):
+            return self._fused_sum_fwd(x)
+
+        def fwd(x):
+            return agg(x), None
+
+        def bwd(_, g):
+            return (self._fused_sum_fwd(g),)
 
         agg.defvjp(fwd, bwd)
         return agg(x)
@@ -305,7 +421,8 @@ def _gctx_flatten(g: GraphContext):
     children = (g.edge_src, g.edge_dst, g.in_degree, g.ell_idx,
                 g.ell_row_pos, g.ring_idx, g.sect_idx, g.sect_sub_dst,
                 g.ell_row_id, g.flat8_idx, g.flat8_dst, g.bd_a,
-                g.bd_src, g.bd_dst)
+                g.bd_src, g.bd_dst, g.ell_w, g.sect_w, g.ring_w,
+                g.bd_scale)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
            g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
            g.sect_meta, g.bd_vpad, g.bd_src_vpad, g.bd_group)
@@ -318,7 +435,8 @@ def _gctx_unflatten(aux, children):
      bd_group) = aux
     (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
      sect_idx, sect_sub_dst, ell_row_id, flat8_idx,
-     flat8_dst, bd_a, bd_src, bd_dst) = children
+     flat8_dst, bd_a, bd_src, bd_dst, ell_w, sect_w, ring_w,
+     bd_scale) = children
     return GraphContext(
         edge_src=edge_src, edge_dst=edge_dst, in_degree=in_degree,
         num_rows=num_rows, gathered_rows=gathered_rows,
@@ -329,7 +447,8 @@ def _gctx_unflatten(aux, children):
         sect_sub_dst=sect_sub_dst, sect_meta=sect_meta,
         ell_row_id=ell_row_id, flat8_idx=flat8_idx,
         flat8_dst=flat8_dst, bd_a=bd_a, bd_src=bd_src, bd_dst=bd_dst,
-        bd_vpad=bd_vpad, bd_src_vpad=bd_src_vpad, bd_group=bd_group)
+        bd_vpad=bd_vpad, bd_src_vpad=bd_src_vpad, bd_group=bd_group,
+        ell_w=ell_w, sect_w=sect_w, ring_w=ring_w, bd_scale=bd_scale)
 
 
 # GraphContext is a pytree so the graph tables travel as jit ARGUMENTS.
@@ -384,6 +503,88 @@ class Model:
         return any(op.kind == "scatter_gather"
                    and op.attrs.get("aggr") in (AGGR_MAX, AGGR_MIN)
                    for op in self._ops)
+
+    def num_fused_aggregates(self) -> int:
+        """Fused norm-aggregate-norm ops in the list (0 for models
+        :meth:`fuse_norm_aggregate` has not been applied to, or whose
+        shape has no fusable chain)."""
+        return sum(op.kind == "fused_aggregate" for op in self._ops)
+
+    def fuse_norm_aggregate(self) -> "Model":
+        """Rewrite every ``indegree_norm -> scatter_gather(SUM) ->
+        indegree_norm [-> relu]`` chain whose intermediates have no
+        other consumer (and don't carry the loss marker) into ONE
+        ``fused_aggregate`` op computing ``[relu](D^-1/2 A D^-1/2 x)``
+        — the GCN normalization sandwich (``gnn.cc:78-91``) folded
+        into the aggregation so the 2-3 extra full ``[V, F]`` HBM
+        round trips per layer disappear (GraphContext.aggregate_fused
+        picks table-baked weights or in-op scaling per impl).
+
+        Returns a NEW Model; parameter names are untouched (the chain
+        is parameter-free), so params initialized from either model
+        feed both — checkpoints stay compatible.  Models with no
+        matching chain come back as an equivalent copy with
+        ``num_fused_aggregates() == 0``."""
+        ops = self._ops
+        n = len(ops)
+        consumers = [0] * n
+        for op in ops:
+            for i in op.inputs:
+                consumers[i] += 1
+        loss = self._loss_op
+        # chain start -> (chain end inclusive, fused activation)
+        chains: Dict[int, Tuple[int, str]] = {}
+        i = 1
+        while i + 2 < n:
+            o0, o1, o2 = ops[i], ops[i + 1], ops[i + 2]
+            ok = (o0.kind == "indegree_norm"
+                  and o1.kind == "scatter_gather"
+                  and o1.inputs == (i,)
+                  and o1.attrs.get("aggr", AGGR_SUM) == AGGR_SUM
+                  and o2.kind == "indegree_norm"
+                  and o2.inputs == (i + 1,)
+                  and consumers[i] == 1 and consumers[i + 1] == 1
+                  and loss not in (i, i + 1))
+            if not ok:
+                i += 1
+                continue
+            end, act = i + 2, AC_MODE_NONE
+            if (end + 1 < n and ops[end + 1].kind == "activation"
+                    and ops[end + 1].attrs.get("mode") == AC_MODE_RELU
+                    and ops[end + 1].inputs == (end,)
+                    and consumers[end] == 1 and loss != end):
+                end += 1
+                act = AC_MODE_RELU
+            chains[i] = (end, act)
+            i = end + 1
+        fused = Model(in_dim=ops[0].dim)
+        fused._n_linear = self._n_linear
+        fused._n_gat = self._n_gat
+        fused._n_eps = self._n_eps
+        new_ops = [ops[0]]
+        remap = {0: 0}
+        skip_until = 0
+        for i in range(1, n):
+            if i in chains:
+                end, act = chains[i]
+                new_ops.append(_Op(
+                    "fused_aggregate", (remap[ops[i].inputs[0]],),
+                    ops[i].dim,
+                    attrs={"aggr": AGGR_SUM, "activation": act}))
+                for k in range(i, end + 1):
+                    remap[k] = len(new_ops) - 1
+                skip_until = end
+                continue
+            if i <= skip_until:
+                continue
+            op = ops[i]
+            new_ops.append(_Op(
+                op.kind, tuple(remap[k] for k in op.inputs), op.dim,
+                op.param, dict(op.attrs)))
+            remap[i] = len(new_ops) - 1
+        fused._ops = new_ops
+        fused._loss_op = remap[loss] if loss is not None else None
+        return fused
 
     # ---- builder API (names match the reference) ----
 
@@ -551,13 +752,14 @@ class Model:
         ops = self._ops
         i = 1
         while i < len(ops) and ops[i].inputs == (i - 1,) and (
-                ops[i].kind == "indegree_norm"
+                ops[i].kind in ("indegree_norm", "fused_aggregate")
                 or (ops[i].kind == "scatter_gather"
                     and ops[i].attrs.get("aggr", AGGR_SUM)
                     in (AGGR_SUM, AGGR_AVG))):
             i += 1
-        if i == 1 or not any(op.kind == "scatter_gather"
-                             for op in ops[1:i]):
+        if i == 1 or not any(
+                op.kind in ("scatter_gather", "fused_aggregate")
+                for op in ops[1:i]):
             return None
         if i + 1 >= len(ops):
             return None
@@ -647,6 +849,20 @@ class Model:
                 # (train/trainer.py remat_policy="save_aggregates")
                 vals[i] = checkpoint_name(
                     gctx.aggregate(x, op.attrs["aggr"]), "aggregate")
+            elif op.kind == "fused_aggregate":
+                # norm -> sum -> norm [-> relu] in one op (fuse_norm_
+                # aggregate).  The activation sits OUTSIDE the
+                # symmetric custom_vjp (relu is nonlinear) but inside
+                # this op's fusion scope, so XLA folds it into the
+                # aggregation epilogue.  Same checkpoint name as
+                # scatter_gather: the remat policy saves fused
+                # aggregations identically.
+                y = checkpoint_name(gctx.aggregate_fused(x),
+                                    "aggregate")
+                if op.attrs.get("activation",
+                                AC_MODE_NONE) != AC_MODE_NONE:
+                    y = dense.activation(y, op.attrs["activation"])
+                vals[i] = y
             elif op.kind == "gat":
                 vals[i] = checkpoint_name(
                     gctx.gat_attention(

@@ -176,7 +176,8 @@ def aggregate_scan(feats: jax.Array, edge_src: jax.Array,
 
 def aggregate_ell(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,
                   num_rows: int,
-                  budget_elems: int = 1 << 24) -> jax.Array:
+                  budget_elems: int = 1 << 24,
+                  ell_w=None) -> jax.Array:
     """Degree-bucketed ELLPACK aggregation (see core/ell.py): per width
     bucket, gather ``feats[idx]`` and sum the width axis; inverse-permute
     the concatenated bucket outputs back to row order.  No scatter, no
@@ -190,25 +191,43 @@ def aggregate_ell(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,
     ``budget_elems`` scalars (R * W * F, i.e. bytes/4 in fp32 — default
     64 MiB) are processed in row segments with lax.scan to bound the
     transient.
+
+    ``ell_w`` (optional): per-bucket edge weights shaped like
+    ``ell_idx`` (core/ell.py ell_weight_tables — the baked
+    ``D^-1/2 A D^-1/2`` scales of the fused aggregation); the gathered
+    rows are weighted in-register before the width reduction, so the
+    weighted sum costs no extra HBM pass over the features.
     """
     F = feats.shape[1]
     outs = []
-    for idx in ell_idx:
+    for bi, idx in enumerate(ell_idx):
+        w = (ell_w[bi].astype(feats.dtype)
+             if ell_w is not None and len(ell_w) else None)
         R, W = idx.shape
         if R * W * F <= budget_elems:
-            outs.append(feats[idx].sum(axis=1))
+            g = feats[idx]
+            if w is not None:
+                g = g * w[:, :, None]
+            outs.append(g.sum(axis=1))
             continue
         segs = -(-R * W * F // budget_elems)
         seg_rows = -(-R // segs)
         Rp = seg_rows * segs
         pad = jnp.full((Rp - R, W), feats.shape[0] - 1, dtype=idx.dtype)
         idx_p = jnp.concatenate([idx, pad], axis=0)
+        xs = (idx_p.reshape(segs, seg_rows, W),)
+        if w is not None:
+            w_p = jnp.concatenate(
+                [w, jnp.zeros((Rp - R, W), dtype=w.dtype)], axis=0)
+            xs += (w_p.reshape(segs, seg_rows, W),)
 
         def body(_, ch):
-            return None, feats[ch].sum(axis=1)
+            g = feats[ch[0]]
+            if len(ch) > 1:
+                g = g * ch[1][:, :, None]
+            return None, g.sum(axis=1)
 
-        _, segs_out = lax.scan(body, None,
-                               idx_p.reshape(segs, seg_rows, W))
+        _, segs_out = lax.scan(body, None, xs)
         outs.append(segs_out.reshape(Rp, F)[:R])
     zero = jnp.zeros((1, F), dtype=feats.dtype)
     cat = jnp.concatenate(outs + [zero], axis=0)
@@ -216,7 +235,8 @@ def aggregate_ell(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,
 
 
 def aggregate_ell_sect(feats: jax.Array, sect_idx, sect_sub_dst,
-                       sect_meta, num_rows: int) -> jax.Array:
+                       sect_meta, num_rows: int,
+                       sect_w=None) -> jax.Array:
     """Source-sectioned width-8 aggregation (core/ell.py SectionedEll —
     the measured numbers and the why live on that dataclass).  Per
     section: slice the <= 64 MiB source block out of ``feats`` (XLA
@@ -228,20 +248,31 @@ def aggregate_ell_sect(feats: jax.Array, sect_idx, sect_sub_dst,
       ``[start, start+size)`` so an appended global dummy row is fine.
     sect_idx / sect_sub_dst: SectionedEll.idx / .sub_dst as jax arrays.
     sect_meta: static tuple of (start, size) per section.
+    sect_w (optional): per-section edge weights shaped like
+      ``sect_idx`` (SectionedEll.weight_tables — the baked fused-norm
+      scales), applied in-register before the width reduction.
     """
     F = feats.shape[1]
     out = jnp.zeros((num_rows + 1, F), dtype=feats.dtype)
     zero = jnp.zeros((1, F), dtype=feats.dtype)
-    for (st, sz), tbl, sdst in zip(sect_meta, sect_idx, sect_sub_dst):
+    weighted = sect_w is not None and len(sect_w) > 0
+    for si, ((st, sz), tbl, sdst) in enumerate(
+            zip(sect_meta, sect_idx, sect_sub_dst)):
         xsec = jnp.concatenate(
             [lax.slice(feats, (st, 0), (st + sz, F)), zero], axis=0)
+        xs = (tbl, sdst)
+        if weighted:
+            xs += (sect_w[si].astype(feats.dtype),)
 
         def body(o, ch, xsec=xsec):
-            idx_ch, dst_ch = ch
-            part = xsec[idx_ch].sum(axis=1)
+            idx_ch, dst_ch = ch[0], ch[1]
+            g = xsec[idx_ch]
+            if len(ch) > 2:
+                g = g * ch[2][:, :, None]
+            part = g.sum(axis=1)
             return o.at[dst_ch].add(part, indices_are_sorted=True), None
 
-        out, _ = lax.scan(body, out, (tbl, sdst))
+        out, _ = lax.scan(body, out, xs)
     return out[:num_rows]
 
 

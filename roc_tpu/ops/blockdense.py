@@ -450,7 +450,9 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
                           out_dtype=jnp.float32,
                           chunk_blocks: int = _CHUNK_BLOCKS,
                           src_vpad: int = 0,
-                          group: int = 1
+                          group: int = 1,
+                          scale_dst: Optional[jax.Array] = None,
+                          scale_src: Optional[jax.Array] = None
                           ) -> jax.Array:
     """Dense-tile partial aggregation (the residual CSR is the
     caller's, via the sectioned/ELL path on the SAME x).
@@ -467,6 +469,14 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
     (every run of ``group`` consecutive blocks shares one dst tile):
     each group is reduced in ONE einsum and its output tile updated
     once — ``group``x less output read-modify-write traffic.
+
+    ``scale_dst`` [vpad] / ``scale_src`` [src_vpad] (optional, set
+    together): per-row fp32 scales of the fused normalization
+    ``D^-1/2 A D^-1/2`` (train fused path).  Applied per tile
+    IN-REGISTER around the einsum — the integer A-table (and its u4
+    packing) stays untouched and no extra HBM pass happens: the
+    source tile is scaled after its load, the fp32 accumulator before
+    its scatter-add.
     """
     F = x.shape[1]
     nblk = a_blocks.shape[0]
@@ -477,6 +487,8 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
         raise ValueError(
             f"group={group} needs a pad_plan_groups-padded plan; "
             f"got {nblk} blocks")
+    if (scale_dst is None) != (scale_src is None):
+        raise ValueError("scale_dst and scale_src must be set together")
     xt = jnp.zeros((src_vpad, F), dtype=x.dtype).at[:src_rows].set(
         x[:src_rows]).reshape(src_vpad // BLOCK, BLOCK, F)
     # pad the block list to a chunk multiple; padding scatters zero
@@ -502,6 +514,19 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
         if pad else dst_blk
     compute = (jnp.bfloat16 if x.dtype in (jnp.bfloat16,)
                else jnp.float32)
+    if scale_src is not None:
+        # tiled scale views: [n_src_tiles, 128] / [n_tiles + 1, 128]
+        # (the trailing zero row serves padding blocks' dummy dst
+        # tile).  Source scaling runs in the compute dtype — exactly
+        # where the unfused indegree_norm multiplied; the dst side
+        # scales the fp32 accumulator.
+        ssrc_t = scale_src.astype(compute).reshape(
+            src_vpad // BLOCK, BLOCK)
+        sdst_t = jnp.concatenate([
+            scale_dst.astype(jnp.float32).reshape(n_tiles, BLOCK),
+            jnp.zeros((1, BLOCK), jnp.float32)])
+    else:
+        ssrc_t = sdst_t = None
 
     def body(out, ch):
         a_u8, s_ids, d_ids = ch
@@ -511,6 +536,8 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
                              axis=-1).reshape(a_u8.shape[0],
                                               BLOCK, BLOCK)
         gx = xt[s_ids].astype(compute)              # [C, 128, F]
+        if ssrc_t is not None:
+            gx = gx * ssrc_t[s_ids][:, :, None]
         if group > 1:
             C = s_ids.shape[0]
             y = jnp.einsum("gwij,gwjf->gif",
@@ -522,6 +549,8 @@ def aggregate_block_dense(x: jax.Array, a_blocks: jax.Array,
         else:
             y = jnp.einsum("bij,bjf->bif", a_u8.astype(compute), gx,
                            preferred_element_type=jnp.float32)
+        if sdst_t is not None:
+            y = y * sdst_t[d_ids][:, :, None]
         # several blocks/groups can share a dst tile within one chunk
         # -> NOT unique; the plan's dst-major sort keeps them sorted
         return out.at[d_ids].add(y, indices_are_sorted=True), None

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def inv_sqrt_degree(in_degree: jax.Array) -> jax.Array:
@@ -25,6 +26,19 @@ def inv_sqrt_degree(in_degree: jax.Array) -> jax.Array:
     degree 0; the reference never sees deg 0 thanks to self edges)."""
     deg = in_degree.astype(jnp.float32)
     return jnp.where(deg > 0, jax.lax.rsqrt(jnp.maximum(deg, 1.0)), 0.0)
+
+
+def inv_sqrt_degree_np(in_degree: np.ndarray) -> np.ndarray:
+    """Host-side :func:`inv_sqrt_degree` (fp32) — the d vector the
+    fused-aggregation weight-table builders bake into the tables
+    (core/ell.py ell_weight_tables / SectionedEll.weight_tables,
+    parallel/ring.py ring_weight_tables).  Must stay numerically
+    identical to the traced form: same max(deg, 1) clamp, same
+    zero-degree mapping."""
+    deg = np.asarray(in_degree, dtype=np.float32)
+    return np.where(deg > 0,
+                    1.0 / np.sqrt(np.maximum(deg, 1.0)),
+                    0.0).astype(np.float32)
 
 
 def indegree_norm(x: jax.Array, in_degree: jax.Array,

@@ -49,6 +49,20 @@ from ..train.trainer import (TrainConfig, cast_floats, compute_dtype_of,
                              remat_policy, resolve_symmetric)
 
 
+def _shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across jax versions: the stable API (with
+    ``check_vma``) when present, else the ``jax.experimental``
+    form (jax <= 0.4.x, whose flag spells ``check_rep``).  Replica
+    checking stays off either way — the step functions psum
+    explicitly."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm
+    return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              check_rep=False)
+
+
 def make_mesh(num_parts: Optional[int] = None,
               devices: Optional[List] = None) -> Mesh:
     """1-D mesh over graph partitions.  One partition per device — the
@@ -155,16 +169,29 @@ class ShardedData:
     # padded slots / real edges of the ring tables (halo='ring' only);
     # surfaced so trainer setup can echo the SPMD-uniformity cost
     ring_padding_ratio: Optional[float] = None
+    # fused-normalization weight tables (aggr_fuse, shapes mirror the
+    # index tables they weight): per-bucket ell weights, per-section
+    # sectioned weights, () or ([P, S, pair_edges],) ring weights,
+    # () or (d_dst [P, vpad], d_src [P, src_vpad]) bdense tile scales.
+    # Empty = the step derives d from in_degree and scales in-op.
+    ell_w: Tuple[jax.Array, ...] = ()
+    sect_w: Tuple[jax.Array, ...] = ()
+    ring_w: Tuple[jax.Array, ...] = ()
+    bd_scale: Tuple[jax.Array, ...] = ()
 
 
 def _sectioned_tables(ptrs: np.ndarray, cols: np.ndarray,
                       pg: PartitionedGraph, src_rows: int,
                       section_rows: Optional[int], sect_sub_w: int,
-                      sect_u16: bool, put):
+                      sect_u16: bool, put,
+                      fuse_d: Optional[Tuple[np.ndarray,
+                                             np.ndarray]] = None):
     """Build + upload the stacked per-part sectioned tables — shared
     by the 'sectioned' branch (whole CSR) and the 'bdense' branch
     (residual CSR), so tuning knobs apply to both in one place.
-    Returns (sect_idx, sect_sub_dst, sect_meta)."""
+    ``fuse_d`` = (d_dst [P, part_nodes], d_src [gathered_rows]) also
+    bakes + uploads the fused-normalization weight tables.
+    Returns (sect_idx, sect_sub_dst, sect_meta, sect_w)."""
     from ..core.ell import (default_section_rows,
                             sectioned_from_padded_parts)
     if section_rows is None:
@@ -174,9 +201,14 @@ def _sectioned_tables(ptrs: np.ndarray, cols: np.ndarray,
         section_rows=section_rows, sub_w=sect_sub_w)
     if sect_u16:
         sect = sect.with_idx_dtype(np.uint16)
+    sect_w = ()
+    if fuse_d is not None:
+        sect_w = tuple(put(w) for w in
+                       sect.weight_tables(fuse_d[0], fuse_d[1]))
     return (tuple(put(a) for a in sect.idx),
             tuple(put(a) for a in sect.sub_dst),
-            tuple(zip(sect.sec_starts, sect.sec_sizes)))
+            tuple(zip(sect.sec_starts, sect.sec_sizes)),
+            sect_w)
 
 
 def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
@@ -187,7 +219,8 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                   sect_sub_w: int = 8, sect_u16: bool = False,
                   bdense_min_fill: int = 64,
                   bdense_a_budget: Optional[int] = 2 << 30,
-                  bdense_group: int = 1
+                  bdense_group: int = 1,
+                  aggr_fuse: bool = False
                   ) -> ShardedData:
     """Build + upload the stacked per-part arrays.  ``put`` overrides
     the upload (default: replicated-process ``device_put`` with the
@@ -195,7 +228,12 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     uploader for multi-host runs.  ``sect_sub_w``/``sect_u16`` tune the
     sectioned layout exactly like the single-device path
     (train/trainer.py build_graph_context) — user-selected config is
-    never silently dropped."""
+    never silently dropped.
+
+    ``aggr_fuse=True`` bakes the symmetric ``D^-1/2`` scales into the
+    tables (fused-aggregation weight tables / bdense tile scales) for
+    models rewritten by ``Model.fuse_norm_aggregate``; without them
+    the fused step still runs correctly via in-op scaling."""
     sh = NamedSharding(mesh, P("parts"))
     if put is None:
         put = lambda x: jax.device_put(x, sh)
@@ -211,12 +249,28 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     bd_src_vpad = 0
     bd_occupancy = ()
     ring_padding_ratio = None
+    ell_w = ()
+    sect_w = ()
+    ring_w = ()
+    bd_scale = ()
+    fuse_d = None
+    if aggr_fuse:
+        # d in both coordinate systems the tables index with: local
+        # padded rows per part (padding rows have degree 0 -> 0) and
+        # the flattened gathered layout
+        from ..ops.norm import inv_sqrt_degree_np
+        d_parts = inv_sqrt_degree_np(pg.part_in_degree)
+        fuse_d = (d_parts, d_parts.reshape(-1))
     if halo == "ring":
         # ring tables fully describe the aggregation — skip the O(E)
         # per-edge array construction entirely and upload stubs
-        from .ring import build_ring_tables
+        from .ring import build_ring_tables, ring_weight_tables
         rt = build_ring_tables(pg)
         ring_idx = (put(rt.src), put(rt.dst))
+        if aggr_fuse:
+            from ..ops.norm import inv_sqrt_degree_np as _inv
+            ring_w = (put(ring_weight_tables(
+                pg, rt, _inv(dataset.graph.in_degree))),)
         ring_padding_ratio = rt.padding_ratio
         col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
         edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
@@ -239,12 +293,17 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
             ell_idx = tuple(put(a) for a in table.idx)
             ell_row_pos = put(table.row_pos)
             ell_row_id = tuple(put(a) for a in table.row_id)
+            if aggr_fuse and aggr_impl == "ell":
+                from ..core.ell import ell_weight_tables
+                ell_w = tuple(put(w) for w in ell_weight_tables(
+                    table, fuse_d[0], fuse_d[1]))
         elif aggr_impl == "sectioned":
-            sect_idx, sect_sub_dst, sect_meta = _sectioned_tables(
-                pg.part_row_ptr, col_padded, pg,
-                src_rows=pg.num_parts * pg.part_nodes,
-                section_rows=section_rows, sect_sub_w=sect_sub_w,
-                sect_u16=sect_u16, put=put)
+            sect_idx, sect_sub_dst, sect_meta, sect_w = \
+                _sectioned_tables(
+                    pg.part_row_ptr, col_padded, pg,
+                    src_rows=pg.num_parts * pg.part_nodes,
+                    section_rows=section_rows, sect_sub_w=sect_sub_w,
+                    sect_u16=sect_u16, put=put, fuse_d=fuse_d)
         elif aggr_impl == "bdense":
             # per-partition block-dense plans over the RECTANGULAR
             # tile space (local dst rows x gathered source coords —
@@ -313,6 +372,18 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                     sblk[p, :nb] = pl.src_blk
                     dblk[p, :nb] = pl.dst_blk
                 bd_tabs = (put(a), put(sblk), put(dblk))
+                if aggr_fuse:
+                    # in-register tile scales (ops/blockdense.py):
+                    # dst covers local padded rows, src the gathered
+                    # layout (identical on every part — replicated
+                    # rows keep the stacked-upload convention)
+                    dd = np.zeros((pg.num_parts, bd_vpad), np.float32)
+                    dd[:, :pg.part_nodes] = fuse_d[0]
+                    ds1 = np.zeros(bd_src_vpad, np.float32)
+                    ds1[:src_rows] = fuse_d[1]
+                    ds = np.broadcast_to(
+                        ds1, (pg.num_parts, bd_src_vpad)).copy()
+                    bd_scale = (put(dd), put(ds))
             # residual scattered edges -> the stacked sectioned tables
             # (every edge, when no tile qualifies anywhere)
             e_res = max(max(pl.res_col.shape[0] for pl in plans), 1)
@@ -320,10 +391,11 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
             res_cols = np.zeros((pg.num_parts, e_res), dtype=np.int32)
             for p, pl in enumerate(plans):
                 res_cols[p, :pl.res_col.shape[0]] = pl.res_col
-            sect_idx, sect_sub_dst, sect_meta = _sectioned_tables(
-                res_ptrs, res_cols, pg, src_rows=src_rows,
-                section_rows=section_rows, sect_sub_w=sect_sub_w,
-                sect_u16=sect_u16, put=put)
+            sect_idx, sect_sub_dst, sect_meta, sect_w = \
+                _sectioned_tables(
+                    res_ptrs, res_cols, pg, src_rows=src_rows,
+                    section_rows=section_rows, sect_sub_w=sect_sub_w,
+                    sect_u16=sect_u16, put=put, fuse_d=fuse_d)
         elif aggr_impl == "attn_flat8":
             # large-graph attention, sharded: per-partition SINGLE-
             # section tables over gathered coordinates (one uniform
@@ -361,6 +433,10 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
         bd_occupancy=bd_occupancy,
         bd_group=bdense_group if bd_tabs else 1,
         ring_padding_ratio=ring_padding_ratio,
+        ell_w=ell_w,
+        sect_w=sect_w,
+        ring_w=ring_w,
+        bd_scale=bd_scale,
     )
 
 
@@ -401,8 +477,23 @@ class DistributedTrainer:
                  mesh: Optional[Mesh] = None,
                  data: Optional[ShardedData] = None,
                  pg=None):
+        from ..train.trainer import (apply_memory_autopilot,
+                                     resolve_auto_impl_early,
+                                     resolve_fuse)
+        model = resolve_fuse(model, config)
         self.model = model
-        from ..train.trainer import apply_memory_autopilot
+        # the shared 'auto' rule incl. the bdense structure probe (the
+        # global dense fraction is the right proxy: per-part plans
+        # tile contiguous local row ranges of the same vertex order).
+        # The gather-table bound uses the GLOBAL node count, the
+        # scatter-carry bound the per-partition output rows
+        # (resolve_auto_impl docstring).  Multi-process runs skip the
+        # probe — every SPMD process must resolve identically.
+        v = dataset.graph.num_nodes
+        config, _ = resolve_auto_impl_early(
+            model, config, dataset.graph,
+            out_rows=-(-v // num_parts),
+            multiprocess=jax.process_count() > 1)
         config = apply_memory_autopilot(model, dataset, config,
                                         num_parts=num_parts)
         if config.features == "host":
@@ -410,25 +501,6 @@ class DistributedTrainer:
                 "features='host' streaming is single-device only; the "
                 "distributed >HBM mechanism is halo='ring' (the "
                 "autopilot picks it automatically for parts > 1)")
-        if config.aggr_impl == "auto":
-            # shared rule incl. the bdense structure probe (the global
-            # dense fraction is the right proxy: per-part plans tile
-            # contiguous local row ranges of the same vertex order).
-            # The gather-table bound uses the GLOBAL node count, the
-            # scatter-carry bound the per-partition output rows
-            # (resolve_auto_impl docstring).  Multi-process runs skip
-            # the probe — every SPMD process must resolve identically.
-            import jax as _jax
-            from ..train.trainer import resolve_auto_impl_probed
-            v = dataset.graph.num_nodes
-            impl, _ = resolve_auto_impl_probed(
-                dataset.graph, out_rows=-(-v // num_parts),
-                bdense_min_fill=config.bdense_min_fill,
-                bdense_a_budget=config.bdense_a_budget,
-                bdense_group=config.bdense_group,
-                verbose=config.verbose,
-                multiprocess=_jax.process_count() > 1)
-            config = dc_replace(config, aggr_impl=impl)
         from ..train.trainer import resolve_attention_impl
         # dataset passed: attention models past ATTN_FLAT8_MIN_EDGES
         # auto-route to the uniform flat8 layout here too —
@@ -462,7 +534,8 @@ class DistributedTrainer:
             sect_u16=config.sect_u16,
             bdense_min_fill=config.bdense_min_fill,
             bdense_a_budget=config.bdense_a_budget,
-            bdense_group=config.bdense_group)
+            bdense_group=config.bdense_group,
+            aggr_fuse=model.num_fused_aggregates() > 0)
         if config.aggr_impl == "bdense" and config.halo != "ring" \
                 and data is None:
             # own build only: injected data carries no plan to report
@@ -620,13 +693,17 @@ class DistributedTrainer:
 
     def _local_gctx(self, edge_src, edge_dst, in_degree, ell_idx,
                     ell_row_pos, ell_row_id, ring_idx, sect_idx,
-                    sect_sub_dst, bd_tabs=()) -> GraphContext:
+                    sect_sub_dst, bd_tabs=(),
+                    fuse_tabs=((), (), (), ())) -> GraphContext:
         """Local-block GraphContext for a shard_map body: slice the
         parts axis off every table.  attn_flat8 carries its single-
         section tables in the sect slots (ShardedData docstring) and
         routes them to the flat8 fields the builder reads; bdense
-        carries its residual there and its dense tiles in bd_tabs."""
+        carries its residual there and its dense tiles in bd_tabs.
+        ``fuse_tabs`` = (ell_w, sect_w, ring_w, bd_scale) — the baked
+        fused-normalization weights (empty tuples when unfused)."""
         flat8 = self.config.aggr_impl == "attn_flat8"
+        ell_w, sect_w, ring_w, bd_scale = fuse_tabs
         return dc_replace(
             self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
             in_degree=in_degree,
@@ -641,7 +718,11 @@ class DistributedTrainer:
             flat8_dst=sect_sub_dst[0][0] if flat8 else None,
             bd_a=bd_tabs[0][0] if bd_tabs else None,
             bd_src=bd_tabs[1][0] if bd_tabs else None,
-            bd_dst=bd_tabs[2][0] if bd_tabs else None)
+            bd_dst=bd_tabs[2][0] if bd_tabs else None,
+            ell_w=tuple(a[0] for a in ell_w),
+            sect_w=tuple(a[0] for a in sect_w),
+            ring_w=ring_w[0][0] if ring_w else None,
+            bd_scale=tuple(a[0] for a in bd_scale))
 
     def _build_train_step(self):
         mesh = self.mesh
@@ -650,13 +731,14 @@ class DistributedTrainer:
 
         def step(params, opt_state, feats, labels, mask, edge_src,
                  edge_dst, in_degree, ell_idx, ell_row_pos, ell_row_id,
-                 ring_idx, sect_idx, sect_sub_dst, bd_tabs, key, lr):
+                 ring_idx, sect_idx, sect_sub_dst, bd_tabs, fuse_tabs,
+                 key, lr):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
             gctx = self._local_gctx(
                 edge_src[0], edge_dst[0], in_degree[0], ell_idx,
                 ell_row_pos, ell_row_id, ring_idx, sect_idx,
-                sect_sub_dst, bd_tabs)
+                sect_sub_dst, bd_tabs, fuse_tabs)
             part_key = jax.random.fold_in(key, lax.axis_index("parts"))
 
             def local_loss(p):
@@ -679,18 +761,18 @@ class DistributedTrainer:
                                             self.adam_cfg)
             return params, opt_state, loss
 
-        sm = jax.shard_map(
+        sm = _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_r, spec_r),
-            out_specs=(spec_r, spec_r, spec_r),
-            check_vma=False)
+                      spec_p, spec_p, spec_p, spec_p, spec_r, spec_r),
+            out_specs=(spec_r, spec_r, spec_r))
         return jax.jit(sm, donate_argnums=(0, 1))
 
     def _local_forward(self, params, feats, edge_src, edge_dst,
                        in_degree, ell_idx, ell_row_pos, ell_row_id,
-                       ring_idx, sect_idx, sect_sub_dst, bd_tabs):
+                       ring_idx, sect_idx, sect_sub_dst, bd_tabs,
+                       fuse_tabs=((), (), (), ())):
         """Shared shard_map body: slice the parts axis off the local
         blocks, assemble the local GraphContext, run the inference
         forward — eval (adds metrics+psum) and predict (adds
@@ -700,7 +782,7 @@ class DistributedTrainer:
         gctx = self._local_gctx(
             edge_src[0], edge_dst[0], in_degree[0], ell_idx,
             ell_row_pos, ell_row_id, ring_idx, sect_idx, sect_sub_dst,
-            bd_tabs)
+            bd_tabs, fuse_tabs)
         return self.model.apply(cast_floats(params, self.compute),
                                 feats, gctx, key=None, train=False)
 
@@ -715,12 +797,12 @@ class DistributedTrainer:
             return jax.tree_util.tree_map(
                 lambda t: lax.psum(t, "parts"), m)
 
-        sm = jax.shard_map(
+        sm = _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p),
-            out_specs=spec_r, check_vma=False)
+                      spec_p, spec_p, spec_p),
+            out_specs=spec_r)
         return jax.jit(sm)
 
     # ---- loop ----
@@ -734,7 +816,9 @@ class DistributedTrainer:
                 self.params, self.opt_state, d.feats, d.labels,
                 d.mask, d.edge_src, d.edge_dst, d.in_degree,
                 d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
-                d.sect_idx, d.sect_sub_dst, d.bd_tabs, step_key, lr)
+                d.sect_idx, d.sect_sub_dst, d.bd_tabs,
+                (d.ell_w, d.sect_w, d.ring_w, d.bd_scale),
+                step_key, lr)
 
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
@@ -751,7 +835,7 @@ class DistributedTrainer:
             self.params, d.feats, d.labels, d.mask, d.edge_src,
             d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
             d.ell_row_id, d.ring_idx, d.sect_idx, d.sect_sub_dst,
-            d.bd_tabs)))
+            d.bd_tabs, (d.ell_w, d.sect_w, d.ring_w, d.bd_scale))))
         m["epoch"] = epoch
         return m
 
@@ -770,7 +854,8 @@ class DistributedTrainer:
         logits = jax.device_get(self._predict_step(
             self.params, d.feats, d.edge_src, d.edge_dst, d.in_degree,
             d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
-            d.sect_idx, d.sect_sub_dst, d.bd_tabs))
+            d.sect_idx, d.sect_sub_dst, d.bd_tabs,
+            (d.ell_w, d.sect_w, d.ring_w, d.bd_scale)))
         return unpad_nodes(logits, self.pg)
 
     def _build_predict_step(self):
@@ -783,9 +868,10 @@ class DistributedTrainer:
             # replicated [P, part_nodes, C]
             return lax.all_gather(logits, "parts", axis=0)
 
-        sm = jax.shard_map(
+        sm = _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p),
-            out_specs=spec_r, check_vma=False)
+                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
+                      spec_p),
+            out_specs=spec_r)
         return jax.jit(sm)

@@ -130,9 +130,38 @@ def build_ring_tables(pg: PartitionedGraph) -> RingTables:
     return RingTables(src=src, dst=dst, padding_ratio=float(ratio))
 
 
+def ring_weight_tables(pg: PartitionedGraph, rt: RingTables,
+                       d_global: np.ndarray) -> np.ndarray:
+    """Baked fused-normalization weights for the ring tables
+    (:func:`ring_aggregate` ``weights``): fp32 ``[P, S, pair_edges]``
+    with ``w = d[dst_global] * d[src_global]`` — the per-edge entries
+    of ``D^-1/2 A D^-1/2`` in ring layout, so the fused aggregation
+    runs the rotation with ZERO runtime normalization.  Padding slots
+    (dummy source id ``part_nodes``) weigh 0; ``d_global`` is the
+    inv-sqrt in-degree vector over ORIGINAL vertex ids [V]."""
+    P, S, pe = rt.src.shape
+    offsets = np.asarray([l for l, _ in pg.bounds] + [pg.num_nodes],
+                         dtype=np.int64)
+    starts = np.minimum(offsets[:P], pg.num_nodes)
+    d = np.asarray(d_global, dtype=np.float32)
+    w = np.zeros((P, S, pe), dtype=np.float32)
+    for p in range(P):
+        # padding dst slots use part_nodes - 1 (may exceed the real
+        # rows); clip for the lookup — the src dummy mask zeroes them
+        dstg = np.minimum(starts[p] + rt.dst[p].astype(np.int64),
+                          pg.num_nodes - 1)
+        for s in range(S):
+            srcl = rt.src[p, s].astype(np.int64)
+            real = srcl < pg.part_nodes
+            srcg = np.minimum(starts[s] + srcl, pg.num_nodes - 1)
+            w[p, s] = np.where(real, d[dstg[s]] * d[srcg], 0.0)
+    return w
+
+
 def ring_aggregate(x: jax.Array, ring_src: jax.Array,
                    ring_dst: jax.Array, axis_name: str = "parts",
-                   edge_chunk: int = 1 << 17) -> jax.Array:
+                   edge_chunk: int = 1 << 17,
+                   weights: Optional[jax.Array] = None) -> jax.Array:
     """SPMD ring aggregation (call inside shard_map).
 
     x: [part_nodes, F] this device's shard.
@@ -142,6 +171,10 @@ def ring_aggregate(x: jax.Array, ring_src: jax.Array,
     edges (bounding the [C, F] gather transient) and scatter-adds with
     ``indices_are_sorted`` (dst-sorted within every pair by
     construction).
+
+    ``weights`` (optional): [S, pair_edges] per-edge weights
+    (:func:`ring_weight_tables` — the baked fused-norm scales),
+    applied to the gathered rows in-register before the scatter-add.
     """
     S, pair_edges = ring_src.shape
     n, F = x.shape
@@ -152,15 +185,19 @@ def ring_aggregate(x: jax.Array, ring_src: jax.Array,
         C //= 2
     n_chunks = pair_edges // C
 
-    def local_pair(out, buf_ext, src_e, dst_e):
+    def local_pair(out, buf_ext, src_e, dst_e, w_e):
+        xs = (src_e.reshape(n_chunks, C), dst_e.reshape(n_chunks, C))
+        if w_e is not None:
+            xs += (w_e.reshape(n_chunks, C),)
+
         def chunk_body(out, args):
-            s_c, d_c = args
+            s_c, d_c = args[0], args[1]
             g = buf_ext[s_c]
+            if len(args) > 2:
+                g = g * args[2][:, None].astype(g.dtype)
             return out.at[d_c].add(g, indices_are_sorted=True,
                                    unique_indices=False), None
-        out, _ = lax.scan(chunk_body, out,
-                          (src_e.reshape(n_chunks, C),
-                           dst_e.reshape(n_chunks, C)))
+        out, _ = lax.scan(chunk_body, out, xs)
         return out
 
     def step(k, carry):
@@ -170,9 +207,12 @@ def ring_aggregate(x: jax.Array, ring_src: jax.Array,
                                          keepdims=False)
         dst_e = lax.dynamic_index_in_dim(ring_dst, src_shard, axis=0,
                                          keepdims=False)
+        w_e = (lax.dynamic_index_in_dim(weights, src_shard, axis=0,
+                                        keepdims=False)
+               if weights is not None else None)
         buf_ext = jnp.concatenate(
             [buf, jnp.zeros((1, F), dtype=buf.dtype)], axis=0)
-        out = local_pair(out, buf_ext, src_e, dst_e)
+        out = local_pair(out, buf_ext, src_e, dst_e, w_e)
         # rotate for the next step (skipped work on the last step is
         # harmless; keeping it unconditional lets XLA overlap the
         # permute with this step's aggregation)

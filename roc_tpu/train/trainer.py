@@ -43,6 +43,17 @@ class TrainConfig:
     # core/ell.py resolve_auto_impl)
     aggr_impl: str = "segment"
     chunk: int = 512
+    # Aggregation fusion (auto|on|off): rewrite every norm ->
+    # sum-aggregate -> norm [-> relu] chain into ONE fused op
+    # (models/builder.py fuse_norm_aggregate) with the symmetric
+    # D^-1/2 scales baked into the host-built tables where the layout
+    # allows (ell/sectioned/bdense/ring — core/ell.py weight tables)
+    # and fused pre/post scaling elsewhere.  Exact linear algebra:
+    # forward and gradients match the unfused chain to fp32 tolerance
+    # (tests/test_fused_agg.py).  "auto" fuses whenever the model has
+    # a matching chain; "on" additionally echoes when nothing fused;
+    # "off" keeps the reference's separate-op semantics.
+    aggr_fuse: str = "auto"
     dtype: Any = jnp.float32
     # Mixed precision: when set (e.g. jnp.bfloat16), params + Adam
     # state stay in ``dtype`` (fp32 master weights) while features,
@@ -229,6 +240,35 @@ def resolve_attention_impl(model, config: TrainConfig,
     return dataclasses.replace(config, aggr_impl="ell")
 
 
+def resolve_fuse(model: Model, config: TrainConfig) -> Model:
+    """``aggr_fuse`` resolution — ONE place for the rule (both
+    trainers): 'off' leaves the model alone; 'auto'/'on' rewrite the
+    fusable ``norm -> aggregate -> norm [-> relu]`` chains into fused
+    ops (models/builder.py fuse_norm_aggregate).  Returns the model to
+    train — the ORIGINAL object when nothing fused, so callers can
+    compare identity.  Parameter names are untouched either way."""
+    if config.aggr_fuse == "off":
+        return model
+    if config.aggr_fuse not in ("auto", "on"):
+        raise ValueError(
+            f"unknown aggr_fuse {config.aggr_fuse!r}; expected "
+            "'auto', 'on', or 'off'")
+    fused = model.fuse_norm_aggregate()
+    n = fused.num_fused_aggregates()
+    import sys
+    if n == 0:
+        if config.aggr_fuse == "on":
+            # an explicit request that changes nothing must say so
+            print("# aggr_fuse='on': no fusable norm->aggregate->norm "
+                  "chain in this model — running unfused",
+                  file=sys.stderr)
+        return model
+    if config.verbose:
+        print(f"# aggr_fuse: {n} norm->aggregate->norm chain(s) "
+              f"folded into the aggregation", file=sys.stderr)
+    return fused
+
+
 def resolve_symmetric(dataset: Dataset,
                       symmetric: Optional[bool]) -> bool:
     if symmetric is None:
@@ -251,11 +291,13 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
     from ..core.memory import choose_memory_plan
     dims = [model._ops[0].dim] + [op.dim for op in model._ops
                                   if op.kind == "linear"]
-    # explicit bdense keeps an A-table resident next to the model;
-    # its worst case is the planner's device-byte cap.  'auto' does
-    # NOT pre-charge it (the probe usually rejects, and charging it
-    # would push marginal uniform-graph configs into remat for
-    # nothing); an uncapped budget is unmodelable — the occupancy
+    # bdense keeps an A-table resident next to the model; its worst
+    # case is the planner's device-byte cap.  The trainers resolve
+    # aggr_impl='auto' (incl. the bdense structure probe) BEFORE
+    # calling the autopilot, so a probe-selected bdense is charged
+    # here exactly like an explicit one — the planner and the actual
+    # residency can no longer disagree by up to the A budget (round-5
+    # advisor).  An uncapped budget is unmodelable — the occupancy
     # echo is the warning there.  Attention/MAX models never keep the
     # table either: resolve_attention_impl (which runs AFTER the
     # autopilot, because it must see the chosen halo) rewrites their
@@ -330,6 +372,32 @@ def resolve_auto_impl_probed(graph, out_rows: Optional[int] = None, *,
     return impl, None
 
 
+def resolve_auto_impl_early(model: Model, config: TrainConfig, graph,
+                            out_rows: Optional[int] = None,
+                            multiprocess: bool = False):
+    """``aggr_impl='auto'`` resolution shared by BOTH trainer
+    constructors — ONE home for the rule: the measured window split +
+    bdense structure probe run BEFORE the memory autopilot, so a
+    probe-selected bdense A-table is charged into the memory plan and
+    the remat downgrade applies (round-5 advisor).  Attention/MAX
+    models skip (resolve_attention_impl rewrites their impl anyway
+    and they never keep the A-table); ``features='host'`` skips (its
+    graph tables may never be built — the placeholder/late path
+    resolves lazily, and paying the ~1 s census for it would be pure
+    startup cost).  Returns ``(config, census)``."""
+    if config.aggr_impl != "auto" or config.features == "host" \
+            or model.uses_attention() or model.uses_max_aggregation():
+        return config, None
+    impl, census = resolve_auto_impl_probed(
+        graph, out_rows=out_rows,
+        bdense_min_fill=config.bdense_min_fill,
+        bdense_a_budget=config.bdense_a_budget,
+        bdense_group=config.bdense_group,
+        verbose=config.verbose,
+        multiprocess=multiprocess)
+    return dc_replace(config, aggr_impl=impl), census
+
+
 def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        chunk: int = 512,
                        symmetric: Optional[bool] = None,
@@ -338,20 +406,32 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        bdense_min_fill: int = 64,
                        bdense_a_budget: Optional[int] = 2 << 30,
                        bdense_group: int = 1,
-                       verbose: bool = False) -> GraphContext:
+                       verbose: bool = False,
+                       fuse: bool = False,
+                       bd_census=None) -> GraphContext:
     """Single-device GraphContext: edges padded to the chunk multiple,
     dummy source id == num_nodes (the appended zero row).
     ``sect_sub_w``/``sect_u16`` tune the sectioned layout and
     ``bdense_min_fill`` the block-dense split (TrainConfig fields of
     the same names); ``verbose`` gates the informational echoes (the
-    impl-override ones stay unconditional)."""
+    impl-override ones stay unconditional).
+
+    ``fuse=True`` additionally bakes the symmetric ``D^-1/2`` scales
+    into the tables (fused-aggregation weight tables / bdense tile
+    scales) for models rewritten by ``Model.fuse_norm_aggregate``;
+    ``bd_census`` reuses a probe census from an earlier
+    :func:`resolve_auto_impl_probed` call (the trainers resolve
+    'auto' before the memory autopilot and pass it through)."""
     g = dataset.graph
-    bd_census = None
     if aggr_impl == "auto":
         aggr_impl, bd_census = resolve_auto_impl_probed(
             g, bdense_min_fill=bdense_min_fill,
             bdense_a_budget=bdense_a_budget,
             bdense_group=bdense_group, verbose=verbose)
+    d_np = None
+    if fuse:
+        from ..ops.norm import inv_sqrt_degree_np
+        d_np = inv_sqrt_degree_np(g.in_degree)
     ell_idx: tuple = ()
     ell_row_pos = None
     sect_idx: tuple = ()
@@ -360,6 +440,9 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     flat8_idx = flat8_dst = None
     bd_a = bd_src = bd_dst = None
     bd_vpad = 0
+    ell_w: tuple = ()
+    sect_w: tuple = ()
+    bd_scale: tuple = ()
     if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
                      "bdense"):
         # these paths never read the flat edge arrays — don't upload
@@ -377,6 +460,13 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         ell_idx = tuple(jnp.asarray(a[0]) for a in table.idx)
         ell_row_pos = jnp.asarray(table.row_pos[0])
         ell_row_id = tuple(jnp.asarray(a[0]) for a in table.row_id)
+        if fuse and aggr_impl == "ell":
+            # 'pallas' derives d in-trace instead (the fused kernel
+            # route scales rows, not table entries)
+            from ..core.ell import ell_weight_tables
+            ell_w = tuple(
+                jnp.asarray(w[0]) for w in ell_weight_tables(
+                    table, d_np[None, :], d_np))
     elif aggr_impl == "sectioned":
         from ..core.ell import default_section_rows, sectioned_from_graph
         sect = sectioned_from_graph(
@@ -386,6 +476,9 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         if sect_u16:
             sect = sect.with_idx_dtype(np.uint16)
         sect_idx, sect_sub_dst, sect_meta = sect.as_jax()
+        if fuse:
+            sect_w = tuple(jnp.asarray(w)
+                           for w in sect.weight_tables(d_np, d_np))
     elif aggr_impl == "bdense":
         # block-dense MXU aggregation: dense [128,128] adjacency tiles
         # as uint8 multiplicity tables, scattered residual through the
@@ -421,6 +514,14 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
             print(f"# bdense: no [128,128] tile reaches min_fill="
                   f"{bdense_min_fill} on this graph/order — running "
                   f"the sectioned residual only", file=_sys.stderr)
+        if fuse:
+            # in-register tile scales (ops/blockdense.py scale_dst/
+            # scale_src) — the integer A-table stays intact
+            dd = np.zeros(plan.vpad, np.float32)
+            dd[:g.num_nodes] = d_np
+            ds = np.zeros(plan.src_vpad, np.float32)
+            ds[:g.num_nodes] = d_np
+            bd_scale = (jnp.asarray(dd), jnp.asarray(ds))
         if plan.res_col.shape[0]:
             # same tuning knobs as the 'sectioned' branch — bdense's
             # residual must not silently drop user-selected config
@@ -431,6 +532,9 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
             if sect_u16:
                 sect = sect.with_idx_dtype(np.uint16)
             sect_idx, sect_sub_dst, sect_meta = sect.as_jax()
+            if fuse:
+                sect_w = tuple(jnp.asarray(w)
+                               for w in sect.weight_tables(d_np, d_np))
     elif aggr_impl == "attn_flat8":
         # large-graph attention: ONE section spanning all sources
         # (global ids, dummy == num_nodes == the appended zero row),
@@ -467,6 +571,9 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         bd_dst=bd_dst,
         bd_vpad=bd_vpad,
         bd_group=bdense_group if bd_a is not None else 1,
+        ell_w=ell_w,
+        sect_w=sect_w,
+        bd_scale=bd_scale,
     )
 
 
@@ -475,7 +582,10 @@ class Trainer:
 
     def __init__(self, model: Model, dataset: Dataset,
                  config: TrainConfig = TrainConfig()):
+        model = resolve_fuse(model, config)
         self.model = model
+        config, bd_census = resolve_auto_impl_early(
+            model, config, dataset.graph)
         config = apply_memory_autopilot(model, dataset, config)
         config = resolve_attention_impl(model, config, dataset)
         self.config = config
@@ -538,7 +648,7 @@ class Trainer:
             self.feats = jnp.asarray(dataset.features,
                                      dtype=self.compute)
         if self._head is not None and not any(
-                op.kind in ("scatter_gather", "gat")
+                op.kind in ("scatter_gather", "gat", "fused_aggregate")
                 for op in self._tail_model._ops):
             # the model's whole graph part ran in the host-side
             # precompute (SGC): don't build O(E) tables nobody reads
@@ -563,15 +673,14 @@ class Trainer:
                 bdense_min_fill=config.bdense_min_fill,
                 bdense_a_budget=config.bdense_a_budget,
                 bdense_group=config.bdense_group,
-                verbose=config.verbose)
+                verbose=config.verbose,
+                fuse=model.num_fused_aggregates() > 0,
+                bd_census=bd_census)
             if config.aggr_impl == "auto":
-                # reflect the resolved impl (the probe/window choice)
-                # so recorded artifacts and callers reading
-                # trainer.config.aggr_impl see what actually runs —
-                # the DistributedTrainer already writes its resolution
-                # back.  Gated on 'auto': the host-features branch
-                # above builds a placeholder context whose impl must
-                # never overwrite an explicit user choice.
+                # attention/MAX models reach here with 'auto' already
+                # rewritten by resolve_attention_impl; any other
+                # residue resolves inside make_graph_context — reflect
+                # it so artifacts record what actually runs
                 self.config = dc_replace(self.config,
                                          aggr_impl=self.gctx.aggr_impl)
         # Dataset tensors are jitted *arguments*, not closure captures:

@@ -1,0 +1,34 @@
+#!/bin/sh
+# Round-6 measurement queue — the fused-normalization race (ISSUE 1:
+# table-baked D^-1/2 scales + fused epilogue).  Run whole or per-step
+# on a live chip; each step records its own artifacts
+# (benchmarks/*.jsonl / measured_baselines.json).  The acceptance
+# claim is >= 1.15x on at least one impl x substrate for the
+# aggregation path (chain-X vs fused-X below), or the checked-in
+# numbers as a written-up negative result.
+cd "$(dirname "$0")/.."
+set -x
+# 1. staged headline refresh (regression guard before the new rows)
+python bench.py
+# 2. fused vs chain micro race, UNIFORM substrate, Reddit V/E
+python benchmarks/micro_agg.py --dtype mixed \
+  --impls chain-ell,fused-ell,chain-sectioned,fused-sectioned \
+  --iters 10
+# 3. fused vs chain micro race, COMMUNITY substrate (the VERDICT
+#    weakness-2 co-track: the headline substrate must include
+#    community structure), incl. the bdense tile-scale fold
+python benchmarks/micro_agg.py --graph planted:16384 --reorder lpa \
+  --dtype mixed \
+  --impls chain-sectioned,fused-sectioned,chain-bdense:32:16,fused-bdense:32:16 \
+  --a-budget $((6<<30)) --iters 10
+# 4. hand-written kernel trio (pre-scale kernel -> ELL DMA kernel ->
+#    fused scale+relu epilogue) vs its unfused form — the
+#    configuration where the Pallas path races with fusion on its side
+python benchmarks/micro_agg.py --dtype float32 \
+  --impls chain-pallas,fused-pallas,chain-ell,fused-ell --iters 10
+# 5. epoch-level fused race on BOTH substrates (full GCN training
+#    epochs; the micro win must transfer end-to-end)
+python benchmarks/epoch_community.py --graph random --reorder none \
+  --impls sectioned,sectioned+fuse,ell,ell+fuse
+python benchmarks/epoch_community.py --min-fill 32 --a-budget $((6<<30)) \
+  --bdense-group 16 --impls bdense,bdense+fuse,sectioned,sectioned+fuse

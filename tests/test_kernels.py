@@ -8,7 +8,6 @@ import numpy as np
 import pytest
 
 import jax.numpy as jnp
-from jax.experimental.pallas import tpu as pltpu
 
 from roc_tpu.core.graph import add_self_edges, synthetic_graph
 from roc_tpu.core.partition import padded_edge_list
@@ -24,8 +23,7 @@ def test_graphnorm_pallas_matches_xla():
         [np.zeros(5, np.int32),  # padding rows -> zero output
          rng.randint(1, 50, size=95).astype(np.int32)]))
     want = indegree_norm(x, deg)
-    with pltpu.force_tpu_interpret_mode():
-        got = indegree_norm_pallas(x, deg, block=32)
+    got = indegree_norm_pallas(x, deg, block=32, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
 
@@ -36,8 +34,7 @@ def test_graphnorm_pallas_unaligned_rows():
     x = jnp.asarray(rng.randn(37, 8).astype(np.float32))
     deg = jnp.asarray(rng.randint(1, 9, size=37).astype(np.int32))
     want = indegree_norm(x, deg)
-    with pltpu.force_tpu_interpret_mode():
-        got = indegree_norm_pallas(x, deg, block=16)
+    got = indegree_norm_pallas(x, deg, block=16, interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-6)
 
@@ -97,9 +94,9 @@ def test_spmm_pallas_interpret_small():
     src, dst = padded_edge_list(g, multiple=64)
     want = aggregate_segment(jnp.asarray(feats), jnp.asarray(src),
                              jnp.asarray(dst), V)
-    with pltpu.force_tpu_interpret_mode():
-        got = csr_spmm_pallas(jnp.asarray(feats), jnp.asarray(src),
-                              jnp.asarray(dst), V, chunk=64)
+    got = csr_spmm_pallas(jnp.asarray(feats), jnp.asarray(src),
+                          jnp.asarray(dst), V, chunk=64,
+                          interpret=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
 

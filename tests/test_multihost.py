@@ -96,7 +96,11 @@ def test_predict_on_local_shards():
     want = tr.predict()
     assert want.shape == (96, 3)
     tr.data = mh.shard_dataset_local(ds, tr.pg, mesh, aggr_impl="ell")
-    np.testing.assert_allclose(tr.predict(), want, rtol=1e-5)
+    # atol: the global build carries baked fused-norm weight tables,
+    # the local-shards build scales in-op (same operator, different
+    # fp32 association) — near-zero logits need an absolute floor
+    np.testing.assert_allclose(tr.predict(), want, rtol=1e-5,
+                               atol=1e-6)
 
 
 def test_gat_trains_on_local_shards():
