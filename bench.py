@@ -84,6 +84,23 @@ _PROBE_INTERVAL = 210.0
 _PROBE_PROGRESS = "probe_progress.txt"
 
 
+def _light_obs_imports() -> None:
+    """Make ``roc_tpu.obs`` importable in the PARENT without executing
+    the package's heavy ``__init__`` (which imports jax).  The parent
+    is deliberately import-light — all jax work lives in stage
+    children under per-stage timeouts, so a wedged/slow jax import
+    must never eat the parent's deadline unobserved.  ``roc_tpu/obs``
+    and its modules are stdlib-only, so a namespace stub for the
+    parent package is all the import system needs.  No-op when the
+    real package is already loaded (in-process tests, children)."""
+    if "roc_tpu" in sys.modules:
+        return
+    import types
+    pkg = types.ModuleType("roc_tpu")
+    pkg.__path__ = [os.path.join(_HERE, "roc_tpu")]
+    sys.modules["roc_tpu"] = pkg
+
+
 def build_parser():
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=REDDIT_NODES)
@@ -521,14 +538,24 @@ def child_probe(args) -> dict:
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    _probe_note("jax imported; claiming backend")
+    _probe_note("jax imported")
+    # each heavy import gets its own note BEFORE the next phase label,
+    # so a wedge anywhere leaves the artifact pointing at the true
+    # culprit: "start" = jax import, "jax imported" = the roc_tpu
+    # package, "claiming backend" = the claim (with heartbeats)
+    from roc_tpu.obs.heartbeat import Heartbeat
+    _probe_note("roc_tpu imported; claiming backend")
     t0 = time.time()
-    dev = jax.devices()[0]
+    # the historical silent hang: a held claim / wedged relay used to
+    # time this child out with zero evidence — now it heartbeats
+    with Heartbeat("claiming backend"):
+        dev = jax.devices()[0]
     claim_s = time.time() - t0
     _probe_note(f"claimed in {claim_s:.1f}s; compiling matmul")
     t0 = time.time()
-    x = jnp.ones((1024, 1024))
-    _sync_fetch(x @ x)
+    with Heartbeat("probe matmul"):
+        x = jnp.ones((1024, 1024))
+        _sync_fetch(x @ x)
     _probe_note(f"matmul done in {time.time() - t0:.1f}s")
     return {"platform": dev.platform, "device_kind": dev.device_kind,
             "claim_s": round(claim_s, 2),
@@ -626,7 +653,9 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
 
     layers = [int(x) for x in args.layers.split("-")]
     t0 = time.time()
-    dev = jax.devices()[0]
+    from roc_tpu.obs.heartbeat import Heartbeat
+    with Heartbeat("claiming backend"):
+        dev = jax.devices()[0]
     print(f"# device: {dev.platform} {dev.device_kind} "
           f"(claim {time.time() - t0:.1f}s)", file=sys.stderr)
     if args.impl == "auto":
@@ -734,15 +763,25 @@ _TERM_GRACE = 45.0
 def _run_stage(name: str, timeout: float, argv,
                grace: float = _TERM_GRACE) -> dict:
     """Run one stage child under ``timeout``; returns its record
-    (``ok`` key tells success).  Persists the attempt immediately."""
+    (``ok`` key tells success).  Persists the attempt immediately.
+
+    The wait runs under a stall heartbeat (roc_tpu/obs): a wedged
+    stage emits "still waiting in bench:<stage>" events to stderr and
+    the events artifact BEFORE its timeout, so the round-5 failure
+    mode — every stage timing out with zero evidence — cannot recur."""
+    _light_obs_imports()
+    from roc_tpu.obs.heartbeat import Heartbeat, heartbeat_interval
     t0 = time.time()
     rec = {"stage": name, "t": _now_iso(), "timeout_s": round(timeout, 0)}
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child",
          "--stage", name] + argv,
         stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+    hb = Heartbeat(f"bench:{name}", heartbeat_interval(),
+                   timeout_s=round(timeout, 0))
     try:
-        out, _ = proc.communicate(timeout=timeout)
+        with hb:
+            out, _ = proc.communicate(timeout=timeout)
         if proc.returncode == 0:
             for line in reversed(out.splitlines()):
                 line = line.strip()
@@ -765,14 +804,18 @@ def _run_stage(name: str, timeout: float, argv,
             proc.communicate()
         rec.update(ok=False, error=f"timeout after {timeout:.0f}s")
     rec["elapsed_s"] = round(time.time() - t0, 1)
+    if hb.fired:
+        rec["heartbeats"] = hb.fired
     if name == "probe" and not rec.get("ok"):
         # where the probe died (claim-wait vs matmul) — wedge vs slow
         # is diagnosable from the artifact alone
         rec["progress"] = _read_probe_progress()
     _append_stage(rec)
-    print(f"# stage {name}: "
-          f"{'ok' if rec.get('ok') else rec.get('error')} "
-          f"({rec['elapsed_s']}s)", file=sys.stderr)
+    from roc_tpu.obs.events import emit
+    emit("bench", f"stage {name}: "
+         f"{'ok' if rec.get('ok') else rec.get('error')} "
+         f"({rec['elapsed_s']}s)", stage=name,
+         ok=bool(rec.get("ok")), elapsed_s=rec["elapsed_s"])
     return rec
 
 
@@ -791,6 +834,15 @@ def _baseline_entry(result: dict, extra_keys=("V", "E", "layers", "impl",
 def parent(args, argv) -> int:
     t_start = time.time()
     remaining = lambda: args.deadline - (time.time() - t_start)
+    # structured events ride next to bench_stages.jsonl; the env var
+    # makes every stage CHILD (trainer manifest/compile events, claim
+    # heartbeats) append to the same artifact
+    events_path = (os.environ.get("ROC_TPU_EVENTS")
+                   or os.path.join(_ART_DIR, "events.jsonl"))
+    os.environ["ROC_TPU_EVENTS"] = events_path
+    _light_obs_imports()
+    from roc_tpu.obs.events import configure
+    configure(jsonl_path=events_path)
     # Recording: non-fp32 dtypes ALSO record under dtype-suffixed
     # metric names so per-config provenance never overwrites the fp32
     # record.  The HEADLINE line, however, always uses the unsuffixed

@@ -26,3 +26,4 @@ from .train.optimizer import (AdamConfig, AdamState, adam_init,
                               adam_update, decayed_lr)
 from .utils.checkpoint import (checkpoint_trainer, load_checkpoint,
                                restore_trainer, save_checkpoint)
+from .obs import Heartbeat, configure as configure_events, emit

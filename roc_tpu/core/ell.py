@@ -466,11 +466,11 @@ def sectioned_bounds(device_kind: Optional[str] = None
     if device_kind is not None and device_kind != "cpu" and \
             device_kind not in _UNCALIBRATED_WARNED:
         _UNCALIBRATED_WARNED.add(device_kind)
-        import sys
-        print(f"# sectioned-window bounds not calibrated for "
-              f"{device_kind!r}; using v5e-measured defaults "
-              f"(core/ell.py SECTIONED_BOUNDS_BY_KIND)",
-              file=sys.stderr)
+        from ..obs.events import emit
+        emit("resolve", f"sectioned-window bounds not calibrated for "
+             f"{device_kind!r}; using v5e-measured defaults "
+             f"(core/ell.py SECTIONED_BOUNDS_BY_KIND)",
+             device_kind=device_kind)
     return SECTION_ROWS_DEFAULT, SECTIONED_MAX_ROWS
 
 

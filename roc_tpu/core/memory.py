@@ -80,8 +80,10 @@ class MemoryPlan:
                 f"remat={self.remat}")
 
     def echo(self) -> str:
+        """Human-readable decision line (the ``# `` console prefix is
+        added by the event log's console sink)."""
         gib = 1024**3
-        return (f"# memory plan: {self.name} — est "
+        return (f"memory plan: {self.name} — est "
                 f"{self.est_bytes / gib:.2f} GiB of "
                 f"{self.budget_bytes / gib:.2f} GiB budget; {self.reason}")
 

@@ -85,10 +85,11 @@ def _load() -> Optional[ctypes.CDLL]:
     except AttributeError:
         got = 1  # predates the version export
     if got != _ABI_VERSION:
-        import sys
-        print(f"# librocio.so ABI v{got} != expected v{_ABI_VERSION}; "
-              f"ignoring {_LIB_PATH} (rebuild with make -C native)",
-              file=sys.stderr)
+        from .obs.events import emit
+        emit("resolve", f"librocio.so ABI v{got} != expected "
+             f"v{_ABI_VERSION}; ignoring {_LIB_PATH} (rebuild with "
+             f"make -C native)", abi_got=got,
+             abi_expected=_ABI_VERSION)
         return None
     # Full argtypes: int64_t params must not fall back to the 32-bit
     # c_int default (graphs with > 2^31 edges are in scope for the

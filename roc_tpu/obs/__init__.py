@@ -1,0 +1,26 @@
+"""Structured run telemetry (the observability layer).
+
+The reference ships only Legion log categories and commented-out
+``Realm::Clock`` micro-timers (SURVEY.md §5: a gap to fill, not copy).
+This package makes every run self-describing:
+
+- :mod:`events` — categorized event bus with a JSONL sink and a
+  console sink that preserves the ``# ...`` stderr diagnostic lines.
+- :mod:`manifest` — the run-manifest event (config, jax version,
+  device topology, resolved impl/fuse/halo, git sha) emitted at
+  trainer setup.
+- :mod:`compile_watch` — jit wrapper capturing lowering/compile wall
+  time plus the compiled executable's ``cost_analysis()`` /
+  ``memory_analysis()``, and the delta between XLA's actual peak and
+  the memory plan's modeled budget.
+- :mod:`heartbeat` — stall watchdog emitting periodic "still waiting
+  in <stage>" events so a hang is diagnosed instead of a blank
+  timeout.
+
+``python -m roc_tpu.report`` summarizes the emitted JSONL.
+"""
+
+from .events import (CATEGORIES, ConsoleSink, EventLog,  # noqa: F401
+                     JsonlSink, configure, emit, get_bus)
+from .heartbeat import Heartbeat  # noqa: F401
+from .manifest import run_manifest  # noqa: F401

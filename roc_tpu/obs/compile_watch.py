@@ -1,0 +1,252 @@
+"""Compile observer: what XLA actually built, vs what we modeled.
+
+Wraps a jitted step function so its first execution goes through the
+explicit AOT path (``lower()`` then ``compile()``), capturing:
+
+- lowering + compile wall time (the number the bench stages could
+  never attribute: "claiming backend" vs "compiling" vs "running");
+- ``cost_analysis()`` — flops and bytes accessed per step, the inputs
+  to MFU/throughput derivation downstream;
+- ``memory_analysis()`` — XLA's actual argument/output/temp sizes,
+  whose sum approximates peak HBM for the executable;
+- the delta between that actual peak and ``core/memory.py``'s modeled
+  budget — warning loudly when the plan undershoots reality (the
+  planner-vs-residency disagreement the round-5 advisor flagged).
+
+Steady-state calls route through the compiled executable (the AOT
+compile would otherwise be thrown away and paid twice).  Every
+introspection step degrades gracefully: a backend without
+``cost_analysis`` still trains, it just reports nulls
+(tests/test_obs.py gates this).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .events import emit
+from .heartbeat import Heartbeat
+
+
+def cost_summary(compiled) -> Dict[str, Optional[float]]:
+    """{'flops', 'bytes_accessed'} from ``cost_analysis()`` — which
+    returns a list of per-computation dicts on jax<=0.4.x and a flat
+    dict on newer releases; None fields when the backend (or an axon
+    relay hop) does not implement it."""
+    out: Dict[str, Optional[float]] = {"flops": None,
+                                       "bytes_accessed": None}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            for key, field in (("flops", "flops"),
+                               ("bytes accessed", "bytes_accessed")):
+                v = ca.get(key)
+                if v is not None and float(v) >= 0:
+                    out[field] = float(v)
+    except Exception:  # noqa: BLE001 - introspection is best-effort
+        pass
+    return out
+
+
+def memory_summary(compiled) -> Dict[str, Optional[int]]:
+    """Byte sizes from ``memory_analysis()`` (CompiledMemoryStats).
+    ``peak_bytes`` approximates the executable's device footprint:
+    arguments + outputs + temporaries, minus donated aliases."""
+    out: Dict[str, Optional[int]] = {
+        "peak_bytes": None, "argument_bytes": None,
+        "output_bytes": None, "temp_bytes": None,
+        "generated_code_bytes": None}
+    try:
+        ma = compiled.memory_analysis()
+        if ma is None:
+            return out
+        parts = {}
+        for field, attr in (("argument_bytes", "argument_size_in_bytes"),
+                            ("output_bytes", "output_size_in_bytes"),
+                            ("temp_bytes", "temp_size_in_bytes"),
+                            ("generated_code_bytes",
+                             "generated_code_size_in_bytes")):
+            v = getattr(ma, attr, None)
+            if v is not None:
+                parts[field] = int(v)
+                out[field] = int(v)
+        alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+        if parts:
+            out["peak_bytes"] = max(
+                0, parts.get("argument_bytes", 0)
+                + parts.get("output_bytes", 0)
+                + parts.get("temp_bytes", 0) - alias)
+    except Exception:  # noqa: BLE001 - introspection is best-effort
+        pass
+    return out
+
+
+# Per-chip peak dense FLOP/s (bf16 MXU path — the precision the
+# production configs run), keyed by device_kind substring.  MFU is a
+# *style* of utilization number: a coarse, stable denominator for
+# round-over-round comparison, not a vendor-exact ceiling.  CPU rigs
+# have no entry — the mfu field is simply absent there.
+PEAK_FLOPS_BY_KIND = {
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+    "v4": 275e12,
+}
+
+
+def peak_flops_per_s(device_kind: Optional[str] = None
+                     ) -> Optional[float]:
+    """Peak FLOP/s for ``device_kind`` (default: the current backend's
+    first device); None when unknown — callers drop the MFU field
+    rather than fabricate a denominator."""
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 - no backend, no MFU
+            return None
+    kind = (device_kind or "").lower()
+    for key, val in PEAK_FLOPS_BY_KIND.items():
+        if key in kind:
+            return val
+    return None
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "?"
+    if n >= 1 << 28:
+        return f"{n / 1024**3:.2f}GiB"
+    if n >= 1 << 17:
+        return f"{n / 1024**2:.1f}MiB"
+    return f"{n / 1024:.1f}KiB"
+
+
+class ObservedJit:
+    """``jax.jit`` with first-compile telemetry.
+
+    Drop-in for the trainer step slots: construct with the step
+    *implementation* (it calls ``jax.jit`` itself) or with
+    ``jitfn=`` for an already-wrapped callable (shard_map steps).
+    ``modeled_bytes`` is the memory plan's estimate for this step;
+    when XLA's actual peak exceeds it the event warns unconditionally.
+    """
+
+    # actual peak this far above the model warns even with verbose off
+    # — both gates must trip: the ratio (the model missed a TERM, not
+    # a rounding) and an absolute floor (at toy scale, fixed XLA
+    # overheads dominate any estimate and the warning would be noise)
+    UNDERSHOOT_WARN_RATIO = 1.1
+    UNDERSHOOT_WARN_MIN_BYTES = 256 << 20
+
+    def __init__(self, fn: Optional[Callable] = None, *,
+                 name: str, jitfn: Optional[Callable] = None,
+                 donate_argnums: Tuple[int, ...] = (),
+                 modeled_bytes: Optional[int] = None,
+                 verbose: bool = False):
+        import jax
+        if jitfn is None:
+            jitfn = jax.jit(fn, donate_argnums=donate_argnums)
+        self._jit = jitfn
+        self.name = name
+        self.modeled_bytes = modeled_bytes
+        self.verbose = verbose
+        self.cost: Optional[Dict[str, Any]] = None  # last compile event
+        self._compiled = None
+        self._degraded = False
+
+    # expose the underlying jit's AOT surface for callers that poke it
+    def lower(self, *args, **kw):
+        return self._jit.lower(*args, **kw)
+
+    def _observe(self, args) -> None:
+        t0 = time.perf_counter()
+        with Heartbeat(f"compile:{self.name}"):
+            lowered = self._jit.lower(*args)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+        fields: Dict[str, Any] = {
+            "name": self.name,
+            "lower_s": round(t1 - t0, 3),
+            "compile_s": round(t2 - t1, 3),
+            "modeled_bytes": self.modeled_bytes,
+        }
+        fields.update(cost_summary(compiled))
+        fields.update(memory_summary(compiled))
+        peak = fields.get("peak_bytes")
+        undershoot = False
+        if peak is not None and self.modeled_bytes:
+            fields["model_delta_bytes"] = int(peak - self.modeled_bytes)
+            fields["model_actual_ratio"] = round(
+                peak / self.modeled_bytes, 3)
+            undershoot = (
+                peak > self.modeled_bytes * self.UNDERSHOOT_WARN_RATIO
+                and peak - self.modeled_bytes
+                > self.UNDERSHOOT_WARN_MIN_BYTES)
+        flops = fields.get("flops")
+        msg = (f"compile {self.name}: lower {fields['lower_s']}s + "
+               f"compile {fields['compile_s']}s, "
+               f"flops={flops:.3g} " if flops is not None else
+               f"compile {self.name}: lower {fields['lower_s']}s + "
+               f"compile {fields['compile_s']}s, flops=? ")
+        msg += (f"peak={_fmt_bytes(peak)} "
+                f"(modeled {_fmt_bytes(self.modeled_bytes)})")
+        emit("compile", msg, console=self.verbose, **fields)
+        if undershoot:
+            emit("compile",
+                 f"memory plan undershoots XLA actual for "
+                 f"{self.name}: modeled "
+                 f"{_fmt_bytes(self.modeled_bytes)} < actual "
+                 f"{_fmt_bytes(peak)} "
+                 f"({fields['model_actual_ratio']:.2f}x) — the "
+                 f"autopilot's budget accounting is missing a term",
+                 warning=True, name=self.name)
+        self.cost = fields
+        self._compiled = compiled
+
+    def _degrade(self, e: BaseException):
+        self._degraded = True
+        self._compiled = None
+        emit("compile",
+             f"compile observer disabled for {self.name}: "
+             f"{type(e).__name__}: {e}",
+             console=self.verbose, name=self.name, degraded=True)
+
+    def __call__(self, *args):
+        if self._degraded:
+            return self._jit(*args)
+        if self._compiled is None:
+            # ONLY the observation may degrade.  The executions below
+            # stay outside the degrade path: their failures are the
+            # step's own (and with donated args a retry through
+            # self._jit could consume already-deleted buffers and mask
+            # the real error).
+            try:
+                self._observe(args)
+            except Exception as e:  # noqa: BLE001 - degrade, not die
+                self._degrade(e)
+                return self._jit(*args)
+            return self._compiled(*args)
+        try:
+            # steady state: no per-step signature walk — the AOT
+            # executable validates avals itself, far cheaper than a
+            # host-side pytree compare in the very loop this observer
+            # exists to measure
+            return self._compiled(*args)
+        except (TypeError, ValueError) as e:
+            # aval/binding mismatch (new shapes/dtypes): raised before
+            # any execution, args intact — re-observe once under the
+            # new signature.  Device-side failures (JaxRuntimeError)
+            # propagate untouched above.
+            try:
+                self._observe(args)
+            except Exception:  # noqa: BLE001 - degrade on the ORIGINAL
+                self._degrade(e)
+                return self._jit(*args)
+            return self._compiled(*args)

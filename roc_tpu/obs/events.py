@@ -1,0 +1,194 @@
+"""Categorized event bus: the ONE home for run diagnostics.
+
+Every runtime decision the framework makes (impl auto-resolution,
+memory plans, fuse counts, bdense occupancy) and everything the
+hardware reports back (compile cost, epoch timing, stalls) flows
+through :func:`emit` as a categorized event.  Two sinks:
+
+- :class:`ConsoleSink` — preserves today's ``# ...`` stderr lines
+  byte-for-byte (stdout stays a clean metrics stream; the lint
+  ratchet ``scripts/lint_prints.sh`` enforces that).
+- :class:`JsonlSink` — append-only structured JSONL, the machine-
+  readable artifact ``python -m roc_tpu.report`` summarizes.
+
+The module-level bus starts with a console sink only; a JSONL sink
+attaches via :func:`configure` (the CLI's ``--events`` flag) or the
+``ROC_TPU_EVENTS`` environment variable — inherited by bench child
+processes, so a staged benchmark's events land in one artifact.
+
+Deliberately jax-free and thread-safe: the stall heartbeat emits from
+a watchdog thread while the main thread is blocked inside a fetch.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+# Canonical categories (free-form strings are accepted — a new
+# category must not require touching this module):
+#   manifest  run-identity event emitted at trainer setup
+#   resolve   config auto-resolution (impl/fuse/attention overrides)
+#   plan      memory plans, bdense occupancy, partition/ring echoes
+#   compile   lowering+compile cost, XLA cost/memory introspection
+#   epoch     per-eval timing, phase spans, throughput
+#   bench     benchmark stage lifecycle
+#   stall     heartbeat "still waiting in <stage>" events
+#   run       CLI lifecycle (resume, checkpoint, artifact writes)
+CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
+              "bench", "stall", "run")
+
+
+def _jsonable(v: Any) -> Any:
+    """Best-effort conversion to something json.dumps accepts."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, dict):
+        return {str(k): _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple, set)):
+        return [_jsonable(x) for x in v]
+    try:
+        import numpy as np
+        if isinstance(v, np.integer):
+            return int(v)
+        if isinstance(v, np.floating):
+            return float(v)
+        if isinstance(v, np.ndarray) and v.size <= 64:
+            return v.tolist()
+    except ImportError:  # numpy is always present in practice
+        pass
+    return str(v)
+
+
+class ConsoleSink:
+    """``# <message>`` lines on stderr — exactly the ad-hoc diagnostic
+    format the event log replaces, so existing eyes and log scrapers
+    keep working."""
+
+    def __init__(self, stream=None):
+        self._stream = stream
+
+    def write(self, record: Dict[str, Any]) -> None:
+        if not record.get("console", True):
+            return
+        stream = self._stream if self._stream is not None else sys.stderr
+        print(f"# {record['msg']}", file=stream)
+
+    def close(self) -> None:
+        pass
+
+
+class JsonlSink:
+    """Append-only JSONL; the handle opens lazily on first event and
+    every line is flushed (a timed-out run must still leave a readable
+    artifact)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = None
+
+    def write(self, record: Dict[str, Any]) -> None:
+        rec = {k: _jsonable(v) for k, v in record.items()
+               if k != "console"}
+        if self._fh is None:
+            d = os.path.dirname(os.path.abspath(self.path))
+            os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a")
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class EventLog:
+    """A bus fanning events out to its sinks.  Sink failures are
+    swallowed after a one-time stderr note — telemetry must never take
+    down the run it observes."""
+
+    def __init__(self, sinks: Optional[List] = None):
+        self.sinks: List = list(sinks) if sinks is not None else []
+        self._lock = threading.Lock()
+        self._sink_warned = False
+
+    def emit(self, cat: str, msg: str, console: bool = True,
+             **fields: Any) -> Dict[str, Any]:
+        record = {"t": round(time.time(), 3), "cat": cat, "msg": msg,
+                  "console": console, **fields}
+        with self._lock:
+            for sink in self.sinks:
+                try:
+                    sink.write(record)
+                except Exception as e:  # noqa: BLE001 - never raise
+                    if not self._sink_warned:
+                        self._sink_warned = True
+                        print(f"# event sink {type(sink).__name__} "
+                              f"failed: {e!r} (further failures "
+                              f"silent)", file=sys.stderr)
+        return record
+
+    def add_sink(self, sink) -> None:
+        with self._lock:
+            self.sinks.append(sink)
+
+    def jsonl_path(self) -> Optional[str]:
+        for sink in self.sinks:
+            if isinstance(sink, JsonlSink):
+                return sink.path
+        return None
+
+    def close(self) -> None:
+        with self._lock:
+            for sink in self.sinks:
+                try:
+                    sink.close()
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+_BUS: Optional[EventLog] = None
+_BUS_LOCK = threading.Lock()
+
+
+def get_bus() -> EventLog:
+    """The process-global bus, created on first use: a console sink,
+    plus a JSONL sink when ``ROC_TPU_EVENTS`` is set (bench children
+    and multi-host workers inherit the artifact path via env)."""
+    global _BUS
+    with _BUS_LOCK:
+        if _BUS is None:
+            _BUS = EventLog([ConsoleSink()])
+            env_path = os.environ.get("ROC_TPU_EVENTS")
+            if env_path:
+                _BUS.add_sink(JsonlSink(env_path))
+        return _BUS
+
+
+def configure(jsonl_path: Optional[str] = None,
+              console: bool = True) -> EventLog:
+    """(Re)build the global bus.  ``jsonl_path`` attaches the JSONL
+    sink; ``console=False`` drops the stderr lines (library embedding
+    that wants pure-JSONL telemetry)."""
+    global _BUS
+    with _BUS_LOCK:
+        if _BUS is not None:
+            _BUS.close()
+        sinks: List = [ConsoleSink()] if console else []
+        if jsonl_path:
+            sinks.append(JsonlSink(jsonl_path))
+        _BUS = EventLog(sinks)
+        return _BUS
+
+
+def emit(cat: str, msg: str, console: bool = True,
+         **fields: Any) -> Dict[str, Any]:
+    """Emit on the global bus.  ``console=False`` keeps an event out
+    of the stderr stream (it still lands in the JSONL artifact) — the
+    call-site analog of today's ``if config.verbose:`` gates."""
+    return get_bus().emit(cat, msg, console=console, **fields)

@@ -1,0 +1,96 @@
+"""Stall watchdog: periodic "still waiting in <stage>" events.
+
+The round-5 bench stages all timed out silently at "claiming backend"
+— a blank timeout is undiagnosable after the fact.  A
+:class:`Heartbeat` wraps any potentially-hanging region (backend
+claim, first compile, a bench stage child) and emits a ``stall``
+event every ``interval_s`` from a daemon thread, so the artifact
+records WHERE the time went and for how long, even when the region
+never returns.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from .events import emit
+
+# default watchdog period; bench/test harnesses tighten it via env
+DEFAULT_INTERVAL_S = 30.0
+
+
+def heartbeat_interval(default: float = DEFAULT_INTERVAL_S) -> float:
+    try:
+        return float(os.environ.get("ROC_TPU_HEARTBEAT_S", default))
+    except ValueError:
+        return default
+
+
+class Heartbeat:
+    """Context manager emitting ``stall`` events while the enclosed
+    region runs.
+
+    >>> with Heartbeat("claiming backend"):
+    ...     dev = jax.devices()[0]
+
+    The thread is a daemon (a wedged region killed by SIGTERM must not
+    be kept alive by its own watchdog) and fires only AFTER the first
+    full interval — a fast region emits nothing.  ``cancel()`` (or
+    normal exit) stops it; the event count is exposed as ``fired`` for
+    tests and post-mortems.  An interval <= 0 (ROC_TPU_HEARTBEAT_S=0)
+    disables the watchdog entirely — never a zero-wait spin loop."""
+
+    def __init__(self, stage: str, interval_s: Optional[float] = None,
+                 bus=None, **fields: Any):
+        self.stage = stage
+        self.interval_s = (heartbeat_interval() if interval_s is None
+                           else float(interval_s))
+        self.fired = 0
+        self._fields = fields
+        self._bus = bus
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._t0 = 0.0
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.fired += 1
+            elapsed = time.monotonic() - self._t0
+            msg = (f"still waiting in {self.stage}, elapsed "
+                   f"{elapsed:.0f}s")
+            if self._bus is not None:
+                self._bus.emit("stall", msg, stage=self.stage,
+                               elapsed_s=round(elapsed, 1),
+                               beat=self.fired, **self._fields)
+            else:
+                emit("stall", msg, stage=self.stage,
+                     elapsed_s=round(elapsed, 1), beat=self.fired,
+                     **self._fields)
+
+    def start(self) -> "Heartbeat":
+        self._t0 = time.monotonic()
+        self._stop.clear()
+        if self.interval_s <= 0:
+            # the documented off switch: wait(0) would return
+            # immediately and flood stderr + the JSONL artifact
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name=f"heartbeat:{self.stage}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def cancel(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "Heartbeat":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.cancel()

@@ -43,6 +43,7 @@ from ..core.ell import ell_from_padded_parts
 from ..core.graph import Dataset, MASK_NONE
 from ..core.partition import PartitionedGraph, partition_graph
 from ..models.builder import GraphContext, Model
+from ..obs.events import emit
 from ..ops.loss import masked_softmax_cross_entropy, perf_metrics, summarize_metrics
 from ..train.optimizer import AdamConfig, adam_init, adam_update
 from ..train.trainer import (TrainConfig, cast_floats, compute_dtype_of,
@@ -541,21 +542,18 @@ class DistributedTrainer:
             # own build only: injected data carries no plan to report
             # (an empty bd_tabs there means the CALLER never planned,
             # not that no tile qualified)
-            import sys
-            if config.verbose:
-                for p, occ in enumerate(self.data.bd_occupancy):
-                    print(f"# bdense part {p}: {occ['n_blocks']} "
-                          f"blocks, dense_frac={occ['dense_frac']}, "
-                          f"mean_fill={occ['mean_fill']}",
-                          file=sys.stderr)
+            for p, occ in enumerate(self.data.bd_occupancy):
+                emit("plan", f"bdense part {p}: {occ['n_blocks']} "
+                     f"blocks, dense_frac={occ['dense_frac']}, "
+                     f"mean_fill={occ['mean_fill']}",
+                     console=config.verbose, part=p, **occ)
             if not self.data.bd_tabs:
                 # changes the effective execution path — echoes
                 # unconditionally, like the single-device fallback
                 # (train/trainer.py)
-                print("# bdense: no [128,128] tile reaches min_fill="
-                      f"{config.bdense_min_fill} on any partition — "
-                      "running the pure sectioned residual",
-                      file=sys.stderr)
+                emit("plan", "bdense: no [128,128] tile reaches "
+                     f"min_fill={config.bdense_min_fill} on any "
+                     "partition — running the pure sectioned residual")
         if data is not None:
             # the autopilot / auto-resolution above may have settled on
             # a different halo/aggr_impl than the caller built tables
@@ -618,10 +616,8 @@ class DistributedTrainer:
                     # planned, but no [128,128] tile reached min_fill:
                     # the step runs the pure sectioned residual — same
                     # echo as the own-build path below
-                    import sys
-                    print("# bdense: injected plan has no dense tiles "
-                          "— running the pure sectioned residual",
-                          file=sys.stderr)
+                    emit("plan", "bdense: injected plan has no dense "
+                         "tiles — running the pure sectioned residual")
                 if config.aggr_impl in ("ell", "pallas") \
                         and not self.data.ell_idx:
                     raise ValueError(
@@ -643,16 +639,20 @@ class DistributedTrainer:
                         f"{config.aggr_impl!r} reads the flat edge "
                         f"arrays — build the data with the same "
                         f"aggr_impl")
-        if config.halo == "ring" and config.verbose:
+        if config.halo == "ring" and self.data.ring_idx:
             # startup echo like the reference's config print
             # (gnn.cc:48-60): make the SPMD padding cost visible, and
             # say out loud that ring tables subsume the aggr impl
-            import sys
-            print(f"# halo=ring: P={self.pg.num_parts} "
-                  f"pair_edges={self.data.ring_idx[0].shape[2]} "
-                  f"padding_ratio={self.data.ring_padding_ratio:.2f} "
-                  f"(aggr_impl={config.aggr_impl!r} unused: ring tables "
-                  f"drive the aggregation)", file=sys.stderr)
+            ratio = self.data.ring_padding_ratio
+            emit("plan", f"halo=ring: P={self.pg.num_parts} "
+                 f"pair_edges={self.data.ring_idx[0].shape[2]} "
+                 f"padding_ratio="
+                 f"{'?' if ratio is None else format(ratio, '.2f')} "
+                 f"(aggr_impl={config.aggr_impl!r} unused: ring tables "
+                 f"drive the aggregation)", console=config.verbose,
+                 num_parts=self.pg.num_parts,
+                 pair_edges=int(self.data.ring_idx[0].shape[2]),
+                 padding_ratio=ratio)
         key = jax.random.PRNGKey(config.seed)
         self.key, init_key = jax.random.split(key)
         host_params = model.init_params(init_key, dtype=config.dtype)
@@ -660,9 +660,27 @@ class DistributedTrainer:
         self.opt_state = put_replicated(adam_init(host_params),
                                         self.mesh)
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
-        self._train_step = self._build_train_step()
-        self._eval_step = self._build_eval_step()
+        # observability: per-device modeled bytes for the compile
+        # observer's modeled-vs-actual check, edges for edges/sec
+        from ..obs.compile_watch import ObservedJit
+        from ..train.trainer import modeled_step_bytes
+        self._obs_edges = int(dataset.graph.num_edges)
+        self._modeled_bytes = modeled_step_bytes(
+            model, dataset, config, num_parts=num_parts)
+        self._train_step = ObservedJit(
+            jitfn=self._build_train_step(), name="dist_train_step",
+            modeled_bytes=self._modeled_bytes, verbose=config.verbose)
+        self._eval_step = ObservedJit(
+            jitfn=self._build_eval_step(), name="dist_eval_step",
+            verbose=config.verbose)
         self._predict_step = None   # built lazily on first predict()
+        from ..obs.manifest import run_manifest
+        run_manifest(config=self.config, dataset=dataset, model=model,
+                     num_parts=num_parts,
+                     extra={"modeled_step_bytes": self._modeled_bytes,
+                            "bd_occupancy": list(
+                                self.data.bd_occupancy)},
+                     console=config.verbose)
         from ..utils.profiling import EpochTimer, MetricsLog
         self.timer = EpochTimer()
         self.metrics_log = MetricsLog(config.metrics_path)

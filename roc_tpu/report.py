@@ -1,0 +1,205 @@
+"""Run-report CLI: summarize an event/metrics JSONL into tables.
+
+``python -m roc_tpu.report run_events.jsonl [--metrics m.jsonl]``
+
+Renders, from the artifacts a run with ``--events``/``--metrics``
+leaves behind:
+
+- the run manifest (what code/hardware/config actually executed);
+- compile cost per step function, with the modeled-vs-actual HBM
+  delta (the planner-vs-residency check);
+- per-phase spans (compile / train / eval / streamed sub-phases) as
+  p50/p90;
+- throughput (edges/sec, TFLOP/s, MFU when the chip's peak is known);
+- stall heartbeats, grouped by stage — where a hung run spent its
+  time.
+
+This is a *reader*: it works on artifacts from a dead run (the JSONL
+sinks flush per line) and never touches a backend — no
+``jax.devices()``, no claim on the relay.  ``python -m roc_tpu.report``
+does import the ``roc_tpu`` package (and thus jax) on the way in; on
+a box without jax, run it as a plain script instead — this module
+deliberately has no package-relative imports:
+``python roc_tpu/report.py events.jsonl``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                # a run killed mid-write leaves at most one torn tail
+                # line; skip rather than refuse the whole artifact
+                continue
+    return out
+
+
+def _fmt_bytes(n: Optional[float]) -> str:
+    if n is None:
+        return "?"
+    if abs(n) >= 1 << 28:
+        return f"{n / 1024**3:.2f}GiB"
+    if abs(n) >= 1 << 17:
+        return f"{n / 1024**2:.1f}MiB"
+    return f"{n / 1024:.1f}KiB"
+
+
+def _pct(values: List[float], q: float) -> float:
+    vs = sorted(values)
+    if not vs:
+        return 0.0
+    idx = min(len(vs) - 1, max(0, int(round(q * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def _rows(title: str, header: List[str],
+          rows: List[List[str]], out) -> None:
+    print(f"\n== {title} ==", file=out)
+    if not rows:
+        print("  (none)", file=out)
+        return
+    widths = [max(len(h), *(len(r[i]) for r in rows))
+              for i, h in enumerate(header)]
+    print("  " + "  ".join(h.ljust(w) for h, w in zip(header, widths)),
+          file=out)
+    for r in rows:
+        print("  " + "  ".join(c.ljust(w) for c, w in zip(r, widths)),
+              file=out)
+
+
+def summarize(events: List[Dict[str, Any]],
+              metrics: Optional[List[Dict[str, Any]]] = None,
+              out=None) -> int:
+    out = out if out is not None else sys.stdout
+
+    manifests = [e for e in events if e.get("cat") == "manifest"]
+    if manifests:
+        m = manifests[-1]
+        res = m.get("resolved") or {}
+        ds = m.get("dataset") or {}
+        print("run manifest:", file=out)
+        print(f"  platform={m.get('platform')} "
+              f"devices={m.get('device_count')} "
+              f"kinds={m.get('device_kinds')} "
+              f"jax={m.get('jax_version')} "
+              f"sha={(m.get('git_sha') or 'none')[:12]}", file=out)
+        print(f"  dataset={ds.get('name')} V={ds.get('num_nodes')} "
+              f"E={ds.get('num_edges')}", file=out)
+        print("  resolved: " + " ".join(
+            f"{k}={v}" for k, v in res.items()), file=out)
+    else:
+        print("run manifest: (none recorded)", file=out)
+
+    decisions = [e for e in events
+                 if e.get("cat") in ("resolve", "plan")]
+    _rows("decisions (resolve/plan)", ["cat", "message"],
+          [[e["cat"], str(e.get("msg", ""))[:96]] for e in decisions],
+          out)
+
+    compiles = [e for e in events
+                if e.get("cat") == "compile" and "lower_s" in e]
+    rows = []
+    for e in compiles:
+        modeled, peak = e.get("modeled_bytes"), e.get("peak_bytes")
+        ratio = (f"{peak / modeled:.2f}x"
+                 if peak is not None and modeled else "?")
+        flops = e.get("flops")
+        rows.append([
+            str(e.get("name")),
+            f"{e.get('lower_s', 0) + e.get('compile_s', 0):.2f}s",
+            f"{flops:.3g}" if flops is not None else "?",
+            _fmt_bytes(e.get("bytes_accessed")),
+            _fmt_bytes(peak), _fmt_bytes(modeled), ratio])
+    _rows("compile (XLA introspection)",
+          ["step", "lower+compile", "flops", "bytes", "peak_hbm",
+           "modeled", "actual/model"], rows, out)
+
+    # phase spans: the trainer emits a final spans summary; fall back
+    # to aggregating the per-eval epoch events / metrics records
+    span_events = [e for e in events
+                   if e.get("cat") == "epoch" and e.get("spans")]
+    rows = []
+    if span_events:
+        for name, s in span_events[-1]["spans"].items():
+            rows.append([name, str(s.get("n")),
+                         f"{s.get('p50_ms', 0):.1f}",
+                         f"{s.get('p90_ms', 0):.1f}",
+                         f"{s.get('total_ms', 0):.0f}"])
+    else:
+        series: Dict[str, List[float]] = {}
+        recs = [e for e in events if e.get("cat") == "epoch"]
+        recs += metrics or []
+        for e in recs:
+            for k in ("epoch_ms", "eval_ms", "compile_ms"):
+                if isinstance(e.get(k), (int, float)):
+                    series.setdefault(k[:-3], []).append(float(e[k]))
+        for name, vs in series.items():
+            rows.append([name, str(len(vs)), f"{_pct(vs, 0.5):.1f}",
+                         f"{_pct(vs, 0.9):.1f}", f"{sum(vs):.0f}"])
+    _rows("phase spans (ms)",
+          ["phase", "n", "p50", "p90", "total"], rows, out)
+
+    thr: Dict[str, List[float]] = {}
+    for e in ([x for x in events if x.get("cat") == "epoch"]
+              + (metrics or [])):
+        for k in ("edges_per_s", "tflops_per_s", "mfu"):
+            if isinstance(e.get(k), (int, float)):
+                thr.setdefault(k, []).append(float(e[k]))
+    rows = [[k, f"{_pct(vs, 0.5):.4g}", f"{max(vs):.4g}"]
+            for k, vs in thr.items()]
+    _rows("throughput", ["metric", "p50", "max"], rows, out)
+
+    stalls = [e for e in events if e.get("cat") == "stall"]
+    by_stage: Dict[str, List[float]] = {}
+    for e in stalls:
+        by_stage.setdefault(str(e.get("stage")), []).append(
+            float(e.get("elapsed_s", 0)))
+    rows = [[st, str(len(vs)), f"{max(vs):.0f}s"]
+            for st, vs in by_stage.items()]
+    _rows("stalls (heartbeats)", ["stage", "beats", "max_wait"],
+          rows, out)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="roc_tpu.report", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("events", help="event-log JSONL (--events / "
+                                   "ROC_TPU_EVENTS artifact)")
+    ap.add_argument("--metrics", default=None,
+                    help="training metrics JSONL (--metrics artifact) "
+                         "to fold into the span/throughput tables")
+    args = ap.parse_args(argv)
+    try:
+        events = load_jsonl(args.events)
+    except OSError as e:
+        print(f"error: cannot read {args.events}: {e}",
+              file=sys.stderr)
+        return 2
+    metrics = None
+    if args.metrics:
+        try:
+            metrics = load_jsonl(args.metrics)
+        except OSError as e:
+            print(f"error: cannot read {args.metrics}: {e}",
+                  file=sys.stderr)
+            return 2
+    return summarize(events, metrics)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

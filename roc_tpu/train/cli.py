@@ -142,6 +142,17 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "compile)")
     ap.add_argument("--profile-dir", type=str, default=None,
                     help="write a jax.profiler trace of one epoch here")
+    ap.add_argument("--metrics", type=str, default=None,
+                    help="training-metrics JSONL path (one record per "
+                         "eval: loss/accuracies, epoch_ms, eval_ms, "
+                         "compile_ms, edges_per_s, tflops_per_s, mfu)")
+    ap.add_argument("--events", type=str, default=None,
+                    help="structured event-log JSONL path (roc_tpu/"
+                         "obs): run manifest, resolve/plan decisions, "
+                         "compile cost + modeled-vs-actual HBM, "
+                         "per-phase epoch spans, stall heartbeats; "
+                         "summarize with `python -m roc_tpu.report`. "
+                         "Also settable via ROC_TPU_EVENTS")
     ap.add_argument("--reorder", default="none",
                     choices=["none", "bfs", "lpa"],
                     help="vertex relabeling for gather locality "
@@ -156,6 +167,13 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    from ..obs.events import emit
+    if args.events:
+        # env too, so worker/child processes join the same artifact
+        import os
+        os.environ["ROC_TPU_EVENTS"] = args.events
+        from ..obs.events import configure
+        configure(jsonl_path=args.events)
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -265,14 +283,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         ds, perm = apply_vertex_order(
             ds, ORDERINGS[args.reorder](ds.graph),
             order_name=args.reorder)
-        print(f"# reorder={args.reorder} applied in "
-              f"{time.time() - t0:.1f}s", file=sys.stderr)
-    # config echo, like gnn.cc:48-60
-    print(f"# dataset={ds.name} V={ds.graph.num_nodes} "
-          f"E={ds.graph.num_edges} layers={layers} model={args.model} "
-          f"lr={args.lr} wd={args.weight_decay} dropout={args.dropout} "
-          f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
-          f"impl={args.impl}", file=sys.stderr)
+        emit("plan", f"reorder={args.reorder} applied in "
+             f"{time.time() - t0:.1f}s", reorder=args.reorder,
+             reorder_s=round(time.time() - t0, 2))
+    # config echo, like gnn.cc:48-60 (the structured run manifest is
+    # emitted by the trainer once the config is RESOLVED)
+    emit("run", f"dataset={ds.name} V={ds.graph.num_nodes} "
+         f"E={ds.graph.num_edges} layers={layers} model={args.model} "
+         f"lr={args.lr} wd={args.weight_decay} dropout={args.dropout} "
+         f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
+         f"impl={args.impl}")
 
     from ..models.appnp import build_appnp
     from ..models.gcn2 import build_gcn2
@@ -304,7 +324,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed, eval_every=args.eval_every, verbose=True,
         aggr_impl=args.impl, aggr_fuse=args.fuse, halo=args.halo,
         memory=memory, features=args.features, remat=args.remat,
-        dtype=dt, compute_dtype=cdt)
+        dtype=dt, compute_dtype=cdt, metrics_path=args.metrics)
 
     if args.parts > 1:
         trainer = DistributedTrainer(model, ds, args.parts, cfg)
@@ -317,8 +337,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.resume:
         restore_trainer(trainer, args.resume)
-        print(f"# resumed from {args.resume} at epoch {trainer.epoch}",
-              file=sys.stderr)
+        emit("run", f"resumed from {args.resume} at epoch "
+             f"{trainer.epoch}", epoch=trainer.epoch)
 
     def save_logits():
         if not args.save_logits:
@@ -332,8 +352,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             out[perm] = logits
             logits = out
         np.save(args.save_logits, logits)
-        print(f"# logits [{logits.shape[0]}, {logits.shape[1]}] "
-              f"saved to {args.save_logits}", file=sys.stderr)
+        emit("run", f"logits [{logits.shape[0]}, {logits.shape[1]}] "
+             f"saved to {args.save_logits}", path=args.save_logits)
 
     if args.eval_only:
         from .trainer import format_metrics
@@ -346,7 +366,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         trainer.train(epochs=1)  # compile outside the trace
         with jax.profiler.trace(args.profile_dir):
             trainer.train(epochs=1)
-        print(f"# profile written to {args.profile_dir}", file=sys.stderr)
+        emit("run", f"profile written to {args.profile_dir}",
+             path=args.profile_dir)
 
     t0 = time.time()
     remaining = args.epochs - trainer.epoch
@@ -359,12 +380,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         trainer.train(epochs=max(remaining, 0))
     dt = time.time() - t0
     if remaining > 0:
-        print(f"# {remaining} epochs in {dt:.1f}s "
-              f"({1000.0 * dt / max(remaining, 1):.1f} ms/epoch)",
-              file=sys.stderr)
+        emit("run", f"{remaining} epochs in {dt:.1f}s "
+             f"({1000.0 * dt / max(remaining, 1):.1f} ms/epoch)",
+             epochs=remaining, wall_s=round(dt, 2))
     if args.checkpoint:
         checkpoint_trainer(trainer, args.checkpoint)
-        print(f"# checkpoint saved to {args.checkpoint}", file=sys.stderr)
+        emit("run", f"checkpoint saved to {args.checkpoint}",
+             path=args.checkpoint)
     save_logits()
     return 0
 

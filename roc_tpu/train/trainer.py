@@ -21,6 +21,7 @@ import numpy as np
 from ..core.graph import Dataset
 from ..core.partition import padded_edge_list
 from ..models.builder import GraphContext, Model
+from ..obs.events import emit
 from ..ops.loss import perf_metrics, summarize_metrics
 from .optimizer import AdamConfig, adam_init, adam_update, decayed_lr
 
@@ -218,10 +219,11 @@ def resolve_attention_impl(model, config: TrainConfig,
             config.aggr_impl not in ("ell", "pallas") and \
             dataset.graph.num_edges >= ATTN_FLAT8_MIN_EDGES:
         import dataclasses
-        import sys
-        print(f"# aggr_impl={config.aggr_impl!r} -> 'attn_flat8' "
-              f"(attention at E={dataset.graph.num_edges:,}: uniform "
-              "layout keeps the compile small)", file=sys.stderr)
+        emit("resolve",
+             f"aggr_impl={config.aggr_impl!r} -> 'attn_flat8' "
+             f"(attention at E={dataset.graph.num_edges:,}: uniform "
+             "layout keeps the compile small)",
+             requested=config.aggr_impl, resolved="attn_flat8")
         return dataclasses.replace(config, aggr_impl="attn_flat8")
     if config.aggr_impl in ("ell", "pallas"):
         return config
@@ -233,9 +235,9 @@ def resolve_attention_impl(model, config: TrainConfig,
         return config
     # echo unconditionally: this changes user-selected behavior, so it
     # must never be silent (ADVICE r3)
-    import sys
-    print(f"# aggr_impl={config.aggr_impl!r} -> 'ell' "
-          f"({why} model needs the ELL tables)", file=sys.stderr)
+    emit("resolve", f"aggr_impl={config.aggr_impl!r} -> 'ell' "
+         f"({why} model needs the ELL tables)",
+         requested=config.aggr_impl, resolved="ell", why=why)
     import dataclasses
     return dataclasses.replace(config, aggr_impl="ell")
 
@@ -255,18 +257,50 @@ def resolve_fuse(model: Model, config: TrainConfig) -> Model:
             "'auto', 'on', or 'off'")
     fused = model.fuse_norm_aggregate()
     n = fused.num_fused_aggregates()
-    import sys
     if n == 0:
         if config.aggr_fuse == "on":
             # an explicit request that changes nothing must say so
-            print("# aggr_fuse='on': no fusable norm->aggregate->norm "
-                  "chain in this model — running unfused",
-                  file=sys.stderr)
+            emit("resolve", "aggr_fuse='on': no fusable "
+                 "norm->aggregate->norm chain in this model — running "
+                 "unfused", fuse=0)
         return model
-    if config.verbose:
-        print(f"# aggr_fuse: {n} norm->aggregate->norm chain(s) "
-              f"folded into the aggregation", file=sys.stderr)
+    emit("resolve", f"aggr_fuse: {n} norm->aggregate->norm chain(s) "
+         f"folded into the aggregation", console=config.verbose,
+         fuse=n)
     return fused
+
+
+def model_layer_dims(model: Model) -> List[int]:
+    """The CLI-style layer spec (in-dim, linear out-dims...) recovered
+    from the built model — the shape vocabulary core/memory.py's
+    estimator speaks."""
+    return [model._ops[0].dim] + [op.dim for op in model._ops
+                                  if op.kind == "linear"]
+
+
+def modeled_step_bytes(model: Model, dataset: Dataset,
+                       config: TrainConfig,
+                       num_parts: int = 1) -> int:
+    """The memory model's peak-HBM estimate for the RESOLVED config —
+    the number the compile observer (obs/compile_watch.py) holds
+    against XLA's actual ``memory_analysis()`` so the planner and the
+    residency can never silently disagree again (round-5 advisor).
+    Computed for manual configs too: the autopilot only runs under
+    ``memory='auto'``, but the modeled-vs-actual delta is evidence on
+    every run."""
+    from ..core.memory import estimate_plan_bytes
+    keeps_bdense = (config.aggr_impl == "bdense"
+                    and not model.uses_attention()
+                    and not model.uses_max_aggregation())
+    a_tab = (config.bdense_a_budget or 0) if keeps_bdense else 0
+    return estimate_plan_bytes(
+        dataset.graph.num_nodes, dataset.graph.num_edges,
+        model_layer_dims(model), num_parts=num_parts,
+        dtype_bytes=jnp.dtype(compute_dtype_of(config)).itemsize,
+        halo=config.halo if num_parts > 1 else "gather",
+        features=config.features, remat=config.remat,
+        remat_policy=config.remat_policy,
+        extra_table_bytes=a_tab)
 
 
 def resolve_symmetric(dataset: Dataset,
@@ -287,10 +321,8 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
     if config.memory != "auto":
         return config
     import dataclasses
-    import sys
     from ..core.memory import choose_memory_plan
-    dims = [model._ops[0].dim] + [op.dim for op in model._ops
-                                  if op.kind == "linear"]
+    dims = model_layer_dims(model)
     # bdense keeps an A-table resident next to the model; its worst
     # case is the planner's device-byte cap.  The trainers resolve
     # aggr_impl='auto' (incl. the bdense structure probe) BEFORE
@@ -315,8 +347,12 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
                          or model.streamable_agg_head() is not None),
         remat_policy=config.remat_policy,
         extra_table_bytes=a_tab)
-    if config.verbose:
-        print(plan.echo(), file=sys.stderr)
+    # a plan that doesn't fit echoes even with verbose off — running
+    # anyway is a deliberate gamble the operator must see
+    emit("plan", plan.echo(), console=config.verbose or not plan.fits,
+         halo=plan.halo, features=plan.features, remat=plan.remat,
+         fits=plan.fits, est_bytes=plan.est_bytes,
+         budget_bytes=plan.budget_bytes, candidates=plan.candidates)
     return dataclasses.replace(
         config, memory="manual", features=plan.features,
         remat=plan.remat,
@@ -345,7 +381,6 @@ def resolve_auto_impl_probed(graph, out_rows: Optional[int] = None, *,
     depends on per-host native availability, and every SPMD process
     must resolve the SAME impl — multi-process resolution stays pure
     arithmetic (set aggr_impl explicitly to use bdense there)."""
-    import sys as _sys
     from ..core.ell import resolve_auto_impl
     from ..ops import blockdense as _BD
     impl = resolve_auto_impl(graph.num_nodes, out_rows=out_rows)
@@ -361,14 +396,15 @@ def resolve_auto_impl_probed(graph, out_rows: Optional[int] = None, *,
     frac, census = probe
     if frac >= _BD.BDENSE_AUTO_MIN_FRAC:
         # changes the execution path — echoes unconditionally
-        print(f"# aggr_impl='auto' -> 'bdense' (census: {frac:.0%} "
-              f"of edges on dense tiles >= "
-              f"{_BD.BDENSE_AUTO_MIN_FRAC:.0%})", file=_sys.stderr)
+        emit("resolve", f"aggr_impl='auto' -> 'bdense' (census: "
+             f"{frac:.0%} of edges on dense tiles >= "
+             f"{_BD.BDENSE_AUTO_MIN_FRAC:.0%})",
+             resolved="bdense", dense_frac=round(float(frac), 4))
         return "bdense", census
-    if verbose:
-        print(f"# auto bdense probe: dense_frac {frac:.1%} < "
-              f"{_BD.BDENSE_AUTO_MIN_FRAC:.0%} — staying sectioned",
-              file=_sys.stderr)
+    emit("resolve", f"auto bdense probe: dense_frac {frac:.1%} < "
+         f"{_BD.BDENSE_AUTO_MIN_FRAC:.0%} — staying sectioned",
+         console=verbose, resolved=impl,
+         dense_frac=round(float(frac), 4))
     return impl, None
 
 
@@ -487,7 +523,6 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         # makes a mis-fit choice visible)
         from ..core.ell import default_section_rows, sectioned_from_graph
         from ..ops.blockdense import BLOCK, plan_blocks_packed
-        import sys as _sys
         plan = plan_blocks_packed(g.row_ptr, g.col_idx, g.num_nodes,
                                   min_fill=bdense_min_fill,
                                   a_budget_bytes=bdense_a_budget,
@@ -496,13 +531,12 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         packed = plan.a_blocks.shape[-1] == BLOCK // 2
         occ = plan.occupancy()
         if plan.n_blocks:
-            if verbose:
-                print(f"# bdense plan: {occ['n_blocks']} blocks, "
-                      f"fill {occ['mean_fill']}, dense "
-                      f"{occ['dense_frac']:.0%} (residual "
-                      f"{1 - occ['dense_frac']:.0%} via sectioned"
-                      f"{', A u4-packed' if packed else ''})",
-                      file=_sys.stderr)
+            emit("plan", f"bdense plan: {occ['n_blocks']} blocks, "
+                 f"fill {occ['mean_fill']}, dense "
+                 f"{occ['dense_frac']:.0%} (residual "
+                 f"{1 - occ['dense_frac']:.0%} via sectioned"
+                 f"{', A u4-packed' if packed else ''})",
+                 console=verbose, packed=packed, **occ)
             bd_a = jnp.asarray(plan.a_blocks)
             bd_src = jnp.asarray(plan.src_blk)
             bd_dst = jnp.asarray(plan.dst_blk)
@@ -511,9 +545,9 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
             # no tile qualifies: running the zero-block kernel every
             # step would be pure overhead — this changes the effective
             # execution path, so it echoes unconditionally
-            print(f"# bdense: no [128,128] tile reaches min_fill="
-                  f"{bdense_min_fill} on this graph/order — running "
-                  f"the sectioned residual only", file=_sys.stderr)
+            emit("plan", f"bdense: no [128,128] tile reaches min_fill="
+                 f"{bdense_min_fill} on this graph/order — running "
+                 f"the sectioned residual only", **occ)
         if fuse:
             # in-register tile scales (ops/blockdense.py scale_dst/
             # scale_src) — the integer A-table stays intact
@@ -591,6 +625,10 @@ class Trainer:
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0
+        # observability: edge count for edges/sec and the memory
+        # model's estimate the compile observer checks XLA against
+        self._obs_edges = int(dataset.graph.num_edges)
+        self._modeled_bytes = modeled_step_bytes(model, dataset, config)
         self.labels = jnp.asarray(dataset.labels)
         self.mask = jnp.asarray(dataset.mask)
         key = jax.random.PRNGKey(config.seed)
@@ -640,7 +678,11 @@ class Trainer:
             self.feats_host = np.ascontiguousarray(
                 feats_np.astype(jnp.dtype(self.compute), copy=False))
             self.feats = None
-            self._tail_grad = jax.jit(self._tail_grad_impl)
+            from ..obs.compile_watch import ObservedJit
+            self._tail_grad = ObservedJit(
+                self._tail_grad_impl, name="tail_grad",
+                modeled_bytes=self._modeled_bytes,
+                verbose=config.verbose)
             self._tail_eval = jax.jit(self._tail_eval_impl)
             self._apply_update = jax.jit(self._apply_update_impl,
                                          donate_argnums=(0, 1))
@@ -688,10 +730,22 @@ class Trainer:
         # as an executable constant and recompile per Trainer instance
         # (the Reddit feature matrix alone is ~560 MB).  Only params +
         # opt state are donated — the data args are reused every step.
-        self._train_step = jax.jit(self._train_step_impl,
-                                   donate_argnums=(0, 1))
-        self._eval_step = jax.jit(self._eval_step_impl)
+        # ObservedJit records lower/compile wall time + XLA cost/memory
+        # introspection on the first call (obs/compile_watch.py).
+        from ..obs.compile_watch import ObservedJit
+        self._train_step = ObservedJit(self._train_step_impl,
+                                       name="train_step",
+                                       donate_argnums=(0, 1),
+                                       modeled_bytes=self._modeled_bytes,
+                                       verbose=config.verbose)
+        self._eval_step = ObservedJit(self._eval_step_impl,
+                                      name="eval_step",
+                                      verbose=config.verbose)
         self._predict_step = jax.jit(self._predict_impl)
+        from ..obs.manifest import run_manifest
+        run_manifest(config=self.config, dataset=dataset, model=model,
+                     extra={"modeled_step_bytes": self._modeled_bytes},
+                     console=config.verbose)
         from ..utils.profiling import EpochTimer, MetricsLog
         self.timer = EpochTimer()
         self.metrics_log = MetricsLog(config.metrics_path)
@@ -754,17 +808,25 @@ class Trainer:
         head_key, tail_key = jax.random.split(step_key)
         # cast the master weight to the compute dtype so the streamed
         # blocks (and Y, hence the whole tail) run in compute precision
-        # — the footprint the memory autopilot sized the plan with
+        # — the footprint the memory autopilot sized the plan with.
+        # The phase spans record host wall time per sub-phase WITHOUT
+        # extra barriers (the streamed head is already host-paced per
+        # block; a per-phase fetch would serialize the tail dispatch).
+        timer = self.timer
         w0 = self.params[self._head_param].astype(self.compute)
-        y = self._head.forward(w0, self.feats_host, head_key, True)
-        _, grads, gy = self._tail_grad(self.params, y, tail_key,
-                                       self.labels, self.mask,
-                                       self.gctx)
-        grads[self._head_param] = self._head.wgrad(
-            self.feats_host, gy, head_key, True
-        ).astype(self.params[self._head_param].dtype)
-        self.params, self.opt_state = self._apply_update(
-            self.params, self.opt_state, grads, lr)
+        with timer.span("head_forward"):
+            y = self._head.forward(w0, self.feats_host, head_key, True)
+        with timer.span("tail_grad"):
+            _, grads, gy = self._tail_grad(self.params, y, tail_key,
+                                           self.labels, self.mask,
+                                           self.gctx)
+        with timer.span("head_wgrad"):
+            grads[self._head_param] = self._head.wgrad(
+                self.feats_host, gy, head_key, True
+            ).astype(self.params[self._head_param].dtype)
+        with timer.span("update"):
+            self.params, self.opt_state = self._apply_update(
+                self.params, self.opt_state, grads, lr)
 
     # ---- loop ----
 
@@ -840,6 +902,7 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
     burst of steady steps (the reference prints every 5th epoch,
     ``gnn.cc:107-110``; same cadence, phase-shifted off the compile
     epoch)."""
+    from ..obs.heartbeat import Heartbeat
     from ..utils.profiling import trace
     cfg = tr.config
     epochs = epochs if epochs is not None else cfg.epochs
@@ -850,50 +913,103 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
     # per-trainer flag, NOT tr.epoch > 0: a checkpoint-restored trainer
     # in a fresh process has epoch > 0 but still compiles on step one
     compiled = getattr(tr, "_loop_compiled", False)
-    with trace(cfg.profile_dir):
-        for _ in range(epochs):
-            epoch = tr.epoch
-            lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
-                            cfg.decay_rate, cfg.decay_steps)
-            tr.key, step_key = jax.random.split(tr.key)
-            do_step(step_key, lr)
-            if not compiled:
-                # barrier the compile step out of the steady laps
-                tr.sync()
-                now = time.perf_counter()
-                compile_ms = (now - t_last) * 1e3
-                tr.timer.laps_ms.append(compile_ms)
-                t_last, e_last = now, tr.epoch + 1
-                compiled = tr._loop_compiled = True
-            if epoch % cfg.eval_every == cfg.eval_every - 1:
-                tr.sync()
-                now = time.perf_counter()
-                m = do_eval()
-                t_eval_end = time.perf_counter()
-                m["epoch"] = epoch
-                span = tr.epoch + 1 - e_last
-                if span <= 0:
-                    # no steady steps since the compile barrier (only
-                    # possible on the first eval with eval_every == 1):
-                    # the compile lap is the only honest number we have
-                    m["epoch_ms"] = compile_ms
-                else:
-                    m["epoch_ms"] = (now - t_last) * 1e3 / span
-                    tr.timer.laps_ms.append(m["epoch_ms"])
-                m["eval_ms"] = (t_eval_end - now) * 1e3
-                if compile_ms is not None:
-                    m["compile_ms"] = compile_ms
-                    compile_ms = None
-                t_last, e_last = t_eval_end, tr.epoch + 1
-                history.append(m)
-                tr.metrics_log.log(m)
-                if cfg.verbose:
-                    print(format_metrics(epoch, m))
-            tr.epoch += 1
-    # bound fds across many trainers; the log lazily reopens in
-    # append mode if train() is called again
-    tr.metrics_log.close()
+    try:
+        with trace(cfg.profile_dir):
+            for _ in range(epochs):
+                epoch = tr.epoch
+                lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
+                                cfg.decay_rate, cfg.decay_steps)
+                tr.key, step_key = jax.random.split(tr.key)
+                do_step(step_key, lr)
+                if not compiled:
+                    # barrier the compile step out of the steady laps;
+                    # the heartbeat turns the historical blank
+                    # "claiming backend" hang into dated stall events
+                    with Heartbeat("first_compile"):
+                        tr.sync()
+                    now = time.perf_counter()
+                    compile_ms = (now - t_last) * 1e3
+                    tr.timer.laps_ms.append(compile_ms)
+                    tr.timer.spans_ms.setdefault("compile", []).append(
+                        compile_ms)
+                    t_last, e_last = now, tr.epoch + 1
+                    compiled = tr._loop_compiled = True
+                if epoch % cfg.eval_every == cfg.eval_every - 1:
+                    tr.sync()
+                    now = time.perf_counter()
+                    m = do_eval()
+                    t_eval_end = time.perf_counter()
+                    m["epoch"] = epoch
+                    span = tr.epoch + 1 - e_last
+                    if span <= 0:
+                        # no steady steps since the compile barrier
+                        # (only possible on the first eval with
+                        # eval_every == 1): the compile lap is the only
+                        # honest number we have
+                        m["epoch_ms"] = compile_ms
+                    else:
+                        m["epoch_ms"] = (now - t_last) * 1e3 / span
+                        tr.timer.laps_ms.append(m["epoch_ms"])
+                        tr.timer.spans_ms.setdefault(
+                            "train", []).append(m["epoch_ms"])
+                    m["eval_ms"] = (t_eval_end - now) * 1e3
+                    tr.timer.spans_ms.setdefault("eval", []).append(
+                        m["eval_ms"])
+                    if compile_ms is not None:
+                        m["compile_ms"] = compile_ms
+                        compile_ms = None
+                    if span > 0:
+                        # throughput from honest steady laps only
+                        m.update(throughput_fields(tr, m["epoch_ms"]))
+                    t_last, e_last = t_eval_end, tr.epoch + 1
+                    history.append(m)
+                    tr.metrics_log.log(m)
+                    emit("epoch",
+                         f"epoch {epoch}: {m['epoch_ms']:.1f} ms/epoch "
+                         f"eval {m['eval_ms']:.1f} ms",
+                         console=False, **m)
+                    if cfg.verbose:
+                        print(format_metrics(epoch, m))
+                tr.epoch += 1
+    finally:
+        # bound fds across many trainers — on exceptions too; the log
+        # lazily reopens in append mode if train() is called again
+        tr.metrics_log.close()
+        if tr.timer.spans_ms:
+            emit("epoch", "phase spans "
+                 + " ".join(f"{k}:n={v['n']},p50={v['p50_ms']:.1f}ms"
+                            for k, v in
+                            tr.timer.span_summary().items()),
+                 console=False, spans=tr.timer.span_summary(),
+                 laps=tr.timer.summary())
     return history
+
+
+def throughput_fields(tr, epoch_ms: Optional[float]) -> Dict[str, float]:
+    """edges/sec and MFU-style utilization for one steady epoch lap.
+    FLOPs come from the compile observer's ``cost_analysis()`` capture
+    (per-device under SPMD — matching the per-chip peak the MFU ratio
+    divides by); missing introspection just drops the fields."""
+    out: Dict[str, float] = {}
+    if not epoch_ms or epoch_ms <= 0:
+        return out
+    s = epoch_ms / 1e3
+    edges = getattr(tr, "_obs_edges", None)
+    if edges:
+        out["edges_per_s"] = round(edges / s, 1)
+    cost = getattr(getattr(tr, "_train_step", None), "cost", None)
+    if not cost:
+        # features='host' streaming never calls _train_step — the
+        # observed step there is the device-resident tail
+        cost = getattr(getattr(tr, "_tail_grad", None), "cost", None)
+    flops = (cost or {}).get("flops")
+    if flops:
+        out["tflops_per_s"] = round(flops / s / 1e12, 4)
+        from ..obs.compile_watch import peak_flops_per_s
+        peak = peak_flops_per_s()
+        if peak:
+            out["mfu"] = round(flops / s / peak, 4)
+    return out
 
 
 def format_metrics(epoch: int, m: Dict[str, float]) -> str:

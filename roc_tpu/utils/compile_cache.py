@@ -33,9 +33,9 @@ def enable_compile_cache(cache_dir: Optional[str] = None,
     try:
         os.makedirs(d, exist_ok=True)
     except OSError as e:
-        import sys
-        print(f"# compile cache disabled: cannot create {d}: {e}",
-              file=sys.stderr)
+        from ..obs.events import emit
+        emit("compile", f"compile cache disabled: cannot create "
+             f"{d}: {e}", dir=d)
         return None
     jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",

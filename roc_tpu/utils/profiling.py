@@ -10,13 +10,18 @@ calls this a gap to fill, not copy).  The TPU-native equivalents:
   ``-lg:prof`` logs).
 - :class:`annotate` — ``jax.profiler.TraceAnnotation`` wrapper so epoch
   phases (forward/backward/update/eval) show up as named spans.
-- :class:`EpochTimer` — honest wall-clock epoch timing.  Under the
-  axon-tunneled TPU, ``block_until_ready`` does NOT synchronize, so
-  ``sync`` fetches a scalar reduction of a device array — the only
-  reliable barrier (see benchmarks/micro_agg.py).
+- :class:`EpochTimer` — honest wall-clock epoch timing, plus named
+  per-phase spans (train burst / eval / streamed-head sub-phases)
+  recorded with the same fetch barrier.  Under the axon-tunneled TPU,
+  ``block_until_ready`` does NOT synchronize, so ``sync`` fetches a
+  scalar reduction of a device array — the only reliable barrier (see
+  benchmarks/micro_agg.py).
 - :class:`MetricsLog` — structured training-metrics history with JSONL
   export; the rebuild of the reference's stdout-only ``PerfMetrics``
   prints (``softmax_kernel.cu:141-152``) as a queryable artifact.
+
+The structured event bus lives in ``roc_tpu/obs`` — this module stays
+the low-level timing layer it feeds.
 """
 
 from __future__ import annotations
@@ -64,14 +69,20 @@ def sync(x: Any) -> None:
 
 @dataclass
 class EpochTimer:
-    """Wall-clock per-epoch timer with warmup separation.
+    """Wall-clock per-epoch timer with warmup separation and named
+    per-phase spans.
 
     The first ``warmup`` laps (compile + cache effects) are recorded but
-    excluded from the summary statistics.
+    excluded from the summary statistics.  ``span(name)`` records a
+    phase (train burst, eval, halo exchange, streamed head
+    forward/wgrad, optimizer update) into its own series — the host-
+    visible analog of :func:`annotate`'s device-trace spans, summarized
+    by :meth:`span_summary` as p50/p90 per phase.
     """
 
     warmup: int = 1
     laps_ms: List[float] = field(default_factory=list)
+    spans_ms: Dict[str, List[float]] = field(default_factory=dict)
     _t0: Optional[float] = None
 
     def start(self) -> None:
@@ -94,6 +105,26 @@ class EpochTimer:
         finally:
             self.stop(sync_on=sync_on)
 
+    @contextlib.contextmanager
+    def span(self, name: str, sync_on: Any = None) -> Iterator[None]:
+        """Record one lap of the named phase.  To barrier on work
+        dispatched INSIDE the span, pass ``sync_on`` as a zero-arg
+        callable resolved at span exit (``sync_on=lambda: self.params``)
+        — a plain array argument is evaluated at ``with``-entry and can
+        only barrier on something that already existed, which is NOT an
+        end-of-phase mark for the span's own work.  The fetch-based
+        :func:`sync` is used either way (the only honest barrier under
+        the relay).  Independent of the epoch lap state: spans may nest
+        inside or across :meth:`lap` regions."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if sync_on is not None:
+                sync(sync_on() if callable(sync_on) else sync_on)
+            self.spans_ms.setdefault(name, []).append(
+                (time.perf_counter() - t0) * 1e3)
+
     def summary(self) -> Dict[str, float]:
         steady = self.laps_ms[self.warmup:] or self.laps_ms
         arr = np.asarray(steady, dtype=np.float64)
@@ -106,11 +137,30 @@ class EpochTimer:
             "min_ms": float(arr.min()) if arr.size else 0.0,
         }
 
+    def span_summary(self) -> Dict[str, Dict[str, float]]:
+        """Per-phase ``{n, total_ms, p50_ms, p90_ms}`` over every
+        recorded span lap (no warmup exclusion: phases that run once —
+        first compile — must still show up)."""
+        out: Dict[str, Dict[str, float]] = {}
+        for name, laps in self.spans_ms.items():
+            arr = np.asarray(laps, dtype=np.float64)
+            out[name] = {
+                "n": int(arr.size),
+                "total_ms": float(arr.sum()),
+                "p50_ms": float(np.median(arr)),
+                "p90_ms": float(np.percentile(arr, 90)),
+            }
+        return out
+
 
 class MetricsLog:
     """Append-only training metrics history with JSONL export.  The
     file handle opens lazily on first :meth:`log` (constructing many
-    trainers must not accumulate descriptors)."""
+    trainers must not accumulate descriptors).  Context-manager use
+    guarantees :meth:`close` on exceptions:
+
+    >>> with MetricsLog(path) as log: ...
+    """
 
     def __init__(self, path: Optional[str] = None):
         self.path = path
@@ -132,6 +182,12 @@ class MetricsLog:
         if self._fh:
             self._fh.close()
             self._fh = None
+
+    def __enter__(self) -> "MetricsLog":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def last(self) -> Optional[Dict[str, float]]:
         return self.records[-1] if self.records else None

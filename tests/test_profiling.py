@@ -4,6 +4,7 @@ import json
 import os
 
 import numpy as np
+import pytest
 
 from roc_tpu.utils.profiling import EpochTimer, MetricsLog, sync, trace
 
@@ -26,6 +27,36 @@ def test_epoch_timer_lap_context():
     assert len(t.laps_ms) == 1 and t.laps_ms[0] >= 0.0
 
 
+def test_epoch_timer_phase_spans():
+    t = EpochTimer()
+    for ms in (10.0, 12.0, 11.0):
+        t.spans_ms.setdefault("train", []).append(ms)
+    t.spans_ms["eval"] = [5.0]
+    with t.span("head_forward"):
+        pass
+    s = t.span_summary()
+    assert set(s) == {"train", "eval", "head_forward"}
+    assert s["train"]["n"] == 3
+    assert 10.0 <= s["train"]["p50_ms"] <= 12.0
+    assert s["train"]["p90_ms"] >= s["train"]["p50_ms"]
+    assert s["eval"]["total_ms"] == 5.0
+    assert s["head_forward"]["n"] == 1
+
+
+def test_epoch_timer_span_syncs_on_device_array():
+    import jax.numpy as jnp
+    t = EpochTimer()
+    with t.span("train", sync_on=jnp.ones((4,))):
+        pass
+    assert t.spans_ms["train"][0] >= 0.0
+    # callable form: resolved at span EXIT, so it can barrier on work
+    # dispatched inside the span
+    produced = {}
+    with t.span("dispatch", sync_on=lambda: produced["out"]):
+        produced["out"] = jnp.ones((4,)) * 2
+    assert len(t.spans_ms["dispatch"]) == 1
+
+
 def test_sync_fetches():
     import jax.numpy as jnp
     sync({"a": jnp.ones((3, 3))})  # must not raise
@@ -41,6 +72,45 @@ def test_metrics_log_jsonl(tmp_path):
     lines = [json.loads(l) for l in open(p)]
     assert lines[0]["train_loss"] == 1.5
     assert log.last()["epoch"] == 5
+
+
+def test_metrics_log_context_manager_closes(tmp_path):
+    p = str(tmp_path / "m.jsonl")
+    with MetricsLog(p) as log:
+        log.log({"epoch": 0, "loss": 1.0})
+        assert log._fh is not None
+    assert log._fh is None
+    # and on exception too
+    log2 = MetricsLog(p)
+    with pytest.raises(RuntimeError):
+        with log2:
+            log2.log({"epoch": 1})
+            raise RuntimeError("boom")
+    assert log2._fh is None
+    assert len([json.loads(l) for l in open(p)]) == 2
+
+
+def test_trainer_closes_metrics_log_on_exception(tmp_path):
+    """Trainer.train must close the metrics handle even when the epoch
+    loop dies mid-flight (the file-handle leak satellite)."""
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+
+    ds = synthetic_dataset(64, 6, in_dim=8, num_classes=3, seed=0)
+    p = str(tmp_path / "m.jsonl")
+    cfg = TrainConfig(epochs=4, eval_every=1, verbose=False,
+                      metrics_path=p, symmetric=True)
+    tr = Trainer(build_gcn([8, 8, 3]), ds, cfg)
+    tr.train(epochs=2)  # opens the handle via the first eval's log()
+
+    def boom():
+        raise RuntimeError("eval died")
+
+    tr.evaluate = boom
+    with pytest.raises(RuntimeError):
+        tr.train(epochs=2)
+    assert tr.metrics_log._fh is None
 
 
 def test_trace_noop_without_dir():
