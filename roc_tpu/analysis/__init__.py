@@ -1,0 +1,29 @@
+"""roc-lint: trace-level static analysis for jaxpr/HLO anti-patterns
+plus a rule-driven source lint — regressions against the invariants the
+ROC performance story rests on are caught BEFORE merge, not after a
+chip run.
+
+Three layers, mirroring XLA's own cost_analysis / HLO-verifier split:
+
+- :mod:`ast_lint` — source-level rules over the tree (stdout
+  discipline, host syncs in hot paths, jits bypassing the compile
+  observer, pallas interpret plumbing);
+- :mod:`jaxpr_lint` — rules over the ClosedJaxprs of both trainers'
+  step functions and the recorded-op model graph (bf16 upcasts,
+  host callbacks under jit, large non-donated buffers, cross-shard
+  materialization, int32 index-overflow hazards);
+- :mod:`hlo_lint` — rules over the optimized HLO text +
+  ``cost_analysis`` that ``ObservedJit`` already captures
+  (fusion-breaking copies of activation-scale tensors, bytes-accessed
+  vs the core/memory.py model).
+
+:mod:`driver` assembles the lint units (synthetic dataset, both
+trainers, the 8-virtual-device mesh) and runs every rule;
+``python -m roc_tpu.analysis`` is the CLI, ratcheted into tier-1 via
+``scripts/lint_baseline.json`` (tests/test_analysis.py).
+"""
+
+from .findings import Finding, load_baseline, save_baseline, split_findings
+
+__all__ = ["Finding", "load_baseline", "save_baseline",
+           "split_findings"]

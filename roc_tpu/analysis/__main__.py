@@ -1,0 +1,151 @@
+"""``python -m roc_tpu.analysis`` — the roc-lint CLI.
+
+Exit code 0 means the tree is clean modulo the baseline; any
+unbaselined finding exits 1 (lint semantics — this IS the gate the
+tier runs).  Stdout is the product: one ``unit:line: [rule] message``
+line per finding, then a summary.
+
+Usage:
+    python -m roc_tpu.analysis [--strict]          # full run
+    python -m roc_tpu.analysis --select stdout-print   # one rule
+    python -m roc_tpu.analysis --update-baseline   # shrink ratchet
+
+The baseline (``scripts/lint_baseline.json``) is ratchet-only:
+``--update-baseline`` rewrites it as the INTERSECTION of its current
+entries and the findings that still fire — it can only shrink.  New
+findings are fixed at the source or suppressed with an explanatory
+``# roc-lint: ok=<rule>`` pragma, never absorbed.  ``--strict``
+additionally fails on stale baseline entries, forcing the shrink to
+be committed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+
+def _default_root() -> str:
+    """Prefer CWD when it holds a roc_tpu/ tree (the thin-wrapper
+    scripts cd to the repo they lint), else the checkout this module
+    was imported from."""
+    if os.path.isdir(os.path.join(os.getcwd(), "roc_tpu")):
+        return os.getcwd()
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m roc_tpu.analysis",
+        description="roc-lint: jaxpr/HLO/AST static analysis, "
+                    "ratcheted via scripts/lint_baseline.json")
+    p.add_argument("--root", default=None,
+                   help="repo root to lint (default: cwd when it has "
+                        "a roc_tpu/ tree, else this checkout)")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule names (default: all); "
+                        "an AST-only selection skips the jax trace "
+                        "stage entirely")
+    p.add_argument("--no-trace", action="store_true",
+                   help="skip the jaxpr/HLO trace stage (AST only)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline path (default: "
+                        "<root>/scripts/lint_baseline.json)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="shrink-only rewrite of the baseline "
+                        "(drops entries that no longer fire)")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail on stale baseline entries "
+                        "(ratchet shrink must be committed)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule names and exit")
+    args = p.parse_args(argv)
+
+    select = ([s.strip() for s in args.select.split(",") if s.strip()]
+              if args.select else None)
+    trace = not args.no_trace
+    if trace and (select is None
+                  or any(s.startswith(("jaxpr-", "hlo-"))
+                         for s in select)):
+        # the trace stage runs on the 8-virtual-device CPU rig,
+        # unconditionally: the baseline fingerprints are CPU-rig
+        # artifacts, and a TPU-host invocation must not spend chip
+        # time (or drift the HLO) on a lint pass.  jax is ALREADY
+        # imported by the time -m reaches here (roc_tpu/__init__
+        # pulls it in), so the env var alone is latched-and-ignored —
+        # force the platform through jax.config like tests/conftest.py
+        # does; XLA_FLAGS is still read at CPU-client init, so the
+        # virtual-device count append works.
+        os.environ["JAX_PLATFORMS"] = "cpu"   # children / consistency
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from .driver import all_rule_names, analyze
+    from .findings import load_baseline, shrink_baseline, split_findings
+
+    if args.list_rules:
+        for name in all_rule_names():
+            print(name)
+        return 0
+    if select:
+        known = set(all_rule_names())
+        bad = sorted(set(select) - known)
+        if bad:
+            print(f"unknown rule(s): {', '.join(bad)}; see "
+                  f"--list-rules")
+            return 2
+
+    root = args.root or _default_root()
+    baseline_path = args.baseline or os.path.join(
+        root, "scripts", "lint_baseline.json")
+    findings = analyze(root, select=select, trace=trace)
+    # stale-entry accounting and the shrink ratchet are scoped to the
+    # rules that actually ran: an AST-only / --select run must not
+    # declare trace-rule baseline entries "no longer firing"
+    active = set(select) if select else set(all_rule_names())
+    if not trace:
+        active = {r for r in active
+                  if not r.startswith(("jaxpr-", "hlo-"))}
+    baseline = load_baseline(baseline_path)
+    new, old, stale = split_findings(findings, baseline,
+                                     active_rules=active)
+
+    for f in new:
+        print(f.render())
+    for f in old:
+        print(f"{f.render()}  [baselined]")
+    if args.update_baseline:
+        kept = shrink_baseline(baseline_path, findings,
+                               active_rules=active)
+        dropped = len(baseline) - len(kept)
+        print(f"baseline: kept {len(kept)}, dropped {dropped} stale "
+              f"entr{'y' if dropped == 1 else 'ies'} "
+              f"({baseline_path})")
+        stale = set()
+    elif stale:
+        verb = "FAIL" if args.strict else "note"
+        print(f"{verb}: {len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'} no longer "
+              f"fire(s) — run --update-baseline to ratchet down:")
+        for fp in sorted(stale):
+            print(f"  {fp}")
+
+    print(f"roc-lint: {len(new)} new, {len(old)} baselined, "
+          f"{len(stale)} stale")
+    if new:
+        return 1
+    if stale and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

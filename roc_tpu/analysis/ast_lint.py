@@ -1,0 +1,248 @@
+"""Rule-driven AST lint over the source tree.
+
+Generalizes the original ``scripts/lint_prints.sh`` heredoc (whose
+stdout-print rule migrated here verbatim) into a registry of rules,
+each scoped to the modules whose invariants it guards.  Suppression is
+per-line and self-documenting: a trailing ``# roc-lint: ok`` (any
+rule) or ``# roc-lint: ok=rule-a,rule-b`` on the flagged line — or the
+line above it — accepts the finding at the call site, with the comment
+text carrying the why.  jax-free by design: the AST layer must run in
+milliseconds with no backend.
+
+Adding a rule: subclass :class:`AstRule`, set ``name``/``why``,
+implement ``select`` (which repo-relative paths it lints) and
+``check`` (yield :class:`Finding`), and append an instance to
+:data:`RULES`.  Give every finding a line number and a stable ``key``
+if the message embeds location-dependent text.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+from typing import Iterable, List, Optional
+
+from .findings import Finding
+
+
+def pragma_ok(lines: List[str], lineno: Optional[int],
+              rule: str) -> bool:
+    """True when the flagged line (or the line above — decorators,
+    wrapped calls) carries a ``# roc-lint: ok`` pragma covering
+    ``rule``."""
+    if lineno is None:
+        return False
+    for ln in (lineno, lineno - 1):
+        if not 1 <= ln <= len(lines):
+            continue
+        text = lines[ln - 1]
+        mark = "roc-lint: ok"
+        pos = text.find(mark)
+        if pos < 0:
+            continue
+        rest = text[pos + len(mark):]
+        if not rest.startswith("="):
+            return True          # bare pragma: every rule
+        names = rest[1:].split()[0] if rest[1:].split() else ""
+        if rule in [r.strip() for r in names.split(",")]:
+            return True
+    return False
+
+
+class AstRule:
+    name = "abstract"
+    why = ""
+
+    def select(self, relpath: str) -> bool:
+        raise NotImplementedError
+
+    def check(self, tree: ast.AST, relpath: str) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+def _is_name(node: ast.AST, name: str) -> bool:
+    return isinstance(node, ast.Name) and node.id == name
+
+
+def _is_attr(node: ast.AST, attr: str,
+             base: Optional[str] = None) -> bool:
+    """``<base>.<attr>`` (any base when ``base`` is None)."""
+    return (isinstance(node, ast.Attribute) and node.attr == attr
+            and (base is None or _is_name(node.value, base)))
+
+
+class StdoutPrintRule(AstRule):
+    """Bare ``print()`` to stdout — stdout belongs to the metrics
+    stream (the ``[INFER]`` lines); diagnostics go through
+    ``roc_tpu.obs.events.emit`` or ``file=sys.stderr``.  Allowed
+    surfaces: the console event sink, the report CLI, and this
+    package's own CLI — places whose stdout IS their product."""
+
+    name = "stdout-print"
+    why = ("stdout is a clean metrics stream; route diagnostics "
+           "through roc_tpu.obs.events.emit (or file=sys.stderr for "
+           "pre-bus error paths)")
+    ALLOW_FILES = {"roc_tpu/obs/events.py", "roc_tpu/report.py",
+                   "roc_tpu/analysis/__main__.py"}
+
+    def select(self, relpath: str) -> bool:
+        return relpath not in self.ALLOW_FILES
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_name(node.func, "print")):
+                continue
+            if any(kw.arg == "file" for kw in node.keywords):
+                continue    # explicit stream (stderr error paths)
+            if (len(node.args) == 1
+                    and isinstance(node.args[0], ast.Call)
+                    and _is_name(node.args[0].func, "format_metrics")):
+                continue    # the sanctioned [INFER] metrics line
+            yield Finding(self.name, relpath,
+                          "bare print() to stdout", line=node.lineno,
+                          key=f"print@{node.lineno}")
+
+
+class HostSyncHotPathRule(AstRule):
+    """Implicit device→host syncs in hot-path modules: a single
+    ``jax.device_get`` / ``.item()`` / ``float(arr)`` inside the
+    aggregation/kernel/streaming code serializes the dispatch pipeline
+    every step — exactly the stall class the async epoch loop exists
+    to avoid.  ``float()`` of a plain name or literal (config scalars)
+    is not flagged; computed expressions are."""
+
+    name = "host-sync-hot-path"
+    why = ("hot-path modules must stay fetch-free: host syncs "
+           "serialize the async dispatch pipeline")
+    HOT_PREFIXES = ("roc_tpu/ops/", "roc_tpu/kernels/")
+    HOT_FILES = {"roc_tpu/core/streaming.py"}
+
+    def select(self, relpath: str) -> bool:
+        return (relpath.startswith(self.HOT_PREFIXES)
+                or relpath in self.HOT_FILES)
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_attr(node.func, "device_get") or \
+                    _is_name(node.func, "device_get"):
+                yield Finding(self.name, relpath,
+                              "jax.device_get in a hot-path module",
+                              line=node.lineno,
+                              key=f"device_get@{node.lineno}")
+            elif (_is_attr(node.func, "item") and not node.args
+                    and not node.keywords):
+                yield Finding(self.name, relpath,
+                              ".item() in a hot-path module "
+                              "(implicit device fetch)",
+                              line=node.lineno,
+                              key=f"item@{node.lineno}")
+            elif (_is_name(node.func, "float") and len(node.args) == 1
+                    and not isinstance(node.args[0],
+                                       (ast.Constant, ast.Name))):
+                yield Finding(self.name, relpath,
+                              "float(<expr>) in a hot-path module "
+                              "(implicit device fetch on arrays)",
+                              line=node.lineno,
+                              key=f"float@{node.lineno}")
+
+
+class BareJitRule(AstRule):
+    """``jax.jit`` in the trainer/parallel layers that bypasses
+    ``ObservedJit`` — such steps compile invisibly: no lower/compile
+    wall time, no cost/memory introspection, no modeled-vs-actual HBM
+    check.  Allowed only lexically inside an ``ObservedJit(...)`` call
+    (the ``jitfn=jax.jit(...)`` form for pre-wrapped shard_map
+    steps)."""
+
+    name = "bare-jit"
+    why = ("steps must compile through ObservedJit so cost/memory "
+           "introspection and the modeled-vs-actual HBM check see "
+           "them")
+    PREFIXES = ("roc_tpu/train/", "roc_tpu/parallel/")
+
+    def select(self, relpath: str) -> bool:
+        return relpath.startswith(self.PREFIXES)
+
+    def check(self, tree, relpath):
+        observed_spans = []
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Call)
+                    and (_is_name(node.func, "ObservedJit")
+                         or _is_attr(node.func, "ObservedJit"))):
+                observed_spans.append(
+                    (node.lineno, node.end_lineno or node.lineno))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_attr(node.func, "jit", base="jax")):
+                continue
+            if any(lo <= node.lineno <= hi
+                   for lo, hi in observed_spans):
+                continue    # ObservedJit(jitfn=jax.jit(...)) form
+            yield Finding(self.name, relpath,
+                          "bare jax.jit bypasses ObservedJit",
+                          line=node.lineno,
+                          key=f"jit@{node.lineno}")
+
+
+class PallasInterpretRule(AstRule):
+    """Every ``pl.pallas_call`` must plumb ``interpret=`` — kernels
+    without it cannot run on the CPU test rig (jax dropped the global
+    force_tpu_interpret_mode switch), so their coverage silently
+    evaporates."""
+
+    name = "pallas-interpret"
+    why = ("kernels must expose interpret= or they are untestable on "
+           "the CPU rig")
+
+    def select(self, relpath: str) -> bool:
+        return relpath.startswith("roc_tpu/kernels/")
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and _is_attr(node.func, "pallas_call")):
+                continue
+            if any(kw.arg == "interpret" for kw in node.keywords):
+                continue
+            yield Finding(self.name, relpath,
+                          "pallas_call without interpret= plumbing",
+                          line=node.lineno,
+                          key=f"pallas@{node.lineno}")
+
+
+RULES: List[AstRule] = [StdoutPrintRule(), HostSyncHotPathRule(),
+                        BareJitRule(), PallasInterpretRule()]
+
+
+def run_ast_lint(root: str,
+                 select: Optional[List[str]] = None) -> List[Finding]:
+    """Run the AST rules over ``<root>/roc_tpu/**/*.py``.  ``select``
+    restricts to the named rules (unknown names raise — a typo must
+    not silently skip a gate)."""
+    rules = RULES
+    if select is not None:
+        known = {r.name for r in RULES}
+        bad = [s for s in select if s not in known and
+               not s.startswith(("jaxpr-", "hlo-"))]
+        if bad:
+            raise ValueError(f"unknown lint rule(s): {bad}; "
+                             f"AST rules: {sorted(known)}")
+        rules = [r for r in RULES if r.name in select]
+    findings: List[Finding] = []
+    base = pathlib.Path(root)
+    for path in sorted(base.glob("roc_tpu/**/*.py")):
+        rel = path.relative_to(base).as_posix()
+        applicable = [r for r in rules if r.select(rel)]
+        if not applicable:
+            continue
+        src = path.read_text()
+        lines = src.splitlines()
+        tree = ast.parse(src, filename=rel)
+        for rule in applicable:
+            for f in rule.check(tree, rel):
+                if not pragma_ok(lines, f.line, rule.name):
+                    findings.append(f)
+    return findings

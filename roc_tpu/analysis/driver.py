@@ -1,0 +1,178 @@
+"""Assemble lint units and run every rule — the engine behind
+``python -m roc_tpu.analysis``.
+
+The trace stage builds BOTH trainers against a small synthetic
+dataset (the same 8-virtual-device CPU rig the test tier uses), traces
+their train/eval step functions and the recorded-op model graph to
+ClosedJaxprs, and compiles the single-device train step once for the
+HLO rules.  Mixed precision (fp32 master / bf16 compute) is used so
+the bf16-path rules actually arm — the invariants under lint are the
+production configs', not float32 toy semantics.
+
+Findings are emitted as ``analysis``-category obs events (JSONL
+artifact + machine-readable CI trail) in addition to being returned.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from ..obs.events import emit
+from .ast_lint import RULES as AST_RULES, run_ast_lint
+from .findings import Finding, dedupe
+from .hlo_lint import check_bytes_model, check_large_copy
+from .jaxpr_lint import JAXPR_RULES, JaxprUnit, run_jaxpr_lint
+
+HLO_RULES = ("hlo-large-copy", "hlo-bytes-model")
+
+# synthetic rig: big enough that activation scale ([V, F]) dominates
+# class-width tensors ([V, C]) AND per-device activation scale
+# (V/8 * F on the mesh) dominates parameter scale (F * H) by the
+# margins the rules assume; small enough that the whole stage
+# (3 trainer builds + 1 CPU compile) stays inside the tier's <60 s
+# budget
+_V, _DEG, _F, _C, _H = 256, 6, 48, 6, 24
+
+
+def all_rule_names() -> List[str]:
+    return ([r.name for r in AST_RULES] + list(JAXPR_RULES)
+            + list(HLO_RULES))
+
+
+def _needs_trace(select: Optional[List[str]]) -> bool:
+    if select is None:
+        return True
+    return any(s.startswith(("jaxpr-", "hlo-")) for s in select)
+
+
+def build_trace_findings(select: Optional[List[str]] = None,
+                         hlo_factor: float = 32.0) -> List[Finding]:
+    """Trace/compile the step functions and run the jaxpr + HLO rules.
+    Needs a jax backend (the CLI forces the 8-virtual-device CPU rig);
+    import stays inside so the AST-only path never touches jax."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.graph import synthetic_dataset
+    from ..models.gcn import build_gcn
+    from ..train.trainer import TrainConfig, Trainer
+
+    ds = synthetic_dataset(num_nodes=_V, avg_degree=_DEG, in_dim=_F,
+                           num_classes=_C, seed=0)
+    cfg = TrainConfig(verbose=False, symmetric=True,
+                      dtype=jnp.float32, compute_dtype=jnp.bfloat16)
+    model = build_gcn([_F, _H, _C], dropout_rate=0.5)
+    tr = Trainer(model, ds, cfg)
+    key = jax.random.PRNGKey(0)
+    lr = jnp.asarray(0.01, jnp.float32)
+    donate_min = max(int(v.size) * v.dtype.itemsize
+                     for v in jax.tree_util.tree_leaves(tr.params))
+    ctx: Dict[str, Any] = dict(
+        compute_dtype="bfloat16", num_nodes=_V, vf_elems=_V * _F,
+        halo="gather", donate_min_bytes=donate_min)
+
+    units = [
+        JaxprUnit("train_step", jax.make_jaxpr(tr._train_step._jit)(
+            tr.params, tr.opt_state, key, lr, tr.feats, tr.labels,
+            tr.mask, tr.gctx), **ctx),
+        JaxprUnit("eval_step", jax.make_jaxpr(tr._eval_step._jit)(
+            tr.params, tr.feats, tr.labels, tr.mask, tr.gctx), **ctx),
+        # the recorded-op model graph, traced directly (no pjit): the
+        # builder's interpreter is where an op-list rewrite (fusion,
+        # streaming split) would first leak an anti-pattern
+        JaxprUnit("model_graph", jax.make_jaxpr(
+            lambda p: tr.model.loss_fn(
+                p, tr.feats, tr.labels, tr.mask, tr.gctx, key=key,
+                train=True))(tr.params), **ctx),
+    ]
+
+    # the host-feature streaming tier: its device-resident steps
+    # (tail grad + the optimizer apply) are separate dispatch
+    # boundaries with their own donation contracts
+    str_tr = Trainer(build_gcn([_F, _H, _C], dropout_rate=0.5), ds,
+                     TrainConfig(verbose=False, symmetric=True,
+                                 features="host",
+                                 dtype=jnp.float32,
+                                 compute_dtype=jnp.bfloat16))
+    y = jnp.zeros((_V, _H), jnp.bfloat16)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, str_tr.params)
+    units.append(JaxprUnit(
+        "tail_grad", jax.make_jaxpr(str_tr._tail_grad._jit)(
+            str_tr.params, y, key, str_tr.labels, str_tr.mask,
+            str_tr.gctx), **ctx))
+    units.append(JaxprUnit(
+        "apply_update", jax.make_jaxpr(str_tr._apply_update._jit)(
+            str_tr.params, str_tr.opt_state, grads, lr), **ctx))
+
+    if len(jax.devices()) > 1:
+        from ..parallel.distributed import DistributedTrainer
+        parts = len(jax.devices())
+        dtr = DistributedTrainer(
+            build_gcn([_F, _H, _C], dropout_rate=0.5), ds, parts,
+            TrainConfig(verbose=False, symmetric=True,
+                        dtype=jnp.float32,
+                        compute_dtype=jnp.bfloat16))
+        d = dtr.data
+        fuse_tabs = (d.ell_w, d.sect_w, d.ring_w, d.bd_scale)
+        dctx = dict(ctx)
+        dctx["halo"] = dtr.config.halo
+        # shard_map body avals are block-local: scale-relative rules
+        # compare against the PER-DEVICE activation footprint
+        dctx["vf_elems"] = (_V * _F) // parts
+        dctx["mesh_parts"] = parts
+        units.append(JaxprUnit(
+            "dist_train_step", jax.make_jaxpr(dtr._train_step._jit)(
+                dtr.params, dtr.opt_state, d.feats, d.labels, d.mask,
+                d.edge_src, d.edge_dst, d.in_degree, d.ell_idx,
+                d.ell_row_pos, d.ell_row_id, d.ring_idx, d.sect_idx,
+                d.sect_sub_dst, d.bd_tabs, fuse_tabs, key, lr),
+            **dctx))
+        units.append(JaxprUnit(
+            "dist_eval_step", jax.make_jaxpr(dtr._eval_step._jit)(
+                dtr.params, d.feats, d.labels, d.mask, d.edge_src,
+                d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
+                d.ell_row_id, d.ring_idx, d.sect_idx, d.sect_sub_dst,
+                d.bd_tabs, fuse_tabs),
+            **dctx))
+
+    findings = run_jaxpr_lint(units, select=select)
+
+    hlo_selected = (select is None
+                    or any(s.startswith("hlo-") for s in select))
+    if hlo_selected:
+        from ..obs.compile_watch import cost_summary
+        compiled = tr._train_step._jit.lower(
+            tr.params, tr.opt_state, key, lr, tr.feats, tr.labels,
+            tr.mask, tr.gctx).compile()
+        if select is None or "hlo-large-copy" in select:
+            findings.extend(check_large_copy(
+                "hlo:train_step", compiled.as_text(),
+                copy_min_elems=_V * _F))
+        if select is None or "hlo-bytes-model" in select:
+            findings.extend(check_bytes_model(
+                "hlo:train_step",
+                cost_summary(compiled).get("bytes_accessed"),
+                tr._modeled_bytes, factor=hlo_factor))
+    return findings
+
+
+def analyze(root: str, select: Optional[List[str]] = None,
+            trace: bool = True) -> List[Finding]:
+    """AST lint over ``root`` plus (when ``trace`` and a trace rule is
+    selected) the jaxpr/HLO stage.  Every finding is also emitted as
+    an ``analysis``-category event."""
+    t0 = time.perf_counter()
+    findings = run_ast_lint(root, select=select)
+    if trace and _needs_trace(select):
+        findings.extend(build_trace_findings(select=select))
+    findings = dedupe(findings)
+    for f in findings:
+        emit("analysis", f.render(), console=False, rule=f.rule,
+             unit=f.unit, line=f.line, fingerprint=f.fingerprint)
+    emit("analysis",
+         f"roc-lint: {len(findings)} finding(s) in "
+         f"{time.perf_counter() - t0:.1f}s", console=False,
+         count=len(findings),
+         rules=sorted({f.rule for f in findings}))
+    return findings

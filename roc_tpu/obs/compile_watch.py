@@ -154,6 +154,10 @@ class ObservedJit:
             jitfn = jax.jit(fn, donate_argnums=donate_argnums)
         self._jit = jitfn
         self.name = name
+        # recorded for introspection (roc_tpu/analysis maps jaxpr
+        # invars back to donated argnums); with jitfn= the caller
+        # passes the argnums its own jax.jit was built with
+        self.donate_argnums = donate_argnums
         self.modeled_bytes = modeled_bytes
         self.verbose = verbose
         self.cost: Optional[Dict[str, Any]] = None  # last compile event

@@ -39,8 +39,9 @@ from typing import Any, Dict, List, Optional
 #   bench     benchmark stage lifecycle
 #   stall     heartbeat "still waiting in <stage>" events
 #   run       CLI lifecycle (resume, checkpoint, artifact writes)
+#   analysis  roc-lint findings (python -m roc_tpu.analysis)
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
-              "bench", "stall", "run")
+              "bench", "stall", "run", "analysis")
 
 
 def _jsonable(v: Any) -> Any:

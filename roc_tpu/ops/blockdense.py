@@ -344,6 +344,8 @@ def probe_dense_frac(row_ptr: np.ndarray, col_idx: np.ndarray,
                                        BLOCK, num_cols=num_cols)
     sel = _select_dense(counts, min_fill, a_budget_bytes, group=group,
                         dst_of=keys // n_tiles)
+    # host-side numpy census in the planning probe; no device array
+    # within sight: roc-lint: ok=host-sync-hot-path
     frac = float(counts[sel].sum()) / E
     return (frac, (keys, counts)) if return_census else frac
 

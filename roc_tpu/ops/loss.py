@@ -68,7 +68,9 @@ def summarize_metrics(m: Dict[str, jax.Array]) -> Dict[str, float]:
     def _div(a, b):
         return float(a) / max(float(b), 1.0)
     return {
-        # the reference prints the raw sum, not a mean
+        # the reference prints the raw sum, not a mean.  Callers pass
+        # device_get'd numpy — post-fetch summary, not the step path:
+        # roc-lint: ok=host-sync-hot-path
         "train_loss": float(m["train_loss_sum"]),
         "train_acc": _div(m["train_correct"], m["train_cnt"]),
         "val_acc": _div(m["val_correct"], m["val_cnt"]),

@@ -667,12 +667,17 @@ class DistributedTrainer:
         self._obs_edges = int(dataset.graph.num_edges)
         self._modeled_bytes = modeled_step_bytes(
             model, dataset, config, num_parts=num_parts)
+        # the jax.jit calls sit lexically inside ObservedJit(jitfn=...)
+        # — the sanctioned form roc-lint's bare-jit rule recognizes:
+        # every step compiles through the observer
         self._train_step = ObservedJit(
-            jitfn=self._build_train_step(), name="dist_train_step",
+            jitfn=jax.jit(self._build_train_step(),
+                          donate_argnums=(0, 1)),
+            name="dist_train_step", donate_argnums=(0, 1),
             modeled_bytes=self._modeled_bytes, verbose=config.verbose)
         self._eval_step = ObservedJit(
-            jitfn=self._build_eval_step(), name="dist_eval_step",
-            verbose=config.verbose)
+            jitfn=jax.jit(self._build_eval_step()),
+            name="dist_eval_step", verbose=config.verbose)
         self._predict_step = None   # built lazily on first predict()
         from ..obs.manifest import run_manifest
         run_manifest(config=self.config, dataset=dataset, model=model,
@@ -779,13 +784,12 @@ class DistributedTrainer:
                                             self.adam_cfg)
             return params, opt_state, loss
 
-        sm = _shard_map(
+        return _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_r, spec_r),
             out_specs=(spec_r, spec_r, spec_r))
-        return jax.jit(sm, donate_argnums=(0, 1))
 
     def _local_forward(self, params, feats, edge_src, edge_dst,
                        in_degree, ell_idx, ell_row_pos, ell_row_id,
@@ -815,13 +819,12 @@ class DistributedTrainer:
             return jax.tree_util.tree_map(
                 lambda t: lax.psum(t, "parts"), m)
 
-        sm = _shard_map(
+        return _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p),
             out_specs=spec_r)
-        return jax.jit(sm)
 
     # ---- loop ----
 
@@ -867,7 +870,10 @@ class DistributedTrainer:
         (a P('parts')-sharded device_get would touch non-addressable
         shards there)."""
         if self._predict_step is None:
-            self._predict_step = self._build_predict_step()
+            from ..obs.compile_watch import ObservedJit
+            self._predict_step = ObservedJit(
+                jitfn=jax.jit(self._build_predict_step()),
+                name="dist_predict_step", verbose=self.config.verbose)
         d = self.data
         logits = jax.device_get(self._predict_step(
             self.params, d.feats, d.edge_src, d.edge_dst, d.in_degree,
@@ -886,10 +892,9 @@ class DistributedTrainer:
             # replicated [P, part_nodes, C]
             return lax.all_gather(logits, "parts", axis=0)
 
-        sm = _shard_map(
+        return _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p),
             out_specs=spec_r)
-        return jax.jit(sm)

@@ -55,6 +55,24 @@ def init_distributed(coordinator_address: Optional[str] = None,
         "JAX_COORDINATOR_ADDRESS")
     if coordinator_address is None:
         return
+    platforms = str(jax.config.jax_platforms
+                    or os.environ.get("JAX_PLATFORMS") or "")
+    if "cpu" in platforms or not platforms:
+        # XLA:CPU's default in-process collectives cannot cross
+        # address spaces ("Multiprocess computations aren't
+        # implemented on the CPU backend") — multi-process CPU runs
+        # (the 2-process DCN parity tests, loopback rehearsals of pod
+        # topologies) need the Gloo transport selected BEFORE the
+        # backend initializes.  Armed too when the platform is
+        # auto-detected (empty): the flag only shapes the CPU client,
+        # which accelerator-backend collectives never route through,
+        # so TPU/GPU pods are unaffected; an EXPLICIT non-cpu platform
+        # list skips it.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation",
+                              "gloo")
+        except Exception:  # noqa: BLE001 - flag renamed across jax
+            pass
     num_processes = num_processes if num_processes is not None else int(
         os.environ.get("JAX_NUM_PROCESSES", "1"))
     process_id = process_id if process_id is not None else int(
@@ -120,6 +138,8 @@ def _allreduce_part_vec_max(mesh: Mesh, local: List[int],
         mesh, local,
         [np.asarray(vecs[p], dtype=np.int64)[None] for p in local],
         (num_parts, width))
+    # one-shot bootstrap collective at table build, not a training
+    # step — compile telemetry would be noise: roc-lint: ok=bare-jit
     reduce = jax.jit(lambda a: jnp.max(a, axis=0),
                      out_shardings=NamedSharding(mesh, P()))
     return np.asarray(reduce(arr))
@@ -142,6 +162,7 @@ def _allreduce_part_stats(mesh: Mesh, local: List[int],
         [np.asarray([[stats[p][0], stats[p][1]]], dtype=np.int64)
          for p in local],
         (num_parts, 2))
+    # one-shot bootstrap collective — roc-lint: ok=bare-jit
     reduce = jax.jit(
         lambda a: jnp.stack([jnp.max(a[:, 0]), jnp.sum(a[:, 1])]),
         out_shardings=NamedSharding(mesh, P()))

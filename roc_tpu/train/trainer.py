@@ -679,13 +679,26 @@ class Trainer:
                 feats_np.astype(jnp.dtype(self.compute), copy=False))
             self.feats = None
             from ..obs.compile_watch import ObservedJit
+            # y (arg 1) is donated: the projected [V, H] activation is
+            # rebuilt by the streamed head every step and never read
+            # after this call — undonated it doubled its residency
+            # across the tail (found by roc-lint jaxpr-non-donated)
             self._tail_grad = ObservedJit(
                 self._tail_grad_impl, name="tail_grad",
+                donate_argnums=(1,),
                 modeled_bytes=self._modeled_bytes,
                 verbose=config.verbose)
-            self._tail_eval = jax.jit(self._tail_eval_impl)
-            self._apply_update = jax.jit(self._apply_update_impl,
-                                         donate_argnums=(0, 1))
+            self._tail_eval = ObservedJit(self._tail_eval_impl,
+                                          name="tail_eval",
+                                          verbose=config.verbose)
+            # grads (arg 2) are donated too: they are rebuilt every
+            # step and never read after the update — undonated they'd
+            # hold a param-sized buffer alive across the whole apply
+            # (found by roc-lint jaxpr-non-donated)
+            self._apply_update = ObservedJit(self._apply_update_impl,
+                                             name="apply_update",
+                                             donate_argnums=(0, 1, 2),
+                                             verbose=config.verbose)
         else:
             self.feats = jnp.asarray(dataset.features,
                                      dtype=self.compute)
@@ -741,7 +754,9 @@ class Trainer:
         self._eval_step = ObservedJit(self._eval_step_impl,
                                       name="eval_step",
                                       verbose=config.verbose)
-        self._predict_step = jax.jit(self._predict_impl)
+        self._predict_step = ObservedJit(self._predict_impl,
+                                         name="predict_step",
+                                         verbose=config.verbose)
         from ..obs.manifest import run_manifest
         run_manifest(config=self.config, dataset=dataset, model=model,
                      extra={"modeled_step_bytes": self._modeled_bytes},
@@ -859,10 +874,12 @@ class Trainer:
             w0 = self.params[self._head_param].astype(self.compute)
             y = self._head.forward(w0, self.feats_host, None, False)
             if self._tail_predict is None:
-                self._tail_predict = jax.jit(
+                from ..obs.compile_watch import ObservedJit
+                self._tail_predict = ObservedJit(
                     lambda p, yy, g: self._tail_model.apply(
                         cast_floats(p, self.compute), yy, g,
-                        key=None, train=False))
+                        key=None, train=False),
+                    name="tail_predict", verbose=self.config.verbose)
             return self._tail_predict(self.params, y, self.gctx)
         return self._predict_step(self.params, self.feats, self.gctx)
 

@@ -40,4 +40,16 @@ def enable_compile_cache(cache_dir: Optional[str] = None,
     jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs",
                       float(min_compile_secs))
+    # The persistent cache object is created once, on the first
+    # compilation after it's configured — a later config update alone
+    # does NOT re-point an already-initialized cache (observed: the
+    # CLI's default-dir cache swallowing a later explicit dir in the
+    # same process).  Dropping the instance makes the next compile
+    # re-initialize against the directory just configured.
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc)
+        _cc.reset_cache()
+    except Exception:  # noqa: BLE001 - older/newer jax layouts
+        pass
     return d

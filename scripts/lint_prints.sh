@@ -1,48 +1,15 @@
 #!/bin/sh
-# Print ratchet: stdout belongs to the metrics stream.  Fails when a
-# bare print() (no file= keyword, i.e. stdout) appears in roc_tpu/
-# outside the allowed surfaces:
-#   - the event-log console sink (roc_tpu/obs/events.py) — the ONE
-#     place diagnostics are rendered (to stderr);
-#   - print(format_metrics(...)) — the reference's [INFER] metrics
-#     line, the only sanctioned stdout output of a training run;
-#   - roc_tpu/report.py — the report CLI, whose stdout IS its product.
-# Diagnostics must go through roc_tpu.obs.events.emit (or, for
-# pre-bus error paths, print(..., file=sys.stderr)).  AST-based so
-# multi-line calls with file=sys.stderr on a later line never
-# false-positive.  Wired into the test tier via tests/test_obs.py.
+# Print ratchet: stdout belongs to the metrics stream.  Thin wrapper
+# kept so round-chain scripts and muscle memory don't break — the
+# AST heredoc that used to live here migrated verbatim into the
+# rule-driven linter (roc_tpu/analysis/ast_lint.py, rule
+# 'stdout-print'; see `python -m roc_tpu.analysis --list-rules` for
+# the full rule set this is one slice of).
+#
+# Lints the tree THIS script sits in (the planted-violation test
+# copies it into a scratch tree); roc_tpu.analysis itself is imported
+# from wherever sys.path finds it, so set PYTHONPATH when the linted
+# tree does not contain the analysis package.
 set -e
 cd "$(dirname "$0")/.."
-exec python - <<'PY'
-import ast
-import pathlib
-import sys
-
-ALLOW_FILES = {"roc_tpu/obs/events.py", "roc_tpu/report.py"}
-bad = []
-for path in sorted(pathlib.Path("roc_tpu").rglob("*.py")):
-    rel = path.as_posix()
-    if rel in ALLOW_FILES:
-        continue
-    tree = ast.parse(path.read_text(), filename=rel)
-    for node in ast.walk(tree):
-        if not (isinstance(node, ast.Call)
-                and isinstance(node.func, ast.Name)
-                and node.func.id == "print"):
-            continue
-        if any(kw.arg == "file" for kw in node.keywords):
-            continue  # explicit stream (stderr error paths)
-        if (len(node.args) == 1 and isinstance(node.args[0], ast.Call)
-                and isinstance(node.args[0].func, ast.Name)
-                and node.args[0].func.id == "format_metrics"):
-            continue  # the sanctioned [INFER] metrics line
-        bad.append(f"{rel}:{node.lineno}")
-if bad:
-    print("bare print() to stdout in roc_tpu/ — route diagnostics "
-          "through roc_tpu.obs.events.emit "
-          "(or file=sys.stderr for pre-bus error paths):")
-    for b in bad:
-        print(f"  {b}")
-    sys.exit(1)
-print("lint_prints: OK (stdout stays a clean metrics stream)")
-PY
+exec python -m roc_tpu.analysis --root . --select stdout-print

@@ -7,6 +7,9 @@
 set -e
 LR=$1; WD=$2; DR=$3; DROP=$4; LAYERS=$5; EPOCHS=$6
 shift 6 || true
+# pre-flight static analysis (roc-lint): regressions against the
+# perf invariants fail HERE, before any chip time is spent
+python -m roc_tpu.analysis --strict
 exec python -m roc_tpu.train.cli \
     -lr "$LR" -decay "$WD" -decay-rate "$DR" -dropout "$DROP" \
     -layers "$LAYERS" -e "$EPOCHS" -file dataset/reddit-dgl "$@"

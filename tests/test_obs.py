@@ -313,9 +313,14 @@ def test_lint_prints_catches_stdout_leak(tmp_path):
     dst = planted / "roc_tpu"
     dst.mkdir()
     (dst / "leaky.py").write_text("print('oops stdout')\n")
+    # the planted tree has no roc_tpu.analysis package — the thin
+    # wrapper imports the linter from the real checkout via PYTHONPATH
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     r = subprocess.run(["sh", str(planted / "scripts" /
                                   "lint_prints.sh")],
-                       capture_output=True, text=True, timeout=60)
+                       capture_output=True, text=True, timeout=60,
+                       env=env)
     assert r.returncode == 1
     assert "leaky.py:1" in r.stdout
     assert os.path.exists(victim)  # the real tree untouched
