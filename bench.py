@@ -79,9 +79,19 @@ STAGES = (("probe", 150.0, 40.0),
 # seconds between probe attempt STARTS while the tunnel is down — a
 # wedged relay recovers on the ~30 min scale, so probes are spread
 # across the whole deadline instead of front-loaded backoff (the r03
-# failure mode: four probes bunched into the first 6 minutes)
+# failure mode: four probes bunched into the first 6 minutes).
+# ROC_TPU_BENCH_PROBE_INTERVAL / ROC_TPU_BENCH_PROBE_TIMEOUT override
+# the spacing and the probe child timeout (tests, tunnel tuning).
 _PROBE_INTERVAL = 210.0
 _PROBE_PROGRESS = "probe_progress.txt"
+
+
+def _probe_interval() -> float:
+    try:
+        return float(os.environ.get("ROC_TPU_BENCH_PROBE_INTERVAL",
+                                    _PROBE_INTERVAL))
+    except ValueError:
+        return _PROBE_INTERVAL
 
 
 def _light_obs_imports() -> None:
@@ -407,6 +417,22 @@ def _read_probe_progress() -> list:
         return []
 
 
+def _probe_phase(progress: list) -> str:
+    """Normalized terminal phase of a (possibly dead) probe attempt:
+    the last progress marker with timestamps/durations collapsed, so
+    two attempts that died at the same point compare equal (the
+    retry-abort signal — r04/r05 burned the whole deadline re-dying
+    at the identical phase five times)."""
+    if not progress:
+        # run_child imports jax (for the compile cache) before the
+        # first marker is written, so an empty file means the import
+        # itself never finished
+        return "no-progress (died in the jax/roc_tpu import)"
+    last = progress[-1]
+    txt = last.split(" ", 1)[1] if " " in last else last
+    return re.sub(r"[0-9.]+", "N", txt)
+
+
 # -------------------------------------------------- relay health check
 
 def _relay_health(port: int = None, timeout: float = 2.0) -> dict:
@@ -637,6 +663,28 @@ def child_micro(args) -> dict:
                                        "gbps": round(gb / ms * 1e3, 1)}
         except Exception as e:  # noqa: BLE001
             rows[f"{impl}:{chunk}"] = {"error": _errstr(e)}
+
+    # micro_stream rows: the streamed-tier host->device pipeline, sync
+    # vs prefetched staging (core/streaming.py StagingPool) — the
+    # comm/compute overlap win shows up in BENCH_* next to the
+    # aggregation race (benchmarks/micro_stream.py is the full probe)
+    try:
+        from roc_tpu.core.streaming import StreamedHead
+        Vs, Fs, Hs, bs = 262_144, 128, 64, 32_768
+        Xh = np.random.RandomState(1).rand(Vs, Fs).astype(np.float32)
+        Wh = jnp.asarray(np.random.RandomState(2).rand(
+            Fs, Hs).astype(np.float32))
+        for depth, label in ((0, "stream:sync"), (1, "stream:prefetch")):
+            head = StreamedHead(0.0, block_rows=bs, prefetch=depth)
+            ms = bench(lambda: head.forward(Wh, Xh, None, False))
+            st = head.pool.take_stats()  # summary computed on the pool
+            rows[label] = {
+                "ms": round(ms, 2), "prefetch": depth,
+                "h2d_wait_p50_ms": st["wait_p50_ms"],
+                "overlap_frac": st["overlap_frac"],
+                "max_live_blocks": int(st["max_live"])}
+    except Exception as e:  # noqa: BLE001 - report and continue
+        rows["stream"] = {"error": _errstr(e)}
     return {"platform": dev.platform, "device_kind": dev.device_kind,
             "V": V, "E": E, "F": F, "iters": iters, "impls": rows}
 
@@ -737,8 +785,13 @@ def run_child(args) -> None:
     # protocol, round-over-round) skip the 1-2 min full-scale compile
     # — directly shrinks the timeout risk the staging exists for
     from roc_tpu.utils.compile_cache import enable_compile_cache
-    enable_compile_cache()
+    cache_dir = enable_compile_cache()
     if args.stage == "probe":
+        # warm-start evidence in the progress artifact: repeat probes
+        # hit the persistent cache, so a slow matmul phase on attempt
+        # N>1 means tunnel weather, not compile cost
+        _probe_note(f"compile cache ready at "
+                    f"{cache_dir or '(disabled)'}")
         out = child_probe(args)
     elif args.stage == "micro":
         out = child_micro(args)
@@ -808,8 +861,16 @@ def _run_stage(name: str, timeout: float, argv,
         rec["heartbeats"] = hb.fired
     if name == "probe" and not rec.get("ok"):
         # where the probe died (claim-wait vs matmul) — wedge vs slow
-        # is diagnosable from the artifact alone
-        rec["progress"] = _read_probe_progress()
+        # is diagnosable from the artifact alone, and the
+        # heartbeat-dated partial result below is what the parent's
+        # same-phase retry abort reads (a timed-out probe must never
+        # be a silent null: r04/r05 burned the whole deadline retrying
+        # into the identical wedge)
+        prog = _read_probe_progress()
+        rec["progress"] = prog
+        rec["partial"] = {"t": _now_iso(), "last_phase": _probe_phase(prog),
+                          "heartbeats": hb.fired,
+                          "elapsed_s": rec["elapsed_s"]}
     _append_stage(rec)
     from roc_tpu.obs.events import emit
     emit("bench", f"stage {name}: "
@@ -858,6 +919,13 @@ def parent(args, argv) -> int:
     if args.small:
         wanted = ["probe", "small"]
     stage_cfg = {n: (t, m) for n, t, m in STAGES}
+    probe_to = os.environ.get("ROC_TPU_BENCH_PROBE_TIMEOUT")
+    if probe_to:
+        try:
+            t = float(probe_to)
+            stage_cfg["probe"] = (t, min(stage_cfg["probe"][1], t))
+        except ValueError:
+            pass
     unknown = [n for n in wanted if n not in stage_cfg]
     if unknown:
         # keep the always-one-JSON-line contract even for bad input
@@ -911,6 +979,7 @@ def parent(args, argv) -> int:
             # minutes: spread attempts ~_PROBE_INTERVAL apart across
             # the WHOLE deadline, stopping only when one more probe
             # plus the cheapest measurement stage could no longer fit
+            last_phase = None
             for attempt in range(args.probe_retries + 1):
                 t_attempt = time.time()
                 try:  # fresh progress file per attempt
@@ -923,6 +992,24 @@ def parent(args, argv) -> int:
                         remaining() - 20 - _TERM_GRACE), argv)
                 if rec.get("ok") or attempt == args.probe_retries:
                     break
+                # same-phase abort: two consecutive attempts that died
+                # at the identical (normalized) phase with zero new
+                # progress mean the tunnel is wedged on the ~30 min
+                # scale — further 150 s retries only burn the deadline
+                # that the in-round promotion path and any remaining
+                # stages could still use (the r04/r05 failure shape:
+                # five identical "timeout after 150s" probes, nothing
+                # else ever ran)
+                phase = (rec.get("partial") or {}).get("last_phase")
+                if phase is not None and phase == last_phase:
+                    print(f"# probe died at the same phase twice "
+                          f"({phase}) — aborting retries to preserve "
+                          f"the deadline", file=sys.stderr)
+                    _append_stage({"stage": "probe_abort",
+                                   "t": _now_iso(), "phase": phase,
+                                   "attempts": attempt + 1})
+                    break
+                last_phase = phase
                 # one more cycle = probe timeout + its grace + the
                 # cheapest still-wanted measurement stage's min budget
                 # + finalize margin
@@ -932,7 +1019,7 @@ def parent(args, argv) -> int:
                           + (min(later_mins) if later_mins else 0) + 60)
                 if remaining() < needed:
                     break
-                wait = max(0.0, _PROBE_INTERVAL
+                wait = max(0.0, _probe_interval()
                            - (time.time() - t_attempt))
                 wait = min(wait, max(remaining() - needed, 0.0))
                 if wait > 0:

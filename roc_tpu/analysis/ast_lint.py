@@ -149,6 +149,58 @@ class HostSyncHotPathRule(AstRule):
                               key=f"float@{node.lineno}")
 
 
+class SyncH2dInLoopRule(AstRule):
+    """Synchronous host→device staging inside a Python loop: a
+    ``jax.device_put`` / ``np.ascontiguousarray`` in a ``for``/
+    ``while`` body puts the host copy + H2D transfer on the critical
+    path of every iteration — exactly the latency-serial pattern the
+    staging pool (``core/streaming.py StagingPool``) exists to hide.
+    Route block staging through the pool (``_stage_block`` is the one
+    sanctioned call site, and it lives outside any loop); genuinely
+    cold loops suppress with ``# roc-lint: ok=sync-h2d-in-loop``."""
+
+    name = "sync-h2d-in-loop"
+    why = ("a per-iteration device_put/ascontiguousarray serializes "
+           "the transfer behind compute; stage through "
+           "core/streaming.StagingPool so block k+1's copy runs "
+           "under block k's work")
+    HOT_PREFIXES = ("roc_tpu/ops/", "roc_tpu/kernels/")
+    HOT_FILES = {"roc_tpu/core/streaming.py"}
+
+    def select(self, relpath: str) -> bool:
+        return (relpath.startswith(self.HOT_PREFIXES)
+                or relpath in self.HOT_FILES)
+
+    LOOP_NODES = (ast.For, ast.AsyncFor, ast.While, ast.ListComp,
+                  ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+    def check(self, tree, relpath):
+        seen = set()
+        for loop in ast.walk(tree):
+            if not isinstance(loop, self.LOOP_NODES):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_attr(node.func, "device_put") or \
+                        _is_name(node.func, "device_put"):
+                    what = "device_put"
+                elif _is_attr(node.func, "ascontiguousarray") or \
+                        _is_name(node.func, "ascontiguousarray"):
+                    what = "ascontiguousarray"
+                else:
+                    continue
+                key = f"{what}@{node.lineno}"
+                if key in seen:     # nested loops walk twice
+                    continue
+                seen.add(key)
+                yield Finding(self.name, relpath,
+                              f"{what} inside a loop body — "
+                              "synchronous H2D on the critical path "
+                              "(stage through StagingPool)",
+                              line=node.lineno, key=key)
+
+
 class BareJitRule(AstRule):
     """``jax.jit`` in the trainer/parallel layers that bypasses
     ``ObservedJit`` — such steps compile invisibly: no lower/compile
@@ -214,7 +266,8 @@ class PallasInterpretRule(AstRule):
 
 
 RULES: List[AstRule] = [StdoutPrintRule(), HostSyncHotPathRule(),
-                        BareJitRule(), PallasInterpretRule()]
+                        SyncH2dInLoopRule(), BareJitRule(),
+                        PallasInterpretRule()]
 
 
 def run_ast_lint(root: str,

@@ -140,6 +140,11 @@ class GraphContext:
     # flat per-source-shard ring edge lists: (src, dst), each int32
     # [S, pair_edges] — this device's slice (parallel/ring.py)
     ring_idx: Tuple[jax.Array, ...] = ()
+    # double-buffered ring schedule (ppermute issued before the local
+    # scatter-accumulate, parallel/ring.py ring_aggregate): identical
+    # numerics either way; False keeps the strictly sequential hop
+    # order for measurement/debug (TrainConfig.ring_overlap)
+    ring_overlap: bool = True
     axis_name: str = "parts"
 
     def _gathered_with_zero(self, x: jax.Array) -> jax.Array:
@@ -154,7 +159,8 @@ class GraphContext:
         if self.halo == "ring":
             from ..parallel.ring import ring_aggregate
             return ring_aggregate(x, self.ring_idx[0], self.ring_idx[1],
-                                  axis_name=self.axis_name)
+                                  axis_name=self.axis_name,
+                                  overlap=self.ring_overlap)
         full = self._gathered_with_zero(x)
         if self.aggr_impl == "ell":
             return aggregate_ell(full, self.ell_idx, self.ell_row_pos,
@@ -232,11 +238,13 @@ class GraphContext:
             if self.ring_w is not None:
                 return ring_aggregate(
                     x, self.ring_idx[0], self.ring_idx[1],
-                    axis_name=self.axis_name, weights=self.ring_w)
+                    axis_name=self.axis_name, weights=self.ring_w,
+                    overlap=self.ring_overlap)
             d = inv_sqrt_degree(self.in_degree).astype(x.dtype)
             out = ring_aggregate(x * d[:, None], self.ring_idx[0],
                                  self.ring_idx[1],
-                                 axis_name=self.axis_name)
+                                 axis_name=self.axis_name,
+                                 overlap=self.ring_overlap)
             return out * d[:, None]
         if self.aggr_impl == "ell" and self.ell_w:
             full = self._gathered_with_zero(x)
@@ -425,14 +433,15 @@ def _gctx_flatten(g: GraphContext):
                 g.bd_scale)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
            g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
-           g.sect_meta, g.bd_vpad, g.bd_src_vpad, g.bd_group)
+           g.sect_meta, g.bd_vpad, g.bd_src_vpad, g.bd_group,
+           g.ring_overlap)
     return children, aux
 
 
 def _gctx_unflatten(aux, children):
     (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
      symmetric, halo, axis_name, sect_meta, bd_vpad, bd_src_vpad,
-     bd_group) = aux
+     bd_group, ring_overlap) = aux
     (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
      sect_idx, sect_sub_dst, ell_row_id, flat8_idx,
      flat8_dst, bd_a, bd_src, bd_dst, ell_w, sect_w, ring_w,
@@ -448,6 +457,7 @@ def _gctx_unflatten(aux, children):
         ell_row_id=ell_row_id, flat8_idx=flat8_idx,
         flat8_dst=flat8_dst, bd_a=bd_a, bd_src=bd_src, bd_dst=bd_dst,
         bd_vpad=bd_vpad, bd_src_vpad=bd_src_vpad, bd_group=bd_group,
+        ring_overlap=ring_overlap,
         ell_w=ell_w, sect_w=sect_w, ring_w=ring_w, bd_scale=bd_scale)
 
 

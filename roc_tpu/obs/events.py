@@ -40,8 +40,10 @@ from typing import Any, Dict, List, Optional
 #   stall     heartbeat "still waiting in <stage>" events
 #   run       CLI lifecycle (resume, checkpoint, artifact writes)
 #   analysis  roc-lint findings (python -m roc_tpu.analysis)
+#   pipeline  streamed-tier / ring overlap telemetry (staging-pool
+#             h2d_wait + overlap_frac, hop_compute vs hop_permute)
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
-              "bench", "stall", "run", "analysis")
+              "bench", "stall", "run", "analysis", "pipeline")
 
 
 def _jsonable(v: Any) -> Any:

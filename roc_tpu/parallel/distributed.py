@@ -648,11 +648,13 @@ class DistributedTrainer:
                  f"pair_edges={self.data.ring_idx[0].shape[2]} "
                  f"padding_ratio="
                  f"{'?' if ratio is None else format(ratio, '.2f')} "
+                 f"overlap={'on' if config.ring_overlap else 'off'} "
                  f"(aggr_impl={config.aggr_impl!r} unused: ring tables "
                  f"drive the aggregation)", console=config.verbose,
                  num_parts=self.pg.num_parts,
                  pair_edges=int(self.data.ring_idx[0].shape[2]),
-                 padding_ratio=ratio)
+                 padding_ratio=ratio,
+                 ring_overlap=bool(config.ring_overlap))
         key = jax.random.PRNGKey(config.seed)
         self.key, init_key = jax.random.split(key)
         host_params = model.init_params(init_key, dtype=config.dtype)
@@ -706,6 +708,7 @@ class DistributedTrainer:
             chunk=self.config.chunk,
             symmetric=self.symmetric,
             halo=self.config.halo,
+            ring_overlap=self.config.ring_overlap,
             sect_meta=self.data.sect_meta,
             bd_vpad=self.data.bd_vpad,
             bd_src_vpad=self.data.bd_src_vpad,

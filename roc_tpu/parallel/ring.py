@@ -161,7 +161,8 @@ def ring_weight_tables(pg: PartitionedGraph, rt: RingTables,
 def ring_aggregate(x: jax.Array, ring_src: jax.Array,
                    ring_dst: jax.Array, axis_name: str = "parts",
                    edge_chunk: int = 1 << 17,
-                   weights: Optional[jax.Array] = None) -> jax.Array:
+                   weights: Optional[jax.Array] = None,
+                   overlap: bool = True) -> jax.Array:
     """SPMD ring aggregation (call inside shard_map).
 
     x: [part_nodes, F] this device's shard.
@@ -175,6 +176,17 @@ def ring_aggregate(x: jax.Array, ring_src: jax.Array,
     ``weights`` (optional): [S, pair_edges] per-edge weights
     (:func:`ring_weight_tables` — the baked fused-norm scales),
     applied to the gathered rows in-register before the scatter-add.
+
+    ``overlap`` (default True): double-buffered hop schedule — the
+    ``ppermute`` of the incoming buffer is ISSUED before the
+    scatter-accumulate of the current one.  The two are
+    data-independent once double-buffered, so XLA's latency-hiding
+    scheduler can run the collective under the compute (the
+    reference's interconnect/compute overlap, ICI edition).
+    ``overlap=False`` keeps the strictly sequential
+    compute-then-permute form: the parity/measurement reference —
+    both orders produce identical values (the rotation never reads
+    the accumulator), so this is a schedule knob, not a numerics one.
     """
     S, pair_edges = ring_src.shape
     n, F = x.shape
@@ -202,6 +214,15 @@ def ring_aggregate(x: jax.Array, ring_src: jax.Array,
 
     def step(k, carry):
         buf, out = carry
+        # double-buffered hop: the rotation that fills the NEXT step's
+        # buffer is issued FIRST, before this step's scatter-accumulate
+        # touches ``buf`` — the collective and the local aggregation
+        # share no data (the permute never reads ``out``), so the
+        # program order puts the ICI transfer under the gather/scatter
+        # compute instead of after it.  (Skipped rotation work on the
+        # last step is harmless; keeping it unconditional keeps the
+        # loop body uniform.)
+        nxt = (lax.ppermute(buf, axis_name, perm) if overlap else None)
         src_shard = jnp.mod(me - k, S)
         src_e = lax.dynamic_index_in_dim(ring_src, src_shard, axis=0,
                                          keepdims=False)
@@ -213,11 +234,10 @@ def ring_aggregate(x: jax.Array, ring_src: jax.Array,
         buf_ext = jnp.concatenate(
             [buf, jnp.zeros((1, F), dtype=buf.dtype)], axis=0)
         out = local_pair(out, buf_ext, src_e, dst_e, w_e)
-        # rotate for the next step (skipped work on the last step is
-        # harmless; keeping it unconditional lets XLA overlap the
-        # permute with this step's aggregation)
-        buf = lax.ppermute(buf, axis_name, perm)
-        return buf, out
+        if not overlap:
+            # sequential reference: rotate only after the accumulate
+            nxt = lax.ppermute(buf, axis_name, perm)
+        return nxt, out
 
     out0 = jnp.zeros((n, F), dtype=x.dtype)
     _, out = lax.fori_loop(0, S, step, (x, out0))

@@ -162,6 +162,25 @@ def summarize(events: List[Dict[str, Any]],
             for k, vs in thr.items()]
     _rows("throughput", ["metric", "p50", "max"], rows, out)
 
+    # pipelined execution: overlap_frac = fraction of host->device
+    # staging latency hidden under compute (1.0 = fully overlapped,
+    # 0.0 = the synchronous prefetch=0 path); h2d_wait_p50_ms = the
+    # un-hidden per-block stall.  Ring hop_compute/hop_permute rows
+    # come from the micro_stream probe's pipeline events.
+    pipe: Dict[str, List[float]] = {}
+    for e in ([x for x in events
+               if x.get("cat") in ("epoch", "pipeline")]
+              + (metrics or [])):
+        for k in ("overlap_frac", "h2d_wait_p50_ms",
+                  "h2d_stage_p50_ms", "prefetch_depth",
+                  "hop_compute_ms", "hop_permute_ms"):
+            if isinstance(e.get(k), (int, float)):
+                pipe.setdefault(k, []).append(float(e[k]))
+    rows = [[k, f"{_pct(vs, 0.5):.4g}", f"{min(vs):.4g}",
+             f"{max(vs):.4g}"] for k, vs in pipe.items()]
+    _rows("pipeline (h2d prefetch / ring overlap)",
+          ["metric", "p50", "min", "max"], rows, out)
+
     stalls = [e for e in events if e.get("cat") == "stall"]
     by_stage: Dict[str, List[float]] = {}
     for e in stalls:

@@ -117,6 +117,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "(>HBM graphs, single device)")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize activations in backward")
+    ap.add_argument("--prefetch", default="auto",
+                    help="streamed-tier staging-pool depth "
+                         "(--features host): blocks the background "
+                         "stager runs ahead of compute; 'auto' = 1 "
+                         "(double-buffered — block k+1's host copy + "
+                         "H2D transfer hide under block k's compute), "
+                         "0 = synchronous (the parity/debug "
+                         "reference).  Epoch records then carry "
+                         "overlap_frac / h2d_wait_p50_ms "
+                         "(python -m roc_tpu.report)")
     ap.add_argument("--eval-every", type=int, default=5)
     ap.add_argument("--checkpoint", type=str, default=None,
                     help="save params+opt state here after training")
@@ -203,6 +213,14 @@ def main(argv: Optional[List[str]] = None) -> int:
               "kernel, measured 8.4x SLOWER than --impl ell on v5e "
               "(kernels/ell_spmm.py records why); pass "
               "--allow-slow-impl to run it anyway", file=sys.stderr)
+        return 2
+    # ONE validator (train/trainer.py resolve_prefetch) so the CLI and
+    # the trainer can never accept different --prefetch vocabularies
+    from .trainer import resolve_prefetch
+    try:
+        resolve_prefetch(TrainConfig(prefetch=args.prefetch))
+    except ValueError as e:
+        print(f"error: --prefetch: {e}", file=sys.stderr)
         return 2
     if args.model != "gat" and args.heads != 1:
         print("error: --heads applies to --model gat only",
@@ -324,6 +342,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed, eval_every=args.eval_every, verbose=True,
         aggr_impl=args.impl, aggr_fuse=args.fuse, halo=args.halo,
         memory=memory, features=args.features, remat=args.remat,
+        prefetch=args.prefetch,
         dtype=dt, compute_dtype=cdt, metrics_path=args.metrics)
 
     if args.parts > 1:

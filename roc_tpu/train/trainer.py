@@ -71,6 +71,21 @@ class TrainConfig:
     # all_gather, the reference's whole-region semantics) or "ring"
     # (ppermute rotation, O(V/P) peak memory; parallel/ring.py)
     halo: str = "gather"
+    # Ring hop schedule (halo='ring'): True (default) issues each
+    # hop's ppermute BEFORE the scatter-accumulate of the current
+    # buffer — double-buffered, so XLA can overlap the collective
+    # with compute.  False keeps the strictly sequential
+    # compute-then-permute order (identical numerics; the
+    # measurement/debug reference).
+    ring_overlap: bool = True
+    # Streamed-tier prefetch (features='host'): staging-pool depth —
+    # how many feature blocks the background stager runs ahead of
+    # compute (core/streaming.py StagingPool).  "auto" resolves to 1
+    # (double-buffered: block k+1's host copy + H2D transfer run
+    # under block k's compute, peak 2 live block buffers); 0 stages
+    # synchronously (bit-identical results — the parity reference
+    # the overlap_frac epoch metric compares against).
+    prefetch: Any = "auto"
     # Symmetric-adjacency assumption for the aggregation backward (the
     # reference requires it, scattergather_kernel.cu:160-170).
     # None = verify host-side at setup (O(E log E)); True = trust the
@@ -142,6 +157,24 @@ def resolve_dtypes(name: str):
         return jnp.float32, jnp.bfloat16
     raise ValueError(f"unknown dtype mode {name!r}; expected "
                      "'float32', 'bfloat16', or 'mixed'")
+
+
+def resolve_prefetch(config: TrainConfig) -> int:
+    """``TrainConfig.prefetch`` -> staging-pool depth: 'auto' = 1 (the
+    double-buffered default — one block ahead is enough to hide the
+    host copy + H2D issue, and deeper pools only add live buffers);
+    an int >= 0 is taken literally (0 = synchronous)."""
+    p = config.prefetch
+    if p == "auto":
+        return 1
+    try:
+        depth = int(p)
+    except (TypeError, ValueError):
+        raise ValueError(f"unknown prefetch {p!r}; expected 'auto' or "
+                         "an int >= 0") from None
+    if depth < 0:
+        raise ValueError(f"prefetch must be >= 0, got {depth}")
+    return depth
 
 
 def compute_dtype_of(config: TrainConfig):
@@ -664,12 +697,14 @@ class Trainer:
             else:
                 rate, self._head_param, self._tail_model = head
             from ..core.streaming import StreamedHead
-            self._head = StreamedHead(rate)
+            depth = resolve_prefetch(config)
+            self._head = StreamedHead(rate, prefetch=depth)
             feats_np = np.asarray(dataset.features)
             if prefix_ops is not None:
                 from ..core.streaming import stream_prefix_to_host
                 feats_np = stream_prefix_to_host(
-                    dataset.graph, prefix_ops, feats_np)
+                    dataset.graph, prefix_ops, feats_np,
+                    prefetch=depth)
             # host copy in the COMPUTE dtype (ml_dtypes bf16 under
             # mixed): device_put then ships 2-byte blocks — the
             # host-link transfer is this tier's dominant per-epoch
@@ -843,6 +878,37 @@ class Trainer:
             self.params, self.opt_state = self._apply_update(
                 self.params, self.opt_state, grads, lr)
 
+    def pipeline_fields(self) -> Dict[str, float]:
+        """Streaming-pipeline metrics accumulated since the last call
+        (the staging pool's per-block series), folded into the epoch
+        record by ``run_epoch_loop``: ``overlap_frac`` = fraction of
+        staging latency hidden under compute (0 for the synchronous
+        ``prefetch=0`` path by construction), ``h2d_wait_p50_ms`` =
+        median consumer-side stall per block, ``prefetch_depth`` = the
+        resolved pool depth.  The per-block waits also land in the
+        ``h2d_wait``/``h2d_stage`` timer spans so the report's phase
+        table shows them next to the epoch phases."""
+        if self._head is None:
+            return {}
+        stats = self._head.pool.take_stats()
+        if not stats["n"]:
+            return {}
+        self.timer.spans_ms.setdefault("h2d_wait", []).extend(
+            stats["wait_ms"])
+        self.timer.spans_ms.setdefault("h2d_stage", []).extend(
+            stats["stage_ms"])
+        out: Dict[str, float] = {
+            "prefetch_depth": int(stats["depth"]),
+            "h2d_wait_p50_ms": stats["wait_p50_ms"],
+            "h2d_stage_p50_ms": stats["stage_p50_ms"],
+        }
+        if stats["overlap_frac"] is not None:
+            out["overlap_frac"] = stats["overlap_frac"]
+        emit("pipeline", f"h2d: {stats['n']} blocks, wait p50 "
+             f"{out['h2d_wait_p50_ms']:.2f} ms, overlap_frac "
+             f"{out.get('overlap_frac', 0.0)}", console=False, **out)
+        return out
+
     # ---- loop ----
 
     def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
@@ -978,6 +1044,11 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                     if span > 0:
                         # throughput from honest steady laps only
                         m.update(throughput_fields(tr, m["epoch_ms"]))
+                    # streamed-tier pipeline metrics (overlap_frac,
+                    # h2d_wait p50) accumulated over the burst
+                    pipe = getattr(tr, "pipeline_fields", None)
+                    if pipe is not None:
+                        m.update(pipe() or {})
                     t_last, e_last = t_eval_end, tr.epoch + 1
                     history.append(m)
                     tr.metrics_log.log(m)

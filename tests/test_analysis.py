@@ -65,6 +65,41 @@ def test_host_sync_hot_path_fires(tmp_path):
     assert all(f.unit == "roc_tpu/ops/hot.py" for f in got)
 
 
+def test_sync_h2d_in_loop_fires(tmp_path):
+    _plant(tmp_path, "roc_tpu/core/streaming.py",
+           "import jax\n"
+           "import numpy as np\n"
+           "def stage_once(feats, lo, hi):\n"
+           "    # outside any loop: the sanctioned pool call site\n"
+           "    return jax.device_put(np.ascontiguousarray("
+           "feats[lo:hi]))\n"
+           "def bad(blocks):\n"
+           "    out = []\n"
+           "    for b in blocks:\n"
+           "        x = np.ascontiguousarray(b)\n"
+           "        out.append(jax.device_put(x))\n"
+           "    i = 0\n"
+           "    while i < 3:\n"
+           "        # cold loop: roc-lint: ok=sync-h2d-in-loop\n"
+           "        jax.device_put(blocks[i])\n"
+           "        i += 1\n"
+           "    comp = [jax.device_put(b) for b in blocks]\n"
+           "    return out, comp\n")
+    # the same calls OUTSIDE the hot modules are not this rule's
+    # business
+    _plant(tmp_path, "roc_tpu/train/cold.py",
+           "import jax\n"
+           "def f(bs):\n"
+           "    return [jax.device_put(b) for b in bs]\n")
+    got = run_ast_lint(str(tmp_path), select=["sync-h2d-in-loop"])
+    # the for-body copy + put, and the comprehension rewrite (the
+    # obvious ratchet dodge) — the pragma'd while body stays quiet
+    assert [(f.rule, f.line) for f in got] == \
+        [("sync-h2d-in-loop", 9), ("sync-h2d-in-loop", 10),
+         ("sync-h2d-in-loop", 16)]
+    assert all(f.unit == "roc_tpu/core/streaming.py" for f in got)
+
+
 def test_bare_jit_fires_and_observed_form_allowed(tmp_path):
     _plant(tmp_path, "roc_tpu/train/steps.py",
            "import jax\n"

@@ -144,6 +144,35 @@ def test_micro_only_run_never_promotes(tmp_path):
     assert "provenance" not in line
 
 
+def test_probe_timeout_leaves_partial_and_aborts_same_phase(tmp_path):
+    """A timed-out probe must leave a heartbeat-dated partial result
+    (where it died, normalized) instead of a bare error, and two
+    consecutive deaths at the SAME phase must abort the retry loop —
+    the r04/r05 failure burned the whole deadline re-dying at the
+    identical phase five times."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               ROC_TPU_BENCH_ARTIFACTS=str(tmp_path),
+               ROC_TPU_BENCH_PROBE_TIMEOUT="1",      # dies in import
+               ROC_TPU_BENCH_PROBE_INTERVAL="0")     # no retry sleep
+    r = subprocess.run(
+        [sys.executable, _BENCH, "--cpu", "--stages", "probe",
+         "--probe-retries", "5", "--deadline", "600"],
+        capture_output=True, text=True, timeout=240, cwd=_REPO,
+        env=env)
+    line = _last_json(r.stdout)
+    assert line["value"] is None
+    recs = [json.loads(l) for l in
+            (tmp_path / "bench_stages.jsonl").read_text().splitlines()]
+    probes = [x for x in recs if x.get("stage") == "probe"]
+    # same-phase abort after the second identical death, not 6 attempts
+    assert len(probes) == 2, [p.get("error") for p in probes]
+    for p in probes:
+        assert p["partial"]["last_phase"], p
+        assert "t" in p["partial"]
+    aborts = [x for x in recs if x.get("stage") == "probe_abort"]
+    assert len(aborts) == 1 and aborts[0]["attempts"] == 2
+
+
 def test_stale_record_not_promoted(tmp_path):
     """The stage log is append-only across rounds: records past the
     promotion age window yield an honest null, never a replay of an

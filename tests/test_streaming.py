@@ -171,6 +171,176 @@ def test_host_features_converges_with_dropout():
     assert m["train_acc"] > 0.6, m
 
 
+# ---- pipelined execution: staging pool + prefetch parity ----
+
+import functools
+
+from roc_tpu.core.streaming import StagingPool
+
+
+def test_staging_pool_order_stats_and_errors():
+    pool = StagingPool(depth=2)
+    got = list(pool.stream([(lambda i=i: i * 10) for i in range(7)]))
+    assert got == [0, 10, 20, 30, 40, 50, 60]
+    s = pool.take_stats()
+    assert s["n"] == 7 and len(s["stage_ms"]) == 7
+    # a second take sees only new work
+    assert pool.take_stats()["n"] == 0
+
+    def boom():
+        raise RuntimeError("stage died")
+    with pytest.raises(RuntimeError, match="stage died"):
+        list(StagingPool(depth=1).stream([boom]))
+
+
+def test_staging_pool_caps_live_buffers_at_depth_plus_one():
+    """The 2-slot invariant: however many blocks V splits into (and
+    across reuse passes), a depth-1 pool never holds more than 2 live
+    staged buffers — and the worker never runs more than depth stages
+    ahead of the consumer."""
+    pool = StagingPool(depth=1)
+    for _ in range(3):          # reused pool: the bound must not leak
+        staged, taken = [], []
+
+        def mk(i):
+            def f():
+                staged.append(i)
+                return i
+            return f
+        for v in pool.stream([mk(i) for i in range(16)]):
+            taken.append(v)
+            # credits bound the run-ahead: staged <= taken + depth
+            assert len(staged) <= len(taken) + pool.depth
+    assert pool.max_live <= 2
+    # synchronous pools hold exactly one
+    p0 = StagingPool(depth=0)
+    assert list(p0.stream([lambda: 1, lambda: 2])) == [1, 2]
+    assert p0.max_live == 1
+
+
+def test_streamed_head_pool_live_bound_many_blocks():
+    """End-to-end: fwd + wgrad over many blocks and repeated epochs
+    keep peak live block buffers <= 2 (the ISSUE's staging-pool
+    acceptance), independent of V."""
+    rng = np.random.RandomState(0)
+    X = rng.randn(640, 12).astype(np.float32)   # 10 blocks of 64
+    W = jnp.asarray(rng.randn(12, 6).astype(np.float32))
+    dY = jnp.asarray(rng.randn(640, 6).astype(np.float32))
+    head = StreamedHead(0.3, block_rows=64, prefetch=1)
+    key = jax.random.PRNGKey(1)
+    for _ in range(3):
+        head.forward(W, X, key, True)
+        head.wgrad(X, dY, key, True)
+    assert head.pool.max_live <= 2
+
+
+@pytest.mark.parametrize("key_mode", ["none", "dropout"])
+def test_prefetched_streaming_bitexact_vs_synchronous(key_mode):
+    """The parity gate: prefetch=0 (synchronous) and prefetch>=1
+    (background staging) produce BIT-IDENTICAL fwd + wgrad — the
+    per-block fold_in keys are position-derived, never order-derived,
+    and staging moves bytes, not math."""
+    rng = np.random.RandomState(2)
+    X = rng.randn(330, 12).astype(np.float32)   # uneven tail block
+    W = jnp.asarray(rng.randn(12, 6).astype(np.float32))
+    dY = jnp.asarray(rng.randn(330, 6).astype(np.float32))
+    key = None if key_mode == "none" else jax.random.PRNGKey(3)
+    train = key is not None
+    outs = {}
+    for depth in (0, 1, 2):
+        head = StreamedHead(0.4, block_rows=64, prefetch=depth)
+        outs[depth] = (np.asarray(head.forward(W, X, key, train)),
+                       np.asarray(head.wgrad(X, dY, key, train)))
+    for depth in (1, 2):
+        np.testing.assert_array_equal(outs[0][0], outs[depth][0])
+        np.testing.assert_array_equal(outs[0][1], outs[depth][1])
+
+
+def test_streaming_aggregator_prefetch_bitexact(graph):
+    rng = np.random.RandomState(4)
+    feats = rng.randn(graph.num_nodes, 6).astype(np.float32)
+    a0 = StreamingAggregator(graph, block_rows=50, prefetch=0)
+    a1 = StreamingAggregator(graph, block_rows=50, prefetch=1)
+    np.testing.assert_array_equal(np.asarray(a0(feats)),
+                                  np.asarray(a1(feats)))
+
+
+def test_streaming_aggregator_index_tables_device_resident(graph):
+    """The per-plan int32 tables are uploaded ONCE at plan build (the
+    satellite fix for jnp.asarray re-uploading them in the hot loop):
+    the cached device chunks must be the same objects across calls."""
+    agg = StreamingAggregator(graph, block_rows=64, edge_chunk=128)
+    before = [id(c[0]) for p in agg.plans
+              for c in p.dev_chunks(agg.edge_chunk)]
+    feats = np.random.RandomState(5).randn(
+        graph.num_nodes, 4).astype(np.float32)
+    agg(feats)
+    agg(feats)
+    after = [id(c[0]) for p in agg.plans
+              for c in p.dev_chunks(agg.edge_chunk)]
+    assert before == after and len(before) > 0
+
+
+def test_streaming_aggregator_table_budget_falls_back_transient(graph):
+    """Past the table residency budget the aggregator must NOT pin
+    O(E) index bytes on device (that would defeat the out-of-core
+    tier): uploads become transient per call, results identical."""
+    rng = np.random.RandomState(8)
+    feats = rng.randn(graph.num_nodes, 5).astype(np.float32)
+    cached = StreamingAggregator(graph, block_rows=64)
+    assert cached.cache_tables
+    tight = StreamingAggregator(graph, block_rows=64,
+                                table_cache_bytes=16)
+    assert not tight.cache_tables
+    got = tight(feats)
+    assert all(not p._dev for p in tight.plans)   # nothing pinned
+    np.testing.assert_array_equal(np.asarray(got),
+                                  np.asarray(cached(feats)))
+
+
+def test_aggregate_to_host_prefetch_matches_sync():
+    from roc_tpu.core.streaming import aggregate_to_host
+    ds = synthetic_dataset(200, 7, in_dim=9, num_classes=3, seed=3)
+    x = np.random.RandomState(6).randn(
+        ds.graph.num_nodes, 9).astype(np.float32)
+    got0 = aggregate_to_host(ds.graph, x, block_rows=32,
+                             edge_chunk=64, prefetch=0)
+    got1 = aggregate_to_host(ds.graph, x, block_rows=32,
+                             edge_chunk=64, prefetch=1)
+    np.testing.assert_array_equal(got0, got1)
+
+
+def test_streamed_tier_epoch_records_carry_pipeline_fields():
+    """Epoch records on the streamed tier report overlap_frac,
+    h2d_wait_p50_ms and prefetch_depth; the synchronous path reports
+    overlap_frac == 0 by construction."""
+    ds = synthetic_dataset(200, 5, in_dim=12, num_classes=3, seed=4)
+    recs = {}
+    for depth in (0, 1):
+        model = build_gcn([12, 8, 3], dropout_rate=0.2)
+        cfg = TrainConfig(learning_rate=0.05, features="host",
+                          prefetch=depth, epochs=2, eval_every=2,
+                          verbose=False, symmetric=True)
+        recs[depth] = Trainer(model, ds, cfg).train()
+    for depth, hist in recs.items():
+        assert hist, hist
+        m = hist[-1]
+        assert m["prefetch_depth"] == depth
+        assert "h2d_wait_p50_ms" in m and "overlap_frac" in m
+    assert recs[0][-1]["overlap_frac"] == 0.0
+
+
+def test_resolve_prefetch():
+    from roc_tpu.train.trainer import resolve_prefetch
+    assert resolve_prefetch(TrainConfig()) == 1            # auto
+    assert resolve_prefetch(TrainConfig(prefetch=0)) == 0
+    assert resolve_prefetch(TrainConfig(prefetch="3")) == 3
+    with pytest.raises(ValueError):
+        resolve_prefetch(TrainConfig(prefetch=-1))
+    with pytest.raises(ValueError):
+        resolve_prefetch(TrainConfig(prefetch="fast"))
+
+
 # ---- memory autopilot ----
 
 def test_choose_memory_plan_tiers():
