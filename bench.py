@@ -685,6 +685,28 @@ def child_micro(args) -> dict:
                 "max_live_blocks": int(st["max_live"])}
     except Exception as e:  # noqa: BLE001 - report and continue
         rows["stream"] = {"error": _errstr(e)}
+
+    # micro_partition rows: greedy sweep vs cost-balanced split of a
+    # Zipf POWER-LAW graph (uniform degrees split near-identically
+    # under both methods — the race needs hubs to say anything) — the
+    # straggler shard's padded aggregation step under each split,
+    # reusing the full probe's helpers so the two stay one convention
+    # (benchmarks/micro_partition.py)
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "benchmarks"))
+        import micro_partition as mp
+        from roc_tpu.core.costmodel import PartitionCostModel
+        from roc_tpu.core.graph import zipf_csr
+        w = PartitionCostModel().search_weights()
+        gz = zipf_csr(V, E // 4, a=1.2, seed=0)
+        for method in ("greedy", "cost"):
+            plan, row = mp.split_row(gz, 8, method, w, 8, 512)
+            row["ms"] = round(mp.shard_step_ms(gz, plan, 128, iters),
+                              2)
+            rows[f"partition:{method}"] = row
+    except Exception as e:  # noqa: BLE001 - report and continue
+        rows["partition"] = {"error": _errstr(e)}
     return {"platform": dev.platform, "device_kind": dev.device_kind,
             "V": V, "E": E, "F": F, "iters": iters, "impls": rows}
 

@@ -7,13 +7,17 @@ aggregation races run on.
     plantedo[:ROWS]      same, ORACLE order (upper bound for any
                          reordering pass)
     skew[:A]             hub sources, u**(1+A) mapping
+    zipf[:A]             Zipf in-degrees rank^-A (hub DESTINATIONS —
+                         the edge-balanced-partitioning stress case)
 """
 
 GRAPH_SPEC_HELP = ("random | planted[:COMMUNITY_ROWS] (community "
                    "structure with shuffled ids) | "
                    "plantedo[:COMMUNITY_ROWS] (same, ORACLE vertex "
                    "order — upper bound for any reordering pass) | "
-                   "skew[:A] (hub sources, u**(1+A) mapping)")
+                   "skew[:A] (hub sources, u**(1+A) mapping) | "
+                   "zipf[:A] (Zipf rank^-A in-degrees, hub "
+                   "destinations)")
 
 
 def graph_from_spec(spec: str, V: int, E: int):
@@ -25,6 +29,10 @@ def graph_from_spec(spec: str, V: int, E: int):
         rows = int(parts[1]) if len(parts) > 1 else 65_536
         return planted_community_csr(V, E, community_rows=rows, seed=0,
                                      shuffle=(parts[0] == "planted"))
+    if parts[0] == "zipf":
+        from roc_tpu.core.graph import zipf_csr
+        a = float(parts[1]) if len(parts) > 1 else 1.0
+        return zipf_csr(V, E, a=a, seed=0)
     if parts[0] == "skew":
         a = float(parts[1]) if len(parts) > 1 else 3.0
         # one community spanning the whole graph + skewed member pick

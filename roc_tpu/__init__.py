@@ -11,7 +11,10 @@ from .core.graph import (Dataset, Graph, add_self_edges, from_edge_list,
                          synthetic_dataset, synthetic_graph,
                          MASK_NONE, MASK_TRAIN, MASK_VAL, MASK_TEST)
 from .core.partition import (PartitionedGraph, edge_balanced_bounds,
-                             padded_edge_list, partition_graph)
+                             padded_edge_list, partition_bounds,
+                             partition_graph)
+from .core.costmodel import (PartitionCostModel, cost_balanced_bounds,
+                             partition_static_stats)
 from .core.ell import EllTable, ell_from_graph, ell_from_padded_parts
 from .models.builder import (AGGR_AVG, AGGR_MAX, AGGR_SUM, GraphContext,
                              Model)

@@ -67,9 +67,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     trace = not args.no_trace
+    from .driver import is_trace_rule
     if trace and (select is None
-                  or any(s.startswith(("jaxpr-", "hlo-"))
-                         for s in select)):
+                  or any(is_trace_rule(s) for s in select)):
         # the trace stage runs on the 8-virtual-device CPU rig,
         # unconditionally: the baseline fingerprints are CPU-rig
         # artifacts, and a TPU-host invocation must not spend chip
@@ -112,8 +112,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     # declare trace-rule baseline entries "no longer firing"
     active = set(select) if select else set(all_rule_names())
     if not trace:
-        active = {r for r in active
-                  if not r.startswith(("jaxpr-", "hlo-"))}
+        active = {r for r in active if not is_trace_rule(r)}
     baseline = load_baseline(baseline_path)
     new, old, stale = split_findings(findings, baseline,
                                      active_rules=active)

@@ -26,6 +26,56 @@ from .jaxpr_lint import JAXPR_RULES, JaxprUnit, run_jaxpr_lint
 
 HLO_RULES = ("hlo-large-copy", "hlo-bytes-model")
 
+# trace-stage rules that are neither jaxpr- nor hlo- prefixed: they
+# inspect the BUILT trainers (here: the distributed trainer's actual
+# partition), so they need the same 8-virtual-device rig
+EXTRA_TRACE_RULES = ("partition-imbalance",)
+
+# a recorded max/mean edge imbalance past this on >1 device means the
+# slowest shard gates every SPMD step by >= 50% over the mean — the
+# split (or the vertex order feeding it) needs attention
+IMBALANCE_THRESHOLD = 1.5
+
+
+def is_trace_rule(name: str) -> bool:
+    """True for rules that need the jax trace/build stage (jaxpr-*,
+    hlo-*, and the built-trainer checks) — shared by the driver's
+    stage gating and the CLI's stale-entry scoping."""
+    return (name.startswith(("jaxpr-", "hlo-"))
+            or name in EXTRA_TRACE_RULES)
+
+
+def check_partition_imbalance(unit: str, real_edges,
+                              num_parts: Optional[int] = None,
+                              threshold: float = IMBALANCE_THRESHOLD
+                              ) -> List[Finding]:
+    """[partition-imbalance] warn when the recorded ``max/mean`` edge
+    imbalance of a >1-device partition exceeds ``threshold`` — the
+    straggler shard would gate every step and every ring hop.  Fed by
+    the per-part real edge counts the trainer records in its manifest
+    (``partition_static_stats``); baselined through the shrink-only
+    ratchet like every other rule."""
+    import numpy as np
+    real_edges = np.asarray(real_edges, dtype=np.float64)
+    if num_parts is None:
+        num_parts = int(real_edges.shape[0])
+    if num_parts < 2 or real_edges.size == 0:
+        return []
+    mean = float(real_edges.sum()) / num_parts
+    if mean <= 0:
+        return []
+    ratio = float(real_edges.max()) / mean
+    if ratio <= threshold:
+        return []
+    return [Finding(
+        "partition-imbalance", unit,
+        f"edge imbalance max/mean {ratio:.2f} > {threshold} across "
+        f"{num_parts} devices — the slowest shard gates every SPMD "
+        f"step (use --partition cost / --rebalance, or reorder the "
+        f"vertex ids)",
+        key=f"parts={num_parts}",
+        detail={"ratio": round(ratio, 4), "threshold": threshold})]
+
 # synthetic rig: big enough that activation scale ([V, F]) dominates
 # class-width tensors ([V, C]) AND per-device activation scale
 # (V/8 * F on the mesh) dominates parameter scale (F * H) by the
@@ -37,13 +87,13 @@ _V, _DEG, _F, _C, _H = 256, 6, 48, 6, 24
 
 def all_rule_names() -> List[str]:
     return ([r.name for r in AST_RULES] + list(JAXPR_RULES)
-            + list(HLO_RULES))
+            + list(HLO_RULES) + list(EXTRA_TRACE_RULES))
 
 
 def _needs_trace(select: Optional[List[str]]) -> bool:
     if select is None:
         return True
-    return any(s.startswith(("jaxpr-", "hlo-")) for s in select)
+    return any(is_trace_rule(s) for s in select)
 
 
 def build_trace_findings(select: Optional[List[str]] = None,
@@ -137,6 +187,13 @@ def build_trace_findings(select: Optional[List[str]] = None,
             **dctx))
 
     findings = run_jaxpr_lint(units, select=select)
+
+    if len(jax.devices()) > 1 and (select is None
+                                   or "partition-imbalance" in select):
+        # the split the distributed trainer ACTUALLY built on the rig
+        findings.extend(check_partition_imbalance(
+            "partition:dist_trainer", dtr.pg.real_edges,
+            dtr.pg.num_parts))
 
     hlo_selected = (select is None
                     or any(s.startswith("hlo-") for s in select))

@@ -478,6 +478,27 @@ def _lognormal_degree_sequence(num_nodes: int, num_edges: int,
     return _degree_sequence(raw, num_edges, rng)
 
 
+def zipf_csr(num_nodes: int, num_edges: int, a: float = 1.0,
+             seed: int = 0, shuffle: bool = True) -> Graph:
+    """Benchmark-scale CSR with **Zipf in-degrees**: the vertex ranked
+    k gets degree ∝ k^-a — a heavier hub tail than the lognormal
+    draw, the stress case for edge-balanced partitioning (a handful
+    of hubs can hold a whole partition cap's worth of edges).
+    ``shuffle=True`` scatters the ranks over random vertex ids so the
+    hubs are not id-contiguous.  Uniform random sources; not
+    symmetric — timing/partitioning use only."""
+    assert num_edges >= num_nodes, "need >= 1 edge per node"
+    rng = np.random.RandomState(seed)
+    raw = np.arange(1, num_nodes + 1, dtype=np.float64) ** (-a)
+    if shuffle:
+        rng.shuffle(raw)
+    deg = _degree_sequence(raw, num_edges, rng)
+    row_ptr = np.zeros(num_nodes + 1, dtype=np.int64)
+    np.cumsum(deg, out=row_ptr[1:])
+    col_idx = rng.randint(0, num_nodes, size=num_edges, dtype=np.int64)
+    return Graph(row_ptr=row_ptr, col_idx=col_idx.astype(np.int32))
+
+
 def planted_community_csr(num_nodes: int, num_edges: int,
                           community_rows: int = 65_536,
                           intra_frac: float = 0.8, seed: int = 0,

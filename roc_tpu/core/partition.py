@@ -34,7 +34,13 @@ def edge_balanced_bounds(row_ptr: np.ndarray, num_parts: int
                          ) -> List[Tuple[int, int]]:
     """Greedy edge-balanced split into ``num_parts`` contiguous inclusive
     vertex ranges ``[left, right]`` (reference ``gnn.cc:806-829``).
-    Ranges may be empty (``left > right``) only in the padded tail."""
+    Ranges may be empty (``left > right``) only in the padded tail.
+
+    The Python fallback is vectorized: the greedy sweep closes a range
+    at the first vertex whose running edge count exceeds the cap, i.e.
+    at ``searchsorted(row_ptr, row_ptr[left] + cap, 'right') - 1`` —
+    O(P log V) instead of the former O(V) degree loop, bit-identical
+    to the native sweep (tests/test_native.py test_bounds_parity)."""
     from .. import native
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
     num_nodes = row_ptr.shape[0] - 1
@@ -45,14 +51,18 @@ def edge_balanced_bounds(row_ptr: np.ndarray, num_parts: int
     cap = (num_edges + num_parts - 1) // num_parts
     bounds: List[Tuple[int, int]] = []
     left = 0
-    cnt = 0
-    deg = np.diff(row_ptr)
-    for v in range(num_nodes):
-        cnt += int(deg[v])
-        if cnt > cap and len(bounds) < num_parts - 1:
-            bounds.append((left, v))
-            cnt = 0
-            left = v + 1
+    for _ in range(num_parts - 1):
+        if left >= num_nodes:
+            break
+        # first v with row_ptr[v+1] - row_ptr[left] > cap closes the
+        # range at v; v+1 is the first index whose prefix exceeds the
+        # target, which searchsorted finds in O(log V)
+        v1 = int(np.searchsorted(row_ptr, row_ptr[left] + cap,
+                                 side="right"))
+        if v1 > num_nodes:
+            break  # remaining edges fit under the cap: no more closes
+        bounds.append((left, v1 - 1))
+        left = v1
     bounds.append((left, num_nodes - 1))
     # pad with empty tail ranges so len(bounds) == num_parts always
     while len(bounds) < num_parts:
@@ -97,6 +107,12 @@ class PartitionPlan:
     real_edges: np.ndarray       # int64 [P]
     part_row_ptr: np.ndarray     # int32 [P, part_nodes+1] local offsets
     part_in_degree: np.ndarray   # int32 [P, part_nodes] real in-degrees
+    # the padding multiples the plan was built with — recorded so a
+    # repartition (core/costmodel.py + DistributedTrainer rebalance)
+    # re-quantizes to the SAME multiples and repeat shapes hit the
+    # compile cache
+    node_multiple: int = 8
+    edge_multiple: int = 128
 
     @property
     def padded_num_nodes(self) -> int:
@@ -145,7 +161,15 @@ class PartitionedGraph(PartitionPlan):
     by the training layer).
     """
 
-    part_col_idx: np.ndarray     # int32 [P, part_edges] global src ids
+    # dataclass default only because the base plan's multiples have
+    # defaults; __post_init__ restores the required-field contract
+    part_col_idx: np.ndarray = None  # int32 [P, part_edges] global src
+
+    def __post_init__(self):
+        if self.part_col_idx is None:
+            raise TypeError(
+                "PartitionedGraph requires part_col_idx "
+                "(materialize_plan attaches it to a plan)")
 
 
 def padded_edge_list(graph: Graph, multiple: int = 1024
@@ -165,15 +189,55 @@ def padded_edge_list(graph: Graph, multiple: int = 1024
     return src, dst
 
 
+def partition_bounds(row_ptr: np.ndarray, num_parts: int,
+                     method: str = "greedy",
+                     node_multiple: int = 8,
+                     edge_multiple: int = 128,
+                     cost_weights=None) -> List[Tuple[int, int]]:
+    """Split-point selection — the ONE dispatch between the
+    reference's greedy edge sweep (``method='greedy'``) and the
+    cost-balanced minimax search (``method='cost'``,
+    core/costmodel.py; ``cost_weights`` = the model's
+    ``search_weights()``, default the edge-balance prior).  Unknown
+    methods raise — a typo must not silently change the split."""
+    if method == "greedy":
+        return edge_balanced_bounds(row_ptr, num_parts)
+    if method == "cost":
+        from .costmodel import cost_balanced_bounds
+        return cost_balanced_bounds(row_ptr, num_parts,
+                                    node_multiple=node_multiple,
+                                    edge_multiple=edge_multiple,
+                                    weights=cost_weights)
+    raise ValueError(f"unknown partition method {method!r}; expected "
+                     "'greedy' or 'cost'")
+
+
 def partition_plan(row_ptr: np.ndarray, num_parts: int,
                    node_multiple: int = 8,
-                   edge_multiple: int = 128) -> PartitionPlan:
+                   edge_multiple: int = 128,
+                   method: str = "greedy",
+                   cost_weights=None) -> PartitionPlan:
     """Everything about the partitioning derivable from the global row
     pointers alone (bounds, padded shapes, local row CSRs, degrees) —
     the O(V) metadata every host computes; column data is loaded
     per-partition afterwards (:func:`partition_col`)."""
     row_ptr = np.asarray(row_ptr, dtype=np.int64)
-    bounds = edge_balanced_bounds(row_ptr, num_parts)
+    bounds = partition_bounds(row_ptr, num_parts, method=method,
+                              node_multiple=node_multiple,
+                              edge_multiple=edge_multiple,
+                              cost_weights=cost_weights)
+    return plan_from_bounds(row_ptr, bounds, num_parts,
+                            node_multiple=node_multiple,
+                            edge_multiple=edge_multiple)
+
+
+def plan_from_bounds(row_ptr: np.ndarray, bounds: List[Tuple[int, int]],
+                     num_parts: int, node_multiple: int = 8,
+                     edge_multiple: int = 128) -> PartitionPlan:
+    """Materialize the plan metadata for explicit ``bounds`` — the
+    shared tail of :func:`partition_plan` and the repartitioning path
+    (DistributedTrainer.maybe_rebalance hands searched bounds here)."""
+    row_ptr = np.asarray(row_ptr, dtype=np.int64)
     V = row_ptr.shape[0] - 1
     E = int(row_ptr[-1])
     real_nodes = np.array([max(r - l + 1, 0) for l, r in bounds],
@@ -183,6 +247,19 @@ def partition_plan(row_ptr: np.ndarray, num_parts: int,
          for l, r in bounds], dtype=np.int64)
     part_nodes = _round_up(max(int(real_nodes.max()), 1), node_multiple)
     part_edges = _round_up(max(int(real_edges.max()), 1), edge_multiple)
+    # Padding edges must attach to a PADDED row: the table builders
+    # (sectioned/bdense — core/ell.clean_part_ptr) exclude them via
+    # the real row extents, and a part whose real rows exactly fill
+    # part_nodes would otherwise absorb dummy-source edges into its
+    # last REAL row, leaking out-of-range gathered coordinates into
+    # the planners.  Latent under the greedy sweep (exact fits were
+    # rare); the cost split's node balancing makes them common — one
+    # extra row-multiple restores the invariant whenever a full part
+    # carries padding edges.
+    if any(int(real_nodes[p]) == part_nodes
+           and int(real_edges[p]) < part_edges
+           for p in range(num_parts)):
+        part_nodes += node_multiple
 
     node_offset = np.array([l for l, _ in bounds], dtype=np.int32)
     node_offset = np.minimum(node_offset, V)  # empty tail parts
@@ -209,7 +286,8 @@ def partition_plan(row_ptr: np.ndarray, num_parts: int,
         part_nodes=part_nodes, part_edges=part_edges, bounds=bounds,
         node_offset=node_offset, real_nodes=real_nodes,
         real_edges=real_edges, part_row_ptr=part_row_ptr,
-        part_in_degree=part_in_degree)
+        part_in_degree=part_in_degree,
+        node_multiple=node_multiple, edge_multiple=edge_multiple)
 
 
 def partition_col(plan: PartitionPlan, col_slice, p: int) -> np.ndarray:
@@ -227,14 +305,26 @@ def partition_col(plan: PartitionPlan, col_slice, p: int) -> np.ndarray:
 
 def partition_graph(graph: Graph, num_parts: int,
                     node_multiple: int = 8,
-                    edge_multiple: int = 128) -> PartitionedGraph:
-    """Partition ``graph`` into ``num_parts`` equal-shaped padded shards
-    using the reference's edge-balanced greedy bounds — the fully
-    materialized single-process form (plan + every part's columns)."""
+                    edge_multiple: int = 128,
+                    method: str = "greedy",
+                    cost_weights=None) -> PartitionedGraph:
+    """Partition ``graph`` into ``num_parts`` equal-shaped padded
+    shards — the fully materialized single-process form (plan + every
+    part's columns).  ``method='greedy'`` (default) is the reference's
+    edge-balanced sweep; ``method='cost'`` the cost-balanced minimax
+    search (core/costmodel.py, ``cost_weights`` as there)."""
     plan = partition_plan(graph.row_ptr, num_parts,
                           node_multiple=node_multiple,
-                          edge_multiple=edge_multiple)
+                          edge_multiple=edge_multiple,
+                          method=method, cost_weights=cost_weights)
+    return materialize_plan(graph, plan)
+
+
+def materialize_plan(graph: Graph, plan: PartitionPlan
+                     ) -> PartitionedGraph:
+    """Attach every partition's column data to a plan (single-process;
+    the repartitioning path reuses this with searched bounds)."""
     col_slice = lambda e0, e1: graph.col_idx[e0:e1]
     part_col_idx = np.stack([partition_col(plan, col_slice, p)
-                             for p in range(num_parts)])
+                             for p in range(plan.num_parts)])
     return PartitionedGraph(**vars(plan), part_col_idx=part_col_idx)

@@ -42,8 +42,12 @@ from typing import Any, Dict, List, Optional
 #   analysis  roc-lint findings (python -m roc_tpu.analysis)
 #   pipeline  streamed-tier / ring overlap telemetry (staging-pool
 #             h2d_wait + overlap_frac, hop_compute vs hop_permute)
+#   costmodel partition cost-model telemetry (core/costmodel.py):
+#             split imbalance records, ridge observations, epoch-
+#             boundary repartition decisions
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
-              "bench", "stall", "run", "analysis", "pipeline")
+              "bench", "stall", "run", "analysis", "pipeline",
+              "costmodel")
 
 
 def _jsonable(v: Any) -> Any:

@@ -480,7 +480,7 @@ class DistributedTrainer:
                  pg=None):
         from ..train.trainer import (apply_memory_autopilot,
                                      resolve_auto_impl_early,
-                                     resolve_fuse)
+                                     resolve_fuse, resolve_partition)
         model = resolve_fuse(model, config)
         self.model = model
         # the shared 'auto' rule incl. the bdense structure probe (the
@@ -523,20 +523,37 @@ class DistributedTrainer:
             raise ValueError(
                 "pass pg= alongside data= (the SAME PartitionedGraph "
                 "the tables were built from)")
+        # cost-model-driven partitioning (core/costmodel.py): resolve
+        # the split method, hold the online ridge model, and keep the
+        # dataset so maybe_rebalance can rebuild shards at epoch
+        # boundaries
+        from ..core.costmodel import PartitionCostModel
+        self._dataset = dataset
+        self._partition_method = resolve_partition(config)
+        self._costmodel = PartitionCostModel(
+            node_multiple=8, edge_multiple=config.chunk)
+        self._rebalances = 0
+        self._phi_cache = None
+        if config.rebalance:
+            if data is not None:
+                # injected tables may have been built by a different
+                # process/loader (multihost) — this trainer cannot
+                # rebuild them faithfully mid-run
+                raise ValueError(
+                    "rebalance=True requires the trainer-owned data "
+                    "build; injected data= cannot be repartitioned")
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "online rebalancing is single-controller only "
+                    "(every SPMD process would need to agree on the "
+                    "re-split and reshard over DCN)")
         self.pg = pg if pg is not None else partition_graph(
             dataset.graph, num_parts,
-            node_multiple=8, edge_multiple=config.chunk)
-        self.data = data if data is not None else shard_dataset(
-            dataset, self.pg, self.mesh,
-            dtype=self.compute,
-            aggr_impl=config.aggr_impl,
-            halo=config.halo,
-            sect_sub_w=config.sect_sub_w,
-            sect_u16=config.sect_u16,
-            bdense_min_fill=config.bdense_min_fill,
-            bdense_a_budget=config.bdense_a_budget,
-            bdense_group=config.bdense_group,
-            aggr_fuse=model.num_fused_aggregates() > 0)
+            node_multiple=8, edge_multiple=config.chunk,
+            method=self._partition_method,
+            cost_weights=self._costmodel.search_weights())
+        self.data = data if data is not None else self._build_data(
+            self.pg)
         if config.aggr_impl == "bdense" and config.halo != "ring" \
                 and data is None:
             # own build only: injected data carries no plan to report
@@ -664,11 +681,52 @@ class DistributedTrainer:
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
         # observability: per-device modeled bytes for the compile
         # observer's modeled-vs-actual check, edges for edges/sec
-        from ..obs.compile_watch import ObservedJit
         from ..train.trainer import modeled_step_bytes
         self._obs_edges = int(dataset.graph.num_edges)
         self._modeled_bytes = modeled_step_bytes(
             model, dataset, config, num_parts=num_parts)
+        self._build_steps()
+        # split-quality record: per-part padded shapes + halo rows +
+        # imbalance ratios, into the manifest (every run records the
+        # split it actually trained on) and the costmodel event stream
+        self._partition_stats = self._emit_partition_stats()
+        from ..obs.manifest import run_manifest
+        run_manifest(config=self.config, dataset=dataset, model=model,
+                     num_parts=num_parts,
+                     extra={"modeled_step_bytes": self._modeled_bytes,
+                            "bd_occupancy": list(
+                                self.data.bd_occupancy),
+                            "partition": self._partition_stats},
+                     console=config.verbose)
+        from ..utils.profiling import EpochTimer, MetricsLog
+        self.timer = EpochTimer()
+        self.metrics_log = MetricsLog(config.metrics_path)
+
+    def _build_data(self, pg) -> ShardedData:
+        """Build + upload the sharded tables for ``pg`` with the
+        trainer's resolved knobs — shared by __init__ and the
+        repartitioning path (the halo/ring/sectioned/bdense tables are
+        all rebuilt from the new bounds here)."""
+        config = self.config
+        return shard_dataset(
+            self._dataset, pg, self.mesh,
+            dtype=self.compute,
+            aggr_impl=config.aggr_impl,
+            halo=config.halo,
+            sect_sub_w=config.sect_sub_w,
+            sect_u16=config.sect_u16,
+            bdense_min_fill=config.bdense_min_fill,
+            bdense_a_budget=config.bdense_a_budget,
+            bdense_group=config.bdense_group,
+            aggr_fuse=self.model.num_fused_aggregates() > 0)
+
+    def _build_steps(self) -> None:
+        """(Re)build the observed step functions.  Called at init and
+        after a shape-changing repartition; a shape-preserving
+        repartition keeps the existing ObservedJit objects so the
+        steady-state AOT executables are reused (no recompile)."""
+        from ..obs.compile_watch import ObservedJit
+        config = self.config
         # the jax.jit calls sit lexically inside ObservedJit(jitfn=...)
         # — the sanctioned form roc-lint's bare-jit rule recognizes:
         # every step compiles through the observer
@@ -681,16 +739,162 @@ class DistributedTrainer:
             jitfn=jax.jit(self._build_eval_step()),
             name="dist_eval_step", verbose=config.verbose)
         self._predict_step = None   # built lazily on first predict()
-        from ..obs.manifest import run_manifest
-        run_manifest(config=self.config, dataset=dataset, model=model,
-                     num_parts=num_parts,
-                     extra={"modeled_step_bytes": self._modeled_bytes,
-                            "bd_occupancy": list(
-                                self.data.bd_occupancy)},
-                     console=config.verbose)
-        from ..utils.profiling import EpochTimer, MetricsLog
-        self.timer = EpochTimer()
-        self.metrics_log = MetricsLog(config.metrics_path)
+
+    def _emit_partition_stats(self) -> dict:
+        """Compute + emit the split-quality record for the CURRENT
+        partition; returns the stats dict.  The O(E) feature pass is
+        paid ONCE here — the φ matrix lands in ``_phi_cache`` so the
+        rebalance hook never recomputes it for the same split."""
+        from ..core.costmodel import (partition_static_stats,
+                                      phi_matrix)
+        self._phi_cache = phi_matrix(
+            self.pg, bd_occupancy=self.data.bd_occupancy)
+        stats = partition_static_stats(
+            self.pg, bd_occupancy=self.data.bd_occupancy,
+            phi=self._phi_cache)
+        emit("costmodel",
+             f"partition={self._partition_method}: "
+             f"P={stats['num_parts']} "
+             f"part_nodes={stats['part_nodes']} "
+             f"part_edges={stats['part_edges']} "
+             f"edge imbalance (max/mean) {stats['edge_imbalance']:.2f} "
+             f"node {stats['node_imbalance']:.2f}",
+             console=self.config.verbose,
+             method=self._partition_method, **stats)
+        return stats
+
+    # ---- online load rebalancing (core/costmodel.py) ----
+
+    @staticmethod
+    def _static_signature(pg, data: ShardedData):
+        """Everything the compiled step specializes on: padded shape
+        statics plus every table's (shape, dtype) and the static aux
+        the GraphContext pytree carries.  Two partitions with equal
+        signatures trace to the same executable, so the repartition
+        path may keep the compiled step; any difference forces a
+        rebuild (stale trace-time constants would otherwise
+        mis-aggregate silently)."""
+        def sh(x):
+            if x is None:
+                return None
+            if isinstance(x, (tuple, list)):
+                return tuple(sh(v) for v in x)
+            if hasattr(x, "shape"):
+                return (tuple(x.shape), str(x.dtype))
+            return x
+        return (pg.part_nodes, pg.part_edges, pg.num_parts,
+                sh(data.feats), sh(data.labels), sh(data.mask),
+                sh(data.edge_src), sh(data.edge_dst),
+                sh(data.in_degree), sh(data.ell_idx),
+                sh(data.ell_row_pos), sh(data.ell_row_id),
+                sh(data.ring_idx), sh(data.sect_idx),
+                sh(data.sect_sub_dst), sh(data.sect_meta),
+                sh(data.bd_tabs), data.bd_vpad, data.bd_src_vpad,
+                data.bd_group, sh(data.ell_w), sh(data.sect_w),
+                sh(data.ring_w), sh(data.bd_scale))
+
+    def _phi(self) -> np.ndarray:
+        """Cached per-partition feature matrix for the CURRENT split
+        (recomputed only after a repartition — the O(E) halo pass must
+        not run every eval)."""
+        if self._phi_cache is None:
+            from ..core.costmodel import phi_matrix
+            self._phi_cache = phi_matrix(
+                self.pg, bd_occupancy=self.data.bd_occupancy)
+        return self._phi_cache
+
+    def maybe_rebalance(self, m: Dict[str, float]) -> bool:
+        """Epoch-boundary rebalancing hook (run_epoch_loop calls this
+        after every eval record): feed the measured lap to the online
+        ridge model (attributed to the predicted-slowest shard — under
+        lockstep SPMD only the straggler's time is observable), search
+        a new split under the refitted weights, and repartition when
+        the predicted max-shard gain clears the hysteresis threshold
+        (``rebalance_gain``, at most ``rebalance_max`` times).
+        Returns True when a repartition happened."""
+        cfg = self.config
+        if not cfg.rebalance or self._rebalances >= cfg.rebalance_max:
+            return False
+        from ..core.costmodel import (bounds_max_cost,
+                                      cost_balanced_bounds)
+        # a record carrying compile_ms may have folded the compile
+        # lap into epoch_ms (run_epoch_loop's span<=0 branch at
+        # eval_every=1, and again after a shape-changing repartition)
+        # — a multi-second compile observed as a step time would
+        # inflate the straggler's fitted weights by orders of
+        # magnitude, so that eval's observation is skipped
+        t = (m.get("epoch_ms")
+             if m.get("compile_ms") is None else None)
+        if t:
+            phi = self._phi()
+            p_star = int(np.argmax(self._costmodel.predict(phi)))
+            self._costmodel.observe(phi[p_star], float(t))
+            emit("costmodel",
+                 f"observe: epoch {m.get('epoch')} lap {t:.1f} ms "
+                 f"attributed to part {p_star}", console=False,
+                 part=p_star, epoch_ms=float(t),
+                 n_obs=self._costmodel.n_obs)
+        wn, we = self._costmodel.search_weights()
+        row_ptr = self._dataset.graph.row_ptr
+        nm = self.pg.node_multiple
+        em = self.pg.edge_multiple
+        cur = bounds_max_cost(row_ptr, self.pg.bounds, wn, we, nm, em)
+        new_bounds = cost_balanced_bounds(
+            row_ptr, self.pg.num_parts, node_multiple=nm,
+            edge_multiple=em, weights=(wn, we))
+        new = bounds_max_cost(row_ptr, new_bounds, wn, we, nm, em)
+        gain = 1.0 - new / cur if cur > 0 else 0.0
+        same = [tuple(b) for b in new_bounds] == \
+            [tuple(b) for b in self.pg.bounds]
+        if same or gain <= cfg.rebalance_gain:
+            emit("costmodel",
+                 f"rebalance: predicted max-shard gain {gain:.1%} "
+                 f"<= threshold {cfg.rebalance_gain:.0%} — keeping "
+                 f"the current split", console=False,
+                 gain=round(gain, 4), threshold=cfg.rebalance_gain)
+            return False
+        self._repartition(new_bounds, gain=gain)
+        return True
+
+    def _repartition(self, bounds, gain: Optional[float] = None
+                     ) -> None:
+        """Rebuild PartitionedGraph + ShardedData for ``bounds`` and
+        resume.  Quantization to the plan's node/edge multiples means
+        an unchanged static signature reuses the compiled step (no
+        recompile — the tables are runtime arguments); a changed one
+        rebuilds the observed steps and re-barriers the compile lap.
+        Replicated params/opt state are untouched: full-batch training
+        makes the switch numerics-preserving."""
+        from ..core.partition import materialize_plan, plan_from_bounds
+        g = self._dataset.graph
+        old_edges = self.pg.part_edges
+        plan = plan_from_bounds(
+            g.row_ptr, [tuple(b) for b in bounds], self.pg.num_parts,
+            node_multiple=self.pg.node_multiple,
+            edge_multiple=self.pg.edge_multiple)
+        pg2 = materialize_plan(g, plan)
+        data2 = self._build_data(pg2)
+        recompile = (self._static_signature(pg2, data2)
+                     != self._static_signature(self.pg, self.data))
+        self.pg, self.data = pg2, data2
+        self._phi_cache = None
+        self._rebalances += 1
+        if recompile:
+            self._build_steps()
+            # barrier the recompile lap out of the steady timing,
+            # exactly like the first compile (run_epoch_loop)
+            self._loop_compiled = False
+        self._partition_stats = self._emit_partition_stats()
+        emit("costmodel",
+             f"repartition #{self._rebalances}: predicted max-shard "
+             f"gain {'?' if gain is None else format(gain, '.1%')}, "
+             f"part_edges {old_edges} -> {pg2.part_edges}, "
+             + ("recompiling steps" if recompile else
+                "quantized shapes unchanged — compiled step reused"),
+             rebalance=self._rebalances,
+             gain=None if gain is None else round(gain, 4),
+             recompile=recompile, part_edges=pg2.part_edges,
+             part_nodes=pg2.part_nodes)
 
     # ---- step builders ----
 
@@ -833,9 +1037,12 @@ class DistributedTrainer:
 
     def train(self, epochs: Optional[int] = None) -> List[Dict[str, float]]:
         from ..train.trainer import run_epoch_loop
-        d = self.data
 
         def do_step(step_key, lr):
+            # read self.data PER STEP, not once per train() call — an
+            # epoch-boundary repartition swaps the sharded tables
+            # mid-run and the next step must train on the new split
+            d = self.data
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, d.feats, d.labels,
                 d.mask, d.edge_src, d.edge_dst, d.in_degree,

@@ -181,6 +181,36 @@ def summarize(events: List[Dict[str, Any]],
     _rows("pipeline (h2d prefetch / ring overlap)",
           ["metric", "p50", "min", "max"], rows, out)
 
+    # partition load balance: the manifest's split-quality record
+    # (per-part padded shapes + halo rows, the shapes that gate every
+    # SPMD step) plus the cost-model event stream — every recorded
+    # imbalance / repartition decision of the run
+    part = (manifests[-1].get("partition") or {}) if manifests else {}
+    rows = []
+    if part.get("real_edges"):
+        cols = [part.get(k) or [] for k in
+                ("padded_edges", "padded_nodes", "halo_in",
+                 "halo_out")]
+        for p, re_ in enumerate(part["real_edges"][:16]):
+            rows.append([str(p), str(re_)]
+                        + [str(c[p]) if p < len(c) else "?"
+                           for c in cols])
+        if len(part["real_edges"]) > 16:
+            rows.append(["...", "", "", "", "", ""])
+    _rows("partition load balance",
+          ["part", "real_edges", "padded_edges", "padded_nodes",
+           "halo_in", "halo_out"], rows, out)
+    if part:
+        print(f"  imbalance max/mean: edges "
+              f"{part.get('edge_imbalance')} nodes "
+              f"{part.get('node_imbalance')}  (padded shard "
+              f"{part.get('part_nodes')} nodes x "
+              f"{part.get('part_edges')} edges)", file=out)
+    cm = [e for e in events if e.get("cat") == "costmodel"
+          and ("rebalance" in e or "gain" in e)]
+    _rows("cost model (rebalance decisions)", ["message"],
+          [[str(e.get("msg", ""))[:110]] for e in cm], out)
+
     stalls = [e for e in events if e.get("cat") == "stall"]
     by_stage: Dict[str, List[float]] = {}
     for e in stalls:

@@ -93,6 +93,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "table-baked D^-1/2 scales (exact linear "
                          "algebra; default auto = fuse whenever the "
                          "model has the chain)")
+    ap.add_argument("--partition", default="auto",
+                    choices=["greedy", "cost", "auto"],
+                    help="distributed split-point selection: 'greedy' "
+                         "= the reference's edge-count sweep "
+                         "(gnn.cc:806-829), 'cost' = cost-balanced "
+                         "minimax search over the partition cost "
+                         "model's padded-shape surrogate "
+                         "(core/costmodel.py), 'auto' (default) = "
+                         "cost — never worse than greedy under the "
+                         "model, strictly better on skewed graphs")
+    ap.add_argument("--rebalance", action="store_true",
+                    help="online load rebalancing (--parts > 1): fit "
+                         "the per-partition cost model against "
+                         "measured step times and repartition at "
+                         "epoch boundaries when the predicted "
+                         "max-shard gain exceeds 10%% (at most 2 "
+                         "repartitions per run; numerics-preserving "
+                         "under full-batch training)")
     ap.add_argument("--halo", default="gather",
                     choices=["gather", "ring"],
                     help="distributed halo exchange: one-shot "
@@ -222,6 +240,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: --prefetch: {e}", file=sys.stderr)
         return 2
+    if args.rebalance and args.parts <= 1:
+        print("error: --rebalance requires --parts > 1 (rebalancing "
+              "moves partition boundaries over a device mesh)",
+              file=sys.stderr)
+        return 2
     if args.model != "gat" and args.heads != 1:
         print("error: --heads applies to --model gat only",
               file=sys.stderr)
@@ -342,7 +365,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         seed=args.seed, eval_every=args.eval_every, verbose=True,
         aggr_impl=args.impl, aggr_fuse=args.fuse, halo=args.halo,
         memory=memory, features=args.features, remat=args.remat,
-        prefetch=args.prefetch,
+        prefetch=args.prefetch, partition=args.partition,
+        rebalance=args.rebalance,
         dtype=dt, compute_dtype=cdt, metrics_path=args.metrics)
 
     if args.parts > 1:

@@ -142,6 +142,22 @@ class TrainConfig:
     #   read-modify-write traffic group-x for <= (group-1) zero-A
     #   padding blocks per occupied dst tile.
     bdense_group: int = 1
+    # Graph partitioning (distributed only; core/costmodel.py):
+    # - partition: "greedy" = the reference's edge-count sweep
+    #   (gnn.cc:806-829 semantics), "cost" = the cost-balanced minimax
+    #   split over the model's padded-shape surrogate, "auto" = cost
+    #   (the cold-start weights ARE quantized edge balance, solved
+    #   optimally — never worse than greedy under the model).
+    # - rebalance: refit the per-partition cost model against measured
+    #   step times at every eval boundary and repartition when the
+    #   predicted max-shard gain exceeds rebalance_gain (hysteresis),
+    #   at most rebalance_max times per run.  Full-batch training
+    #   makes a repartition numerics-preserving; unchanged quantized
+    #   shapes reuse the compiled step (no recompile).
+    partition: str = "auto"
+    rebalance: bool = False
+    rebalance_gain: float = 0.10
+    rebalance_max: int = 2
 
 
 def resolve_dtypes(name: str):
@@ -175,6 +191,22 @@ def resolve_prefetch(config: TrainConfig) -> int:
     if depth < 0:
         raise ValueError(f"prefetch must be >= 0, got {depth}")
     return depth
+
+
+def resolve_partition(config: TrainConfig) -> str:
+    """``TrainConfig.partition`` -> the concrete split method:
+    'auto' resolves to 'cost' (cold-start weights are the quantized
+    edge-balance prior, so the searched split is never worse than the
+    greedy sweep under the model and usually strictly better on
+    skewed graphs).  Unknown values raise — the CLI validates through
+    this same function so the vocabularies can never diverge."""
+    p = config.partition
+    if p == "auto":
+        return "cost"
+    if p in ("greedy", "cost"):
+        return p
+    raise ValueError(f"unknown partition {p!r}; expected 'greedy', "
+                     "'cost', or 'auto'")
 
 
 def compute_dtype_of(config: TrainConfig):
@@ -1052,6 +1084,19 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                     t_last, e_last = t_eval_end, tr.epoch + 1
                     history.append(m)
                     tr.metrics_log.log(m)
+                    # epoch-boundary load rebalancing (distributed
+                    # trainers with config.rebalance): feed the
+                    # measured lap to the partition cost model and
+                    # repartition when the predicted max-shard gain
+                    # clears the hysteresis threshold.  After a
+                    # shape-changing repartition the trainer resets
+                    # _loop_compiled so the recompile lap is barriered
+                    # out of the steady timing like the first one.
+                    rb = getattr(tr, "maybe_rebalance", None)
+                    if rb is not None:
+                        rb(m)
+                        compiled = getattr(tr, "_loop_compiled",
+                                           compiled)
                     emit("epoch",
                          f"epoch {epoch}: {m['epoch_ms']:.1f} ms/epoch "
                          f"eval {m['eval_ms']:.1f} ms",

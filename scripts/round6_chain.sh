@@ -32,3 +32,11 @@ python benchmarks/epoch_community.py --graph random --reorder none \
   --impls sectioned,sectioned+fuse,ell,ell+fuse
 python benchmarks/epoch_community.py --min-fill 32 --a-budget $((6<<30)) \
   --bdense-group 16 --impls bdense,bdense+fuse,sectioned,sectioned+fuse
+# 6. partitioning race (ISSUE 5): greedy edge sweep vs cost-balanced
+#    minimax split on the Zipf power-law + community substrates —
+#    max-shard padded shapes, straggler step time, and the distributed
+#    epoch race when the host has >= 8 chips.  Acceptance: the cost
+#    split reduces modeled max-shard cost AND measured max-shard step
+#    time vs greedy (CPU rehearsal: benchmarks/micro_partition_cpu.json)
+python benchmarks/micro_partition.py \
+  --out benchmarks/micro_partition_chip.json

@@ -254,6 +254,38 @@ def test_hlo_bytes_model_fires_past_factor():
     assert not check_bytes_model("hlo:fix", 1e9, None)
 
 
+# -------------------------------------------- built-trainer fixtures
+
+def test_partition_imbalance_rule():
+    """[partition-imbalance] fires past max/mean 1.5 on >1 device,
+    stays silent on balanced splits and single devices, and carries a
+    ratchetable fingerprint."""
+    from roc_tpu.analysis.driver import check_partition_imbalance
+    got = check_partition_imbalance("partition:fix",
+                                    [100, 10, 10, 10])
+    assert len(got) == 1
+    assert got[0].rule == "partition-imbalance"
+    assert "3.08" in got[0].msg
+    assert got[0].fingerprint == \
+        "partition-imbalance|partition:fix|parts=4"
+    # balanced: quiet
+    assert not check_partition_imbalance("partition:fix",
+                                         [10, 11, 10, 10])
+    # single device: the straggler IS the device — not a finding
+    assert not check_partition_imbalance("partition:fix", [100])
+    # empty / zero-edge degenerate inputs never divide by zero
+    assert not check_partition_imbalance("partition:fix", [])
+    assert not check_partition_imbalance("partition:fix", [0, 0])
+
+
+def test_partition_imbalance_registered():
+    from roc_tpu.analysis.driver import all_rule_names, is_trace_rule
+    assert "partition-imbalance" in all_rule_names()
+    assert is_trace_rule("partition-imbalance")
+    assert is_trace_rule("jaxpr-f32-upcast")
+    assert not is_trace_rule("stdout-print")
+
+
 # ------------------------------------------------- baseline mechanics
 
 def test_baseline_split_and_shrink_only(tmp_path):

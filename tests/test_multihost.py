@@ -55,16 +55,30 @@ def test_local_ell_plan_matches_global_on_full_part():
     part_nodes, padding edges inflate the last real row's local-CSR
     degree; the shape plan must be derived from those SAME degrees or
     the local ELL tables silently drop that row's edges and diverge
-    from shard_dataset's."""
+    from shard_dataset's.
+
+    Since the cost-partitioning PR the plan layer PREVENTS the
+    hazardous fixture outright: a part whose real rows exactly fill
+    part_nodes while carrying padding edges gets one extra
+    row-multiple (core/partition.plan_from_bounds), because the
+    sectioned/bdense planners — unlike the ELL builder this test
+    originally pinned — cannot tolerate dummy sources inside real
+    rows.  The test now asserts that invariant AND keeps the
+    local-vs-global ELL equality on the same node_multiple=1
+    fixture."""
     from roc_tpu.parallel.distributed import shard_dataset
 
     ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=11)
-    # node_multiple=1: the largest partition is exactly full
+    # node_multiple=1: the largest partition WOULD be exactly full —
+    # the plan layer must have padded it by one extra row-multiple
+    # instead of letting its last real row absorb the padding edges
     pg = partition_graph(ds.graph, 4, node_multiple=1, edge_multiple=128)
     full = np.flatnonzero(pg.real_nodes == pg.part_nodes)
-    assert full.size, "fixture must contain a full partition"
-    pad_edges = pg.part_edges - pg.real_edges[full]
-    assert (pad_edges > 0).any(), "full partition needs padding edges"
+    assert not full.size, (
+        "plan_from_bounds must keep padding edges on padded rows — a "
+        "full partition with padding edges leaks dummy sources into "
+        "real rows")
+    assert pg.part_nodes == int(pg.real_nodes.max()) + 1
 
     mesh = mh.make_parts_mesh(4)
     loc = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="ell")
