@@ -9,6 +9,12 @@ Usage:
     python -m roc_tpu.analysis [--strict]          # full run
     python -m roc_tpu.analysis --select stdout-print   # one rule
     python -m roc_tpu.analysis --update-baseline   # shrink ratchet
+    python -m roc_tpu.analysis --json              # machine-readable
+
+``--json`` prints one JSON object on stdout — findings, baseline
+split, and the program-space compile-budget reports with full
+program-key sets — so CI and the bench probe can diff program counts
+across commits without parsing text.
 
 The baseline (``scripts/lint_baseline.json``) is ratchet-only:
 ``--update-baseline`` rewrites it as the INTERSECTION of its current
@@ -62,6 +68,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "(ratchet shrink must be committed)")
     p.add_argument("--list-rules", action="store_true",
                    help="print rule names and exit")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable output: one JSON object "
+                        "(findings + program-key sets) on stdout")
     args = p.parse_args(argv)
 
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
@@ -89,7 +98,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from .driver import all_rule_names, analyze
-    from .findings import load_baseline, shrink_baseline, split_findings
+    from .findings import (load_baseline, shrink_baseline,
+                           shrink_program_budget, split_findings)
 
     if args.list_rules:
         for name in all_rule_names():
@@ -106,42 +116,165 @@ def main(argv: Optional[List[str]] = None) -> int:
     root = args.root or _default_root()
     baseline_path = args.baseline or os.path.join(
         root, "scripts", "lint_baseline.json")
-    findings = analyze(root, select=select, trace=trace)
+    extras: dict = {}
+    from .findings import load_program_budget
+    findings = analyze(root, select=select, trace=trace,
+                       program_budget=load_program_budget(
+                           baseline_path),
+                       extras=extras)
+    reports = extras.get("programspace", [])
     # stale-entry accounting and the shrink ratchet are scoped to the
     # rules that actually ran: an AST-only / --select run must not
     # declare trace-rule baseline entries "no longer firing"
     active = set(select) if select else set(all_rule_names())
     if not trace:
         active = {r for r in active if not is_trace_rule(r)}
+    # program_budget keys get the same stale accounting as finding
+    # fingerprints, scoped to runs where the auditor level ran: a
+    # bound for a config name that no longer EXISTS in the rig set
+    # (renamed/removed — not merely unhosted on this box, whose bound
+    # is deliberately kept) is an orphan that would otherwise disarm
+    # the compile-explosion tripwire silently (the renamed config
+    # restarts at budget=None, which never fires)
+    from .driver import _needs_programspace
+    ps_ran = trace and _needs_programspace(select)
+    rig_names: set = set()
+    if ps_ran:
+        from .programspace import rig_configs
+        rig_names = set(rig_configs())
+
+    def _budget_orphans() -> List[str]:
+        if not ps_ran:
+            return []
+        return sorted(set(load_program_budget(baseline_path))
+                      - rig_names)
+
+    orphans = _budget_orphans()
     baseline = load_baseline(baseline_path)
     new, old, stale = split_findings(findings, baseline,
                                      active_rules=active)
+    dropped = 0
+    if args.update_baseline:
+        # shrink FIRST (findings AND budget), then re-split against
+        # the updated file: all output below must describe the state
+        # this run LEAVES, not the entries it just removed — a CI
+        # consumer would otherwise re-flag a ratchet the same
+        # invocation already cleared, and a first-ever run would
+        # print bounds instructing the user to run the flag they are
+        # running
+        kept = shrink_baseline(baseline_path, findings,
+                               active_rules=active)
+        dropped = len(baseline) - len(kept)
+        if ps_ran:
+            budget = shrink_program_budget(
+                baseline_path,
+                {r["config"]: r["programs"] for r in reports},
+                known=rig_names)
+            for rep in reports:
+                b = budget.get(rep["config"])
+                rep["budget"] = b
+                if b is not None:
+                    rep["delta"] = rep["programs"] - b
+        baseline = load_baseline(baseline_path)
+        new, old, stale = split_findings(findings, baseline,
+                                         active_rules=active)
+        orphans = _budget_orphans()
+    # budget slack — same ratchet semantics as stale findings: a
+    # measured program count BELOW the recorded bound must be
+    # committed via --update-baseline, or a later program-count
+    # regression would hide inside the slack and the compile-wall
+    # tripwire would never fire.  A measured config with NO bound at
+    # all is the limiting case of slack (infinite headroom — the
+    # tripwire is disarmed for it), so under --strict it fails the
+    # same way until --update-baseline initializes the bound.
+    slack = [r for r in reports if r.get("delta") is not None
+             and r["delta"] < 0]
+    unbounded = [r for r in reports if r.get("budget") is None]
+
+    if args.json:
+        import json as _json
+        payload = {
+            "findings": [
+                {"rule": f.rule, "unit": f.unit, "line": f.line,
+                 "msg": f.msg, "fingerprint": f.fingerprint,
+                 "baselined": f.fingerprint in baseline,
+                 "detail": f.detail}
+                for f in new + old],
+            "stale": sorted(stale),
+            "budget_stale": orphans,
+            "program_space": reports,
+            "summary": {"new": len(new), "baselined": len(old),
+                        "stale": len(stale),
+                        "budget_slack": len(slack),
+                        "budget_stale": len(orphans),
+                        "budget_unbounded": len(unbounded)},
+        }
+        print(_json.dumps(payload, indent=2))
+        return (1 if new or ((stale or slack or orphans or unbounded)
+                             and args.strict)
+                else 0)
 
     for f in new:
         print(f.render())
     for f in old:
         print(f"{f.render()}  [baselined]")
+    # the program-space compile budget — the static compile-wall
+    # tripwire.  scripts/test.sh's pre-flight surfaces these lines, so
+    # a PR that adds a compiled-program shape shows its delta before
+    # the test tier even starts (red when it grew and a tty is
+    # watching).
+    for rep in reports:
+        b = rep.get("budget")
+        delta = rep.get("delta")
+        d_txt = ("no baseline — run --update-baseline" if b is None
+                 else f"baseline {b}, delta {delta:+d}")
+        line = (f"program budget {rep['config']}: "
+                f"{rep['programs']} programs, modeled compile "
+                f"{rep['modeled_compile_ms'] / 1e3:.1f}s ({d_txt})")
+        if delta is not None and delta > 0 and sys.stdout.isatty():
+            line = f"\x1b[31m{line}\x1b[0m"
+        print(line)
     if args.update_baseline:
-        kept = shrink_baseline(baseline_path, findings,
-                               active_rules=active)
-        dropped = len(baseline) - len(kept)
-        print(f"baseline: kept {len(kept)}, dropped {dropped} stale "
-              f"entr{'y' if dropped == 1 else 'ies'} "
+        print(f"baseline: kept {len(baseline)}, dropped {dropped} "
+              f"stale entr{'y' if dropped == 1 else 'ies'} "
               f"({baseline_path})")
-        stale = set()
-    elif stale:
-        verb = "FAIL" if args.strict else "note"
-        print(f"{verb}: {len(stale)} stale baseline entr"
-              f"{'y' if len(stale) == 1 else 'ies'} no longer "
-              f"fire(s) — run --update-baseline to ratchet down:")
-        for fp in sorted(stale):
-            print(f"  {fp}")
+    else:
+        if stale:
+            verb = "FAIL" if args.strict else "note"
+            print(f"{verb}: {len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} no longer "
+                  f"fire(s) — run --update-baseline to ratchet down:")
+            for fp in sorted(stale):
+                print(f"  {fp}")
+        if slack:
+            verb = "FAIL" if args.strict else "note"
+            print(f"{verb}: {len(slack)} program budget(s) above the "
+                  f"measured count — run --update-baseline to "
+                  f"ratchet down:")
+            for rep in slack:
+                print(f"  {rep['config']}: {rep['programs']} measured"
+                      f" < {rep['budget']} baselined")
+        if orphans:
+            verb = "FAIL" if args.strict else "note"
+            print(f"{verb}: {len(orphans)} program budget entr"
+                  f"{'y' if len(orphans) == 1 else 'ies'} for "
+                  f"unknown rig config(s) — the compile-explosion "
+                  f"bound no longer guards anything; run "
+                  f"--update-baseline to drop:")
+            for cfg in orphans:
+                print(f"  {cfg}")
+        if unbounded and args.strict:
+            print(f"FAIL: {len(unbounded)} measured config(s) have "
+                  f"no program_budget bound (tripwire disarmed) — "
+                  f"run --update-baseline to initialize:")
+            for rep in unbounded:
+                print(f"  {rep['config']}: {rep['programs']} measured")
 
     print(f"roc-lint: {len(new)} new, {len(old)} baselined, "
           f"{len(stale)} stale")
     if new:
         return 1
-    if stale and args.strict:
+    if (stale or slack or orphans or unbounded) and args.strict:
         return 1
     return 0
 

@@ -277,9 +277,10 @@ def run_ast_lint(root: str,
     not silently skip a gate)."""
     rules = RULES
     if select is not None:
+        from .driver import is_trace_rule   # lazy: no import cycle
         known = {r.name for r in RULES}
-        bad = [s for s in select if s not in known and
-               not s.startswith(("jaxpr-", "hlo-"))]
+        bad = [s for s in select
+               if s not in known and not is_trace_rule(s)]
         if bad:
             raise ValueError(f"unknown lint rule(s): {bad}; "
                              f"AST rules: {sorted(known)}")

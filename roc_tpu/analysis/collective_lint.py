@@ -1,0 +1,302 @@
+"""SPMD collective verifier: static deadlock/consistency rules over
+the distributed steps' jaxprs and the ring/halo subroutines.
+
+The distributed layer's failure modes only manifest at P>=2 on real
+hardware — a malformed ``ppermute`` permutation hangs the ICI ring, a
+collective issued in one branch of a conditional but not the other
+desynchronizes the lockstep SPMD programs into a deadlock, a ring
+table that disagrees with the partition plan's halo stats silently
+aggregates the wrong rows.  None of these raise at trace time.  This
+level checks them on the CPU rig, before any chip run:
+
+- [collective-ppermute-cycle] every ``ppermute`` permutation must be a
+  single cycle covering the full ``parts`` axis — exactly the named
+  hop schedule ``parallel/ring.ring_hop_perm``.  A two-cycle rotates
+  two disjoint sub-rings (each shard sees only half the graph); a
+  partial cover leaves devices waiting on sends that never come.
+- [collective-axis-name] every ``psum`` / ``all_gather`` /
+  ``ppermute`` axis name must exist on the mesh the rig built
+  (``parallel/distributed.PARTS_AXIS``).  Inside ``shard_map`` a bad
+  name is a trace error; the hazard is collectives built from config
+  strings that only bind on a larger mesh.
+- [collective-conditional] the collective sequence (primitive, axis
+  names, operand shape) must be identical across all branches of
+  every ``cond`` — a conditional collective is an instant P>=2 hang
+  when shards disagree on the predicate (lockstep-SPMD deadlock
+  freedom).  Collectives under ``cond`` are fine when every branch
+  issues the SAME sequence.
+- [collective-ring-halo] the ring tables' real send/recv row counts
+  must match the partition plan's halo-in/out stats
+  (``core/costmodel.partition_halo_stats`` — the numbers recorded in
+  the run manifest): a drifted table build would exchange the wrong
+  rows with no shape error anywhere.
+
+Units are :class:`CollectiveUnit` (a traced ClosedJaxpr + the mesh
+axis vocabulary); the ring-halo rule is structural (host arrays, no
+jaxpr).  Findings ride the same baseline ratchet as every level.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .findings import Finding
+from .jaxpr_lint import _aval, _shape_str, iter_eqns
+
+# collectives whose axis names the verifier vets; reduce_* carry
+# positional int axes in the same 'axes' param slot, so names are
+# filtered to strings below
+_COLLECTIVE_PRIMS = ("psum", "pmax", "pmin", "ppermute", "all_gather",
+                     "all_gather_invariant", "all_to_all",
+                     "reduce_scatter", "axis_index", "pbroadcast")
+
+
+@dataclass
+class CollectiveUnit:
+    """One traced distributed program under verification.
+
+    ``axis_sizes`` is the mesh vocabulary the rig actually built
+    (name -> size) — the ground truth the axis-name and cycle rules
+    hold the traced eqns against."""
+
+    name: str
+    jaxpr: Any
+    axis_sizes: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def unit(self) -> str:
+        return f"collective:{self.name}"
+
+
+def _axis_names(eqn) -> List[str]:
+    """String axis names a collective eqn binds (positional int axes
+    of plain reductions are not mesh names and are skipped)."""
+    names: List[str] = []
+    for param in ("axis_name", "axes"):
+        v = eqn.params.get(param)
+        if v is None:
+            continue
+        for a in (v if isinstance(v, (tuple, list)) else (v,)):
+            if isinstance(a, str):
+                names.append(a)
+    return names
+
+
+def _is_collective(eqn) -> bool:
+    return (eqn.primitive.name in _COLLECTIVE_PRIMS
+            and bool(_axis_names(eqn)))
+
+
+def check_ppermute_cycle(u: CollectiveUnit) -> List[Finding]:
+    """[collective-ppermute-cycle] see module docstring.  The check is
+    against the axis size, not against ring_hop_perm literally — any
+    single full cycle is deadlock-free (a reversed ring is legal), but
+    the canonical schedule is the one the ring emits."""
+    out: List[Finding] = []
+    for eqn in iter_eqns(u.jaxpr):
+        if eqn.primitive.name != "ppermute":
+            continue
+        names = _axis_names(eqn)
+        perm = [(int(s), int(d)) for s, d in eqn.params.get("perm", ())]
+        size = max((u.axis_sizes.get(n, 0) for n in names), default=0)
+        if not size:
+            continue  # unknown axis: collective-axis-name's business
+        problem = _cycle_problem(perm, size)
+        if problem:
+            out.append(Finding(
+                "collective-ppermute-cycle", u.unit,
+                f"ppermute over {'/'.join(names)} (size {size}) is "
+                f"not a single full cycle: {problem} — this hangs or "
+                f"drops shards at P>=2 (the named schedule is "
+                f"parallel/ring.ring_hop_perm)",
+                key=f"ppermute|{'/'.join(names)}|{problem}"))
+    return out
+
+
+def _cycle_problem(perm: List[Tuple[int, int]],
+                   size: int) -> Optional[str]:
+    """None when ``perm`` is one cycle covering {0..size-1}; else a
+    short description of the defect."""
+    srcs = [s for s, _ in perm]
+    dsts = [d for _, d in perm]
+    members = set(range(size))
+    if set(srcs) != members or set(dsts) != members:
+        missing = sorted(members - set(srcs) - set(dsts))
+        return (f"covers {len(set(srcs) | set(dsts))}/{size} members"
+                + (f" (missing {missing})" if missing else
+                   " asymmetrically"))
+    if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+        return "duplicate senders/receivers"
+    nxt = dict(perm)
+    seen, cur = 1, nxt[0]
+    while cur != 0 and seen <= size:
+        cur = nxt[cur]
+        seen += 1
+    if seen != size:
+        return f"{_n_cycles(nxt, size)} disjoint cycles"
+    return None
+
+
+def _n_cycles(nxt: Dict[int, int], size: int) -> int:
+    left, n = set(range(size)), 0
+    while left:
+        n += 1
+        cur = start = left.pop()
+        while nxt[cur] != start:
+            cur = nxt[cur]
+            left.discard(cur)
+    return n
+
+
+def check_axis_names(u: CollectiveUnit) -> List[Finding]:
+    """[collective-axis-name] see module docstring."""
+    out: List[Finding] = []
+    known = set(u.axis_sizes)
+    for eqn in iter_eqns(u.jaxpr):
+        if eqn.primitive.name not in _COLLECTIVE_PRIMS:
+            continue
+        for name in _axis_names(eqn):
+            if name not in known:
+                out.append(Finding(
+                    "collective-axis-name", u.unit,
+                    f"{eqn.primitive.name} over axis {name!r} which "
+                    f"the rig mesh does not define (axes: "
+                    f"{sorted(known)}) — binds only on a larger mesh, "
+                    f"or never",
+                    key=f"axis|{eqn.primitive.name}|{name}"))
+    return out
+
+
+def _collective_signature(jaxpr) -> Tuple:
+    """Ordered tuple of (primitive, axis names, operand shape,
+    pairing) for every collective in ``jaxpr``, depth-first across
+    nesting — the lockstep schedule a branch would execute.  The
+    pairing term is the ``perm`` of a ppermute: two branches
+    permuting over the same axis with DIFFERENT permutations are just
+    as deadlock-prone as psum-vs-nothing (device A sends along one
+    schedule while B waits on the other), so the perm is part of the
+    sequence identity."""
+    sig = []
+    for eqn in iter_eqns(jaxpr):
+        if not _is_collective(eqn) or eqn.primitive.name == "axis_index":
+            continue
+        a = _aval(eqn.invars[0]) if eqn.invars else None
+        perm = tuple((int(s), int(d))
+                     for s, d in eqn.params.get("perm", ()))
+        sig.append((eqn.primitive.name, tuple(_axis_names(eqn)),
+                    _shape_str(a) if a is not None else "?", perm))
+    return tuple(sig)
+
+
+class _Closed:
+    """Minimal ClosedJaxpr-shaped wrapper so iter_eqns accepts a raw
+    branch Jaxpr."""
+
+    def __init__(self, jaxpr):
+        self.jaxpr = jaxpr
+
+
+def check_conditional_collective(u: CollectiveUnit) -> List[Finding]:
+    """[collective-conditional] see module docstring."""
+    out: List[Finding] = []
+    for eqn in iter_eqns(u.jaxpr):
+        if eqn.primitive.name != "cond":
+            continue
+        branches = eqn.params.get("branches", ())
+        sigs = []
+        for br in branches:
+            j = getattr(br, "jaxpr", br)
+            sigs.append(_collective_signature(_Closed(j)))
+        if len(set(sigs)) <= 1:
+            continue
+        detail = " vs ".join(
+            "[" + ", ".join(
+                f"{p}@{'/'.join(n)}" + (f"{list(pm)}" if pm else "")
+                for p, n, _, pm in s)
+            + "]" for s in sigs)
+        out.append(Finding(
+            "collective-conditional", u.unit,
+            f"cond branches issue different collective sequences "
+            f"({detail}) — shards disagreeing on the predicate "
+            f"deadlock the lockstep SPMD program at P>=2; hoist the "
+            f"collective out of the conditional",
+            key=f"cond|{detail[:80]}"))
+    return out
+
+
+COLLECTIVE_RULES = {
+    "collective-ppermute-cycle": check_ppermute_cycle,
+    "collective-axis-name": check_axis_names,
+    "collective-conditional": check_conditional_collective,
+}
+
+
+def run_collective_lint(units: Sequence[CollectiveUnit],
+                        select: Optional[List[str]] = None
+                        ) -> List[Finding]:
+    findings: List[Finding] = []
+    for unit in units:
+        for name, rule in COLLECTIVE_RULES.items():
+            if select is not None and name not in select:
+                continue
+            findings.extend(rule(unit))
+    return findings
+
+
+# ------------------------------------------- ring-table consistency
+
+def ring_table_halo_counts(pg, rt) -> Tuple[np.ndarray, np.ndarray]:
+    """(send_in [P], send_out [P]) derived from the RING TABLES alone:
+    per part, the distinct external source rows its pairs actually
+    gather (what the rotation must deliver to it) and the distinct
+    local rows other parts' pairs reference (what it must send).
+    Compared against the plan-derived
+    ``core/costmodel.partition_halo_stats`` by
+    :func:`check_ring_halo` — two independent derivations of the same
+    exchange, so a drift in either build is caught."""
+    P = pg.num_parts
+    recv = np.zeros(P, dtype=np.int64)
+    sent: List[set] = [set() for _ in range(P)]
+    for p in range(P):
+        gathered = set()
+        for s in range(P):
+            src = np.asarray(rt.src[p, s], dtype=np.int64)
+            real = np.unique(src[src < pg.part_nodes])
+            if s != p:
+                gathered.update((s, int(v)) for v in real)
+                sent[s].update(int(v) for v in real)
+        recv[p] = len(gathered)
+    send = np.array([len(s) for s in sent], dtype=np.int64)
+    return recv, send
+
+
+def check_ring_halo(unit: str, pg, rt) -> List[Finding]:
+    """[collective-ring-halo] see module docstring."""
+    from ..core.costmodel import partition_halo_stats
+    halo_in, halo_out = partition_halo_stats(pg)
+    recv, send = ring_table_halo_counts(pg, rt)
+    out: List[Finding] = []
+    for p in range(pg.num_parts):
+        if int(recv[p]) != int(halo_in[p]):
+            out.append(Finding(
+                "collective-ring-halo", unit,
+                f"part {p}: ring tables gather {int(recv[p])} distinct "
+                f"external rows but the partition plan's halo-in is "
+                f"{int(halo_in[p])} — the hop schedule and the split "
+                f"disagree about what must be exchanged",
+                key=f"halo-in|part={p}",
+                detail={"table": int(recv[p]),
+                        "plan": int(halo_in[p])}))
+        if int(send[p]) != int(halo_out[p]):
+            out.append(Finding(
+                "collective-ring-halo", unit,
+                f"part {p}: ring tables reference {int(send[p])} "
+                f"distinct rows of this part from other parts but the "
+                f"plan's halo-out is {int(halo_out[p])}",
+                key=f"halo-out|part={p}",
+                detail={"table": int(send[p]),
+                        "plan": int(halo_out[p])}))
+    return out

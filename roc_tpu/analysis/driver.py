@@ -20,16 +20,20 @@ from typing import Any, Dict, List, Optional
 
 from ..obs.events import emit
 from .ast_lint import RULES as AST_RULES, run_ast_lint
+from .collective_lint import (COLLECTIVE_RULES, CollectiveUnit,
+                              check_ring_halo, run_collective_lint)
 from .findings import Finding, dedupe
 from .hlo_lint import check_bytes_model, check_large_copy
 from .jaxpr_lint import JAXPR_RULES, JaxprUnit, run_jaxpr_lint
+from .programspace import (_C, _DEG, _F, _H, _V, PROGRAMSPACE_RULES,
+                           audit_program_space)
 
 HLO_RULES = ("hlo-large-copy", "hlo-bytes-model")
 
 # trace-stage rules that are neither jaxpr- nor hlo- prefixed: they
 # inspect the BUILT trainers (here: the distributed trainer's actual
-# partition), so they need the same 8-virtual-device rig
-EXTRA_TRACE_RULES = ("partition-imbalance",)
+# partition / ring tables), so they need the same 8-virtual-device rig
+EXTRA_TRACE_RULES = ("partition-imbalance", "collective-ring-halo")
 
 # a recorded max/mean edge imbalance past this on >1 device means the
 # slowest shard gates every SPMD step by >= 50% over the mean — the
@@ -39,10 +43,12 @@ IMBALANCE_THRESHOLD = 1.5
 
 def is_trace_rule(name: str) -> bool:
     """True for rules that need the jax trace/build stage (jaxpr-*,
-    hlo-*, and the built-trainer checks) — shared by the driver's
-    stage gating and the CLI's stale-entry scoping."""
-    return (name.startswith(("jaxpr-", "hlo-"))
-            or name in EXTRA_TRACE_RULES)
+    hlo-*, collective-*, the program-space auditor, and the
+    built-trainer checks) — shared by the driver's stage gating and
+    the CLI's stale-entry scoping."""
+    return (name.startswith(("jaxpr-", "hlo-", "collective-"))
+            or name in EXTRA_TRACE_RULES
+            or name in PROGRAMSPACE_RULES)
 
 
 def check_partition_imbalance(unit: str, real_edges,
@@ -81,19 +87,26 @@ def check_partition_imbalance(unit: str, real_edges,
 # (V/8 * F on the mesh) dominates parameter scale (F * H) by the
 # margins the rules assume; small enough that the whole stage
 # (3 trainer builds + 1 CPU compile) stays inside the tier's <60 s
-# budget
-_V, _DEG, _F, _C, _H = 256, 6, 48, 6, 24
+# budget.  The scale constants (_V/_DEG/_F/_C/_H) are defined ONCE in
+# programspace and imported at the top of this module (the reverse
+# import would cycle), so the jaxpr-lint stage and the program-space
+# auditor can never check different synthetic rigs.
 
 
 def all_rule_names() -> List[str]:
     return ([r.name for r in AST_RULES] + list(JAXPR_RULES)
-            + list(HLO_RULES) + list(EXTRA_TRACE_RULES))
+            + list(HLO_RULES) + list(EXTRA_TRACE_RULES)
+            + list(COLLECTIVE_RULES) + list(PROGRAMSPACE_RULES))
 
 
 def _needs_trace(select: Optional[List[str]]) -> bool:
+    """True when the jaxpr/HLO/collective trainer-build stage must
+    run.  Program-space rules have their own rig builds
+    (audit_program_space) and alone don't need this stage."""
     if select is None:
         return True
-    return any(is_trace_rule(s) for s in select)
+    return any(is_trace_rule(s) and s not in PROGRAMSPACE_RULES
+               for s in select)
 
 
 def build_trace_findings(select: Optional[List[str]] = None,
@@ -195,6 +208,39 @@ def build_trace_findings(select: Optional[List[str]] = None,
             "partition:dist_trainer", dtr.pg.real_edges,
             dtr.pg.num_parts))
 
+    collective_selected = (select is None or any(
+        s.startswith("collective-") for s in select))
+    if len(jax.devices()) > 1 and collective_selected:
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.distributed import PARTS_AXIS, _shard_map
+        from ..parallel.ring import build_ring_tables, ring_aggregate
+        axes = {PARTS_AXIS: parts}
+        # the dist steps' traced collectives (gradient psum, halo
+        # gather, metrics reduction) re-use the jaxprs above
+        by_name = {u.name: u for u in units}
+        cunits = [CollectiveUnit(n, by_name[n].jaxpr, axes)
+                  for n in ("dist_train_step", "dist_eval_step")
+                  if n in by_name]
+        # the ring-halo subroutine, traced standalone: the gather-halo
+        # trainer above never emits a ppermute, and the ring schedule
+        # is exactly what the cycle rule exists to verify
+        rt = build_ring_tables(dtr.pg)
+        ring_fn = _shard_map(
+            lambda x, s_, d_: ring_aggregate(
+                x[0], s_[0], d_[0], axis_name=PARTS_AXIS),
+            dtr.mesh, (P(PARTS_AXIS),) * 3, P(PARTS_AXIS))
+        cunits.append(CollectiveUnit(
+            "ring_halo", jax.make_jaxpr(ring_fn)(
+                jnp.zeros((parts, dtr.pg.part_nodes, 8), jnp.float32),
+                jnp.asarray(rt.src), jnp.asarray(rt.dst)), axes))
+        findings.extend(run_collective_lint(cunits, select=select))
+        if select is None or "collective-ring-halo" in select:
+            # structural: the ring tables vs the plan's halo stats —
+            # two independent derivations of the same exchange
+            findings.extend(check_ring_halo(
+                "collective:ring_tables", dtr.pg, rt))
+
     hlo_selected = (select is None
                     or any(s.startswith("hlo-") for s in select))
     if hlo_selected:
@@ -214,15 +260,40 @@ def build_trace_findings(select: Optional[List[str]] = None,
     return findings
 
 
+def _needs_programspace(select: Optional[List[str]]) -> bool:
+    if select is None:
+        return True
+    return any(s in PROGRAMSPACE_RULES for s in select)
+
+
 def analyze(root: str, select: Optional[List[str]] = None,
-            trace: bool = True) -> List[Finding]:
+            trace: bool = True,
+            program_budget: Optional[Dict[str, int]] = None,
+            extras: Optional[Dict[str, Any]] = None) -> List[Finding]:
     """AST lint over ``root`` plus (when ``trace`` and a trace rule is
-    selected) the jaxpr/HLO stage.  Every finding is also emitted as
-    an ``analysis``-category event."""
+    selected) the jaxpr/HLO/collective stage and the program-space
+    auditor.  Every finding is also emitted as an
+    ``analysis``-category event.
+
+    ``program_budget`` is the per-rig-config program-count bound for
+    the compile-explosion rule; None loads it from ``root``'s
+    ``scripts/lint_baseline.json`` (``program_budget`` key).
+    ``extras``, when a dict, receives the auditor's compile-budget
+    reports under ``'programspace'``."""
     t0 = time.perf_counter()
     findings = run_ast_lint(root, select=select)
     if trace and _needs_trace(select):
         findings.extend(build_trace_findings(select=select))
+    if trace and _needs_programspace(select):
+        if program_budget is None:
+            import os
+
+            from .findings import load_program_budget
+            program_budget = load_program_budget(os.path.join(
+                root, "scripts", "lint_baseline.json"))
+        findings.extend(audit_program_space(
+            select=select, program_budget=program_budget,
+            extras=extras))
     findings = dedupe(findings)
     for f in findings:
         emit("analysis", f.render(), console=False, rule=f.rule,

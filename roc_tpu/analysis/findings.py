@@ -55,21 +55,66 @@ def dedupe(findings: Iterable[Finding]) -> List[Finding]:
     return out
 
 
+def _load_raw(path: str) -> Dict[str, Any]:
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
 def load_baseline(path: str) -> Set[str]:
     """Fingerprint set from a baseline file; a missing file is an
     empty baseline (the ratchet starts at zero)."""
-    if not os.path.exists(path):
-        return set()
-    with open(path) as f:
-        data = json.load(f)
-    return set(data.get("findings", []))
+    return set(_load_raw(path).get("findings", []))
 
 
-def save_baseline(path: str, fingerprints: Iterable[str]) -> None:
+def load_program_budget(path: str) -> Dict[str, int]:
+    """Per-rig-config program-count bounds (the compile-explosion
+    ratchet) from the same baseline file; missing file/key = no
+    bounds recorded yet."""
+    return {str(k): int(v) for k, v in
+            _load_raw(path).get("program_budget", {}).items()}
+
+
+def save_baseline(path: str, fingerprints: Iterable[str],
+                  program_budget: Optional[Dict[str, int]] = None
+                  ) -> None:
+    """Write the baseline.  ``program_budget=None`` preserves the
+    file's existing budget section untouched — the finding ratchet and
+    the program-count ratchet shrink independently."""
+    if program_budget is None:
+        program_budget = load_program_budget(path)
+    data: Dict[str, Any] = {"version": 1,
+                            "findings": sorted(set(fingerprints))}
+    if program_budget:
+        data["program_budget"] = {k: int(program_budget[k])
+                                  for k in sorted(program_budget)}
     with open(path, "w") as f:
-        json.dump({"version": 1,
-                   "findings": sorted(set(fingerprints))}, f, indent=2)
+        json.dump(data, f, indent=2)
         f.write("\n")
+
+
+def shrink_program_budget(path: str, counts: Dict[str, int],
+                          known: Optional[Set[str]] = None
+                          ) -> Dict[str, int]:
+    """Ratchet-only budget update: for every config the auditor
+    MEASURED this run, record ``min(stored, measured)`` — a bound can
+    initialize (absent key) and shrink, never grow; growing past the
+    bound means fixing the program explosion or hand-editing the JSON
+    (the same deliberate escape hatch as the findings list).  Configs
+    not measured (e.g. a single-device box skipping the P=2 rig) keep
+    their stored bounds.  ``known``, when given, is the full rig
+    config-name set: bounds for configs that no longer EXIST (renamed
+    or removed rigs — not merely unhosted on this box) are dropped,
+    the budget analogue of a stale finding fingerprint.  Returns the
+    budget written."""
+    budget = load_program_budget(path)
+    if known is not None:
+        budget = {k: v for k, v in budget.items() if k in known}
+    for cfg, n in counts.items():
+        budget[cfg] = min(budget.get(cfg, int(n)), int(n))
+    save_baseline(path, load_baseline(path), program_budget=budget)
+    return budget
 
 
 def _rule_of(fingerprint: str) -> str:

@@ -159,7 +159,35 @@ def check_non_donated(u: JaxprUnit) -> List[Finding]:
     Only the DISPATCH-BOUNDARY pjit is judged — the single top-level
     pjit eqn of a traced jitted callable.  Donation is a caller-side
     contract at that boundary; inner library pjits are inlined by XLA,
-    which reuses their buffers without any donate_argnums."""
+    which reuses their buffers without any donate_argnums.
+
+    Value-and-grad recognition: jax's ``value_and_grad`` convention
+    puts the scalar value FIRST and the gradients after it — a
+    grad-shaped output of such a jaxpr is a *cotangent* of its primal
+    argument, not an update of it, and the caller by construction
+    still needs the primal afterwards (the optimizer apply consumes
+    params AND grads), so donation is not the fix and flagging it was
+    the rule's one known false positive (the retired ``tail_grad``
+    baseline entry).  An update-style step (params first, or no
+    leading scalar) is judged exactly as before.
+
+    A scalar PARAM that happens to flatten first (e.g. a learned-eps
+    GIN) must not disarm the rule for update steps: the echo guard
+    below refuses the exemption when the first two output avals
+    mirror the first two input avals in order — an update step echoes
+    its input prefix (params head INCLUDING the scalar), while
+    value_and_grad's leading scalar is the loss, whose successor is
+    the first *cotangent* and so tracks the primal's leaf 0, not
+    leaf 1.
+
+    Known limit of the convention heuristic: a hand-written update
+    step returning ``(loss, new_params, new_opt_state)`` — scalar
+    FIRST — would be exempted too, since avals alone cannot separate
+    cotangents from updated buffers (adam state is param-shaped, so
+    even cross-arg matching can't).  This repo's steps return loss
+    LAST (the flagged surface), and every step slot is a fixed,
+    linted unit in driver.py — a new scalar-first update slot should
+    keep that convention or donate explicitly."""
     out: List[Finding] = []
     top = [e for e in u.jaxpr.jaxpr.eqns
            if e.primitive.name == "pjit"]
@@ -169,11 +197,26 @@ def check_non_donated(u: JaxprUnit) -> List[Finding]:
         donated = eqn.params.get("donated_invars")
         if donated is None:
             continue
-        out_avals = []
+        out_sigs = []
         for v in eqn.outvars:
             a = _aval(v)
-            if a is not None:
-                out_avals.append((tuple(a.shape), str(a.dtype)))
+            out_sigs.append((tuple(a.shape), str(a.dtype))
+                            if a is not None else None)
+        in_sigs = []
+        for v in eqn.invars[:2]:
+            a = _aval(v)
+            in_sigs.append((tuple(a.shape), str(a.dtype))
+                           if a is not None else None)
+        # an output prefix that mirrors the input prefix in ORDER is
+        # an update-step echo, not (value, grads...) — see docstring
+        echo_prefix = (len(out_sigs) >= 2 and len(in_sigs) == 2
+                       and None not in in_sigs
+                       and out_sigs[0] == in_sigs[0]
+                       and out_sigs[1] == in_sigs[1])
+        value_and_grad_like = (
+            bool(out_sigs) and out_sigs[0] is not None
+            and out_sigs[0][0] == () and "float" in out_sigs[0][1]
+            and len(out_sigs) > 1 and not echo_prefix)
         for pos, (var, don) in enumerate(zip(eqn.invars, donated)):
             if don:
                 continue
@@ -182,14 +225,18 @@ def check_non_donated(u: JaxprUnit) -> List[Finding]:
                 continue
             sig = (tuple(a.shape), str(a.dtype))
             nbytes = _elems(a) * getattr(a.dtype, "itemsize", 4)
-            if sig in out_avals and nbytes >= u.donate_min_bytes:
-                out.append(Finding(
-                    "jaxpr-non-donated", u.unit,
-                    f"arg {pos} ({_shape_str(a)}, {nbytes} B) matches "
-                    f"an output aval but is not donated — its HBM "
-                    f"residency is doubled across the step; add it to "
-                    f"donate_argnums",
-                    key=f"nondonated|{pos}|{_shape_str(a)}"))
+            matches = [i for i, s in enumerate(out_sigs) if s == sig]
+            if not matches or nbytes < u.donate_min_bytes:
+                continue
+            if value_and_grad_like and all(i > 0 for i in matches):
+                continue    # cotangents of a (value, grads...) jaxpr
+            out.append(Finding(
+                "jaxpr-non-donated", u.unit,
+                f"arg {pos} ({_shape_str(a)}, {nbytes} B) matches "
+                f"an output aval but is not donated — its HBM "
+                f"residency is doubled across the step; add it to "
+                f"donate_argnums",
+                key=f"nondonated|{pos}|{_shape_str(a)}"))
     return out
 
 

@@ -47,6 +47,24 @@ _USABLE = 0.85
 _DEFAULT_HBM = 16 * 1024**3  # v5e physical per chip
 
 
+def charged_table_bytes(aggr_impl: str, uses_attention: bool,
+                        uses_max_aggregation: bool,
+                        a_budget_bytes: Optional[int]) -> int:
+    """The impl-specific resident-table bytes the memory plan must
+    charge on top of the generic ``E*4`` term — today the bdense
+    A-table, whose worst case is exactly the planner's device-byte cap
+    (``bdense_a_budget``).  ONE home for the rule (it used to live
+    duplicated in ``modeled_step_bytes`` and the autopilot, round-5
+    advisor): attention/MAX models never keep the table — their impl
+    is rewritten away from bdense by ``resolve_attention_impl`` — and
+    an uncapped budget is unmodelable (0 here; the occupancy echo is
+    the warning there)."""
+    keeps_bdense = (aggr_impl == "bdense"
+                    and not uses_attention
+                    and not uses_max_aggregation)
+    return (a_budget_bytes or 0) if keeps_bdense else 0
+
+
 def detect_hbm_bytes(default: int = _DEFAULT_HBM) -> int:
     """Per-device HBM budget: ``memory_stats()['bytes_limit']`` when the
     backend exposes it (the axon relay may not), else the v5e default;

@@ -74,6 +74,44 @@ def _round_up(x: int, m: int) -> int:
     return ((x + m - 1) // m) * m
 
 
+# The default shape-quantization multiples: per-part padded node rows
+# snap to NODE_MULTIPLE, padded edge slots to EDGE_MULTIPLE.  Named so
+# every consumer of the quantization grid — the splitter below, the
+# rebalance path, and the program-space auditor's cache-key-drift
+# snapping (analysis/programspace.py) — reads the SAME values.
+NODE_MULTIPLE = 8
+EDGE_MULTIPLE = 128
+
+
+def quantize_plan_shapes(real_nodes, real_edges,
+                         node_multiple: int = NODE_MULTIPLE,
+                         edge_multiple: int = EDGE_MULTIPLE
+                         ) -> Tuple[int, int]:
+    """``(part_nodes, part_edges)`` — the padded per-part shapes a
+    plan over these per-part real counts compiles to.  This is THE
+    quantized program-shape derivation: :func:`plan_from_bounds` (the
+    splitter), the rebalance path, and the program-space auditor
+    (``analysis/programspace.py``) all call it, so the shapes the
+    trainer actually builds and the shapes the auditor statically
+    enumerates can never disagree.
+
+    Includes the full-part padding-edge correction: a part whose real
+    rows exactly fill ``part_nodes`` while carrying padding edges
+    would absorb dummy-source edges into its last REAL row (the
+    sectioned/bdense planners then see out-of-range gathered
+    coordinates), so one extra row-multiple is added whenever that
+    configuration occurs."""
+    real_nodes = np.asarray(real_nodes, dtype=np.int64)
+    real_edges = np.asarray(real_edges, dtype=np.int64)
+    part_nodes = _round_up(max(int(real_nodes.max()), 1), node_multiple)
+    part_edges = _round_up(max(int(real_edges.max()), 1), edge_multiple)
+    if any(int(real_nodes[p]) == part_nodes
+           and int(real_edges[p]) < part_edges
+           for p in range(real_nodes.shape[0])):
+        part_nodes += node_multiple
+    return part_nodes, part_edges
+
+
 @dataclass
 class PartitionPlan:
     """Partition metadata computable from ``row_ptr`` alone — O(V), no
@@ -111,8 +149,8 @@ class PartitionPlan:
     # repartition (core/costmodel.py + DistributedTrainer rebalance)
     # re-quantizes to the SAME multiples and repeat shapes hit the
     # compile cache
-    node_multiple: int = 8
-    edge_multiple: int = 128
+    node_multiple: int = NODE_MULTIPLE
+    edge_multiple: int = EDGE_MULTIPLE
 
     @property
     def padded_num_nodes(self) -> int:
@@ -191,8 +229,8 @@ def padded_edge_list(graph: Graph, multiple: int = 1024
 
 def partition_bounds(row_ptr: np.ndarray, num_parts: int,
                      method: str = "greedy",
-                     node_multiple: int = 8,
-                     edge_multiple: int = 128,
+                     node_multiple: int = NODE_MULTIPLE,
+                     edge_multiple: int = EDGE_MULTIPLE,
                      cost_weights=None) -> List[Tuple[int, int]]:
     """Split-point selection — the ONE dispatch between the
     reference's greedy edge sweep (``method='greedy'``) and the
@@ -213,8 +251,8 @@ def partition_bounds(row_ptr: np.ndarray, num_parts: int,
 
 
 def partition_plan(row_ptr: np.ndarray, num_parts: int,
-                   node_multiple: int = 8,
-                   edge_multiple: int = 128,
+                   node_multiple: int = NODE_MULTIPLE,
+                   edge_multiple: int = EDGE_MULTIPLE,
                    method: str = "greedy",
                    cost_weights=None) -> PartitionPlan:
     """Everything about the partitioning derivable from the global row
@@ -232,8 +270,8 @@ def partition_plan(row_ptr: np.ndarray, num_parts: int,
 
 
 def plan_from_bounds(row_ptr: np.ndarray, bounds: List[Tuple[int, int]],
-                     num_parts: int, node_multiple: int = 8,
-                     edge_multiple: int = 128) -> PartitionPlan:
+                     num_parts: int, node_multiple: int = NODE_MULTIPLE,
+                     edge_multiple: int = EDGE_MULTIPLE) -> PartitionPlan:
     """Materialize the plan metadata for explicit ``bounds`` — the
     shared tail of :func:`partition_plan` and the repartitioning path
     (DistributedTrainer.maybe_rebalance hands searched bounds here)."""
@@ -245,21 +283,13 @@ def plan_from_bounds(row_ptr: np.ndarray, bounds: List[Tuple[int, int]],
     real_edges = np.array(
         [int(row_ptr[r + 1] - row_ptr[l]) if r >= l else 0
          for l, r in bounds], dtype=np.int64)
-    part_nodes = _round_up(max(int(real_nodes.max()), 1), node_multiple)
-    part_edges = _round_up(max(int(real_edges.max()), 1), edge_multiple)
-    # Padding edges must attach to a PADDED row: the table builders
-    # (sectioned/bdense — core/ell.clean_part_ptr) exclude them via
-    # the real row extents, and a part whose real rows exactly fill
-    # part_nodes would otherwise absorb dummy-source edges into its
-    # last REAL row, leaking out-of-range gathered coordinates into
-    # the planners.  Latent under the greedy sweep (exact fits were
-    # rare); the cost split's node balancing makes them common — one
-    # extra row-multiple restores the invariant whenever a full part
-    # carries padding edges.
-    if any(int(real_nodes[p]) == part_nodes
-           and int(real_edges[p]) < part_edges
-           for p in range(num_parts)):
-        part_nodes += node_multiple
+    # Padded shapes + the full-part padding-edge correction live in
+    # quantize_plan_shapes — the ONE quantized program-shape
+    # derivation, shared with the rebalance path and the program-space
+    # auditor (analysis/programspace.py).  Latent-bug history of the
+    # correction is documented there.
+    part_nodes, part_edges = quantize_plan_shapes(
+        real_nodes, real_edges, node_multiple, edge_multiple)
 
     node_offset = np.array([l for l, _ in bounds], dtype=np.int32)
     node_offset = np.minimum(node_offset, V)  # empty tail parts
@@ -304,8 +334,8 @@ def partition_col(plan: PartitionPlan, col_slice, p: int) -> np.ndarray:
 
 
 def partition_graph(graph: Graph, num_parts: int,
-                    node_multiple: int = 8,
-                    edge_multiple: int = 128,
+                    node_multiple: int = NODE_MULTIPLE,
+                    edge_multiple: int = EDGE_MULTIPLE,
                     method: str = "greedy",
                     cost_weights=None) -> PartitionedGraph:
     """Partition ``graph`` into ``num_parts`` equal-shaped padded

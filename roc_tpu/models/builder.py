@@ -30,6 +30,7 @@ from jax.ad_checkpoint import checkpoint_name
 import numpy as np
 
 from ..ops import dense
+from ..parallel import PARTS_AXIS
 from ..ops.aggregate import (aggregate, aggregate_ell, aggregate_ell_max,
                              aggregate_ell_sect)
 from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU, AC_MODE_SIGMOID
@@ -145,7 +146,7 @@ class GraphContext:
     # numerics either way; False keeps the strictly sequential hop
     # order for measurement/debug (TrainConfig.ring_overlap)
     ring_overlap: bool = True
-    axis_name: str = "parts"
+    axis_name: str = PARTS_AXIS
 
     def _gathered_with_zero(self, x: jax.Array) -> jax.Array:
         """Halo exchange + the appended dummy zero source row that

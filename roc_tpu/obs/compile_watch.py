@@ -29,6 +29,58 @@ from .events import emit
 from .heartbeat import Heartbeat
 
 
+def leaf_struct(x) -> Tuple[str, Tuple[int, ...], str]:
+    """Structured signature of one flattened argument leaf:
+    ``(dtype, dims, spec)`` — the fields a jit cache key (and the
+    persistent compile cache) actually specializes on.  Sharding spec
+    renders only for NamedSharding (single-device default placements
+    collapse to '-'); non-array leaves collapse to
+    ``('py', (), repr(x))``.  THE one extraction behind both the
+    rendered program key (:func:`program_key_of`, below) and the
+    program-space auditor's dimension-level drift rule
+    (``analysis/programspace.py`` imports this) — a signature change
+    here changes both sides together, so they cannot drift."""
+    aval = getattr(x, "aval", x)
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return ("py", (), repr(x))
+    spec = "-"
+    sh = getattr(x, "sharding", None)
+    if sh is not None and hasattr(sh, "spec"):
+        spec = ",".join("None" if s is None else str(s)
+                        for s in tuple(sh.spec))
+        spec = spec or "-"
+    return (str(dtype), tuple(int(d) for d in shape), spec)
+
+
+def _leaf_sig(x) -> str:
+    """``dtype[d0,d1,...]@spec`` rendering of :func:`leaf_struct`."""
+    dtype, dims, spec = leaf_struct(x)
+    if dtype == "py":
+        return f"py:{spec}"
+    return f"{dtype}[{','.join(str(d) for d in dims)}]@{spec}"
+
+
+def program_key_of(name: str, args,
+                   donate_argnums: Tuple[int, ...] = ()) -> str:
+    """THE canonical compiled-program identity:
+    ``slot|leaf sigs|donate=...``.  Computed by :class:`ObservedJit`
+    at first compile (the ``program_key`` field of every ``compile``
+    event) AND by the program-space auditor
+    (``roc_tpu/analysis/programspace.py``) from the abstract avals —
+    the same function on both sides is what makes static-vs-live
+    program-set parity checkable at all.  Donated argnums are part of
+    the key because donation changes the executable's aliasing (two
+    otherwise-identical programs with different donation are distinct
+    compiles)."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(args)
+    sig = ";".join(_leaf_sig(v) for v in leaves)
+    don = ",".join(str(int(i)) for i in donate_argnums)
+    return f"{name}|{sig}|donate={don}"
+
+
 def cost_summary(compiled) -> Dict[str, Optional[float]]:
     """{'flops', 'bytes_accessed'} from ``cost_analysis()`` — which
     returns a list of per-computation dicts on jax<=0.4.x and a flat
@@ -180,6 +232,11 @@ class ObservedJit:
             "lower_s": round(t1 - t0, 3),
             "compile_s": round(t2 - t1, 3),
             "modeled_bytes": self.modeled_bytes,
+            # the canonical program identity — what the program-space
+            # auditor's static enumeration is held against
+            # (analysis/programspace.py parity check)
+            "program_key": program_key_of(self.name, args,
+                                          self.donate_argnums),
         }
         fields.update(cost_summary(compiled))
         fields.update(memory_summary(compiled))

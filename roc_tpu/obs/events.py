@@ -45,9 +45,12 @@ from typing import Any, Dict, List, Optional
 #   costmodel partition cost-model telemetry (core/costmodel.py):
 #             split imbalance records, ridge observations, epoch-
 #             boundary repartition decisions
+#   programspace  compile-budget reports from the program-space
+#             auditor (analysis/programspace.py): per-config program
+#             counts, modeled compile cost, budget deltas
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
-              "costmodel")
+              "costmodel", "programspace")
 
 
 def _jsonable(v: Any) -> Any:

@@ -1,0 +1,17 @@
+"""Multi-device execution: mesh construction and the distributed
+trainer (``distributed.py``), ring halo exchange (``ring.py``),
+multi-host bring-up (``multihost.py``).
+
+This ``__init__`` must stay import-light: it holds only the constants
+every submodule (and ``models/builder.py``) needs without a cycle.
+"""
+
+# THE name of the partition mesh axis.  Every collective in the step
+# bodies reduces/gathers/permutes over this axis and the SPMD
+# collective verifier (analysis/collective_lint.py) checks the traced
+# eqns' axis names against the mesh built from it — a typo'd axis
+# name is a trace-time error single-process but a hang on a real
+# multi-host mesh, so the name lives in ONE place (here, where
+# ring.py / multihost.py / models/builder.py can all import it
+# cycle-free; distributed.py re-exports it).
+PARTS_AXIS = "parts"

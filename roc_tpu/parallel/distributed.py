@@ -50,6 +50,15 @@ from ..train.trainer import (TrainConfig, cast_floats, compute_dtype_of,
                              remat_policy, resolve_symmetric)
 
 
+# THE name of the partition mesh axis — defined in parallel/__init__
+# (the cycle-free home ring.py / multihost.py / models/builder.py can
+# also import) and re-exported here because every collective in the
+# step bodies below reduces/gathers/permutes over it and the SPMD
+# collective verifier (analysis/collective_lint.py) checks the traced
+# eqns' axis names against the mesh built here.
+from . import PARTS_AXIS
+
+
 def _shard_map(f, mesh: Mesh, in_specs, out_specs):
     """``jax.shard_map`` across jax versions: the stable API (with
     ``check_vma``) when present, else the ``jax.experimental``
@@ -79,7 +88,7 @@ def make_mesh(num_parts: Optional[int] = None,
         num_parts = len(devices)
     assert len(devices) >= num_parts, (
         f"need {num_parts} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:num_parts]), ("parts",))
+    return Mesh(np.asarray(devices[:num_parts]), (PARTS_AXIS,))
 
 
 def remap_col_to_padded(plan, col: np.ndarray) -> np.ndarray:
@@ -235,7 +244,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     tables (fused-aggregation weight tables / bdense tile scales) for
     models rewritten by ``Model.fuse_norm_aggregate``; without them
     the fused step still runs correctly via in-op scaling."""
-    sh = NamedSharding(mesh, P("parts"))
+    sh = NamedSharding(mesh, P(PARTS_AXIS))
     if put is None:
         put = lambda x: jax.device_put(x, sh)
     ell_idx = ()
@@ -478,36 +487,27 @@ class DistributedTrainer:
                  mesh: Optional[Mesh] = None,
                  data: Optional[ShardedData] = None,
                  pg=None):
-        from ..train.trainer import (apply_memory_autopilot,
-                                     resolve_auto_impl_early,
-                                     resolve_fuse, resolve_partition)
-        model = resolve_fuse(model, config)
-        self.model = model
-        # the shared 'auto' rule incl. the bdense structure probe (the
-        # global dense fraction is the right proxy: per-part plans
-        # tile contiguous local row ranges of the same vertex order).
-        # The gather-table bound uses the GLOBAL node count, the
-        # scatter-carry bound the per-partition output rows
-        # (resolve_auto_impl docstring).  Multi-process runs skip the
-        # probe — every SPMD process must resolve identically.
-        v = dataset.graph.num_nodes
-        config, _ = resolve_auto_impl_early(
-            model, config, dataset.graph,
-            out_rows=-(-v // num_parts),
+        from ..train.trainer import resolve_config, resolve_partition
+        # the ONE resolve pass (train/trainer.py resolve_config):
+        # fuse, the shared 'auto' rule incl. the bdense structure
+        # probe (global dense fraction is the right proxy — per-part
+        # plans tile contiguous local row ranges of the same vertex
+        # order; the gather-table bound uses the GLOBAL node count,
+        # the scatter-carry bound the per-partition output rows),
+        # memory autopilot with the A-budget charged, attention impl
+        # (multi-chip attention at >=20M edges auto-routes to the
+        # uniform flat8 layout — VERDICT r4 weak #3).  Multi-process
+        # runs skip the probe — every SPMD process must resolve
+        # identically.
+        model, config, _ = resolve_config(
+            model, dataset, config, num_parts=num_parts,
             multiprocess=jax.process_count() > 1)
-        config = apply_memory_autopilot(model, dataset, config,
-                                        num_parts=num_parts)
+        self.model = model
         if config.features == "host":
             raise NotImplementedError(
                 "features='host' streaming is single-device only; the "
                 "distributed >HBM mechanism is halo='ring' (the "
                 "autopilot picks it automatically for parts > 1)")
-        from ..train.trainer import resolve_attention_impl
-        # dataset passed: attention models past ATTN_FLAT8_MIN_EDGES
-        # auto-route to the uniform flat8 layout here too —
-        # multi-chip attention at >=20M edges would otherwise re-hit
-        # the per-width-bucket compile wall (VERDICT r4 weak #3)
-        config = resolve_attention_impl(model, config, dataset)
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0
@@ -906,8 +906,8 @@ class DistributedTrainer:
             num_rows=pgr.part_nodes,
             gathered_rows=pgr.num_parts * pgr.part_nodes,
             gather_features=lambda x: lax.all_gather(
-                x, "parts", axis=0, tiled=True),
-            psum=lambda t: lax.psum(t, "parts"),
+                x, PARTS_AXIS, axis=0, tiled=True),
+            psum=lambda t: lax.psum(t, PARTS_AXIS),
             aggr_impl=self.config.aggr_impl,
             chunk=self.config.chunk,
             symmetric=self.symmetric,
@@ -956,7 +956,7 @@ class DistributedTrainer:
 
     def _build_train_step(self):
         mesh = self.mesh
-        spec_p = P("parts")
+        spec_p = P(PARTS_AXIS)
         spec_r = P()
 
         def step(params, opt_state, feats, labels, mask, edge_src,
@@ -969,7 +969,7 @@ class DistributedTrainer:
                 edge_src[0], edge_dst[0], in_degree[0], ell_idx,
                 ell_row_pos, ell_row_id, ring_idx, sect_idx,
                 sect_sub_dst, bd_tabs, fuse_tabs)
-            part_key = jax.random.fold_in(key, lax.axis_index("parts"))
+            part_key = jax.random.fold_in(key, lax.axis_index(PARTS_AXIS))
 
             def local_loss(p):
                 # mixed precision: fp32 master params cast per step;
@@ -985,8 +985,8 @@ class DistributedTrainer:
             local_l, grads = jax.value_and_grad(local_loss)(params)
             # the reference's replica-sum gradient allreduce
             # (optimizer_kernel.cu:88-94) as an ICI psum
-            grads = lax.psum(grads, "parts")
-            loss = lax.psum(local_l, "parts")
+            grads = lax.psum(grads, PARTS_AXIS)
+            loss = lax.psum(local_l, PARTS_AXIS)
             params, opt_state = adam_update(params, grads, opt_state, lr,
                                             self.adam_cfg)
             return params, opt_state, loss
@@ -1017,14 +1017,14 @@ class DistributedTrainer:
 
     def _build_eval_step(self):
         mesh = self.mesh
-        spec_p = P("parts")
+        spec_p = P(PARTS_AXIS)
         spec_r = P()
 
         def step(params, feats, labels, mask, *graph_args):
             logits = self._local_forward(params, feats, *graph_args)
             m = perf_metrics(logits, labels[0], mask[0])
             return jax.tree_util.tree_map(
-                lambda t: lax.psum(t, "parts"), m)
+                lambda t: lax.psum(t, PARTS_AXIS), m)
 
         return _shard_map(
             step, mesh=mesh,
@@ -1094,13 +1094,13 @@ class DistributedTrainer:
 
     def _build_predict_step(self):
         mesh = self.mesh
-        spec_p = P("parts")
+        spec_p = P(PARTS_AXIS)
         spec_r = P()
 
         def step(params, feats, *graph_args):
             logits = self._local_forward(params, feats, *graph_args)
             # replicated [P, part_nodes, C]
-            return lax.all_gather(logits, "parts", axis=0)
+            return lax.all_gather(logits, PARTS_AXIS, axis=0)
 
         return _shard_map(
             step, mesh=mesh,

@@ -37,6 +37,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import PARTS_AXIS
+
 
 def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
@@ -114,7 +116,7 @@ def make_sharded_array(mesh: Mesh, local_parts: List[int],
     ``local_parts[i]``.  On a single process this reduces to a plain
     ``device_put`` of the stacked array.
     """
-    sharding = NamedSharding(mesh, P("parts"))
+    sharding = NamedSharding(mesh, P(PARTS_AXIS))
     devices = mesh.devices.reshape(-1)
     singles = [
         jax.device_put(np.ascontiguousarray(shard), devices[part])

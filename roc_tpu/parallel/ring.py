@@ -35,6 +35,19 @@ import numpy as np
 from jax import lax
 
 from ..core.partition import PartitionedGraph
+from . import PARTS_AXIS
+
+
+def ring_hop_perm(num_shards: int):
+    """THE named hop schedule: one step of the ring rotation as a
+    ``lax.ppermute`` permutation — ``[(i, (i+1) % S)]``, a single
+    cycle covering the full axis.  :func:`ring_aggregate` issues
+    exactly this permutation every hop, and the SPMD collective
+    verifier (``analysis/collective_lint.py``) recovers and checks the
+    traced ``ppermute`` eqns against it: any other shape (a two-cycle,
+    a partial cover) deadlocks or drops shards at P>=2 on real
+    hardware, where no trace-time error exists to catch it."""
+    return [(i, (i + 1) % num_shards) for i in range(num_shards)]
 
 
 @dataclass
@@ -159,7 +172,7 @@ def ring_weight_tables(pg: PartitionedGraph, rt: RingTables,
 
 
 def ring_aggregate(x: jax.Array, ring_src: jax.Array,
-                   ring_dst: jax.Array, axis_name: str = "parts",
+                   ring_dst: jax.Array, axis_name: str = PARTS_AXIS,
                    edge_chunk: int = 1 << 17,
                    weights: Optional[jax.Array] = None,
                    overlap: bool = True) -> jax.Array:
@@ -191,7 +204,7 @@ def ring_aggregate(x: jax.Array, ring_src: jax.Array,
     S, pair_edges = ring_src.shape
     n, F = x.shape
     me = lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % S) for i in range(S)]
+    perm = ring_hop_perm(S)
     C = min(edge_chunk, pair_edges)
     while pair_edges % C:
         C //= 2

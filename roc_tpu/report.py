@@ -211,6 +211,24 @@ def summarize(events: List[Dict[str, Any]],
     _rows("cost model (rebalance decisions)", ["message"],
           [[str(e.get("msg", ""))[:110]] for e in cm], out)
 
+    # program space: the auditor's compile-budget reports (one event
+    # per rig config, cat=programspace) — program count vs the
+    # baselined bound, the static compile-wall tripwire
+    ps = [e for e in events if e.get("cat") == "programspace"
+          and "programs" in e]
+    rows = []
+    for e in ps:
+        b, d = e.get("budget"), e.get("delta")
+        rows.append([
+            str(e.get("config")), str(e.get("programs")),
+            str(e.get("observed_programs", "?")),
+            f"{float(e.get('modeled_compile_ms', 0)) / 1e3:.1f}s",
+            "?" if b is None else str(b),
+            "?" if d is None else f"{d:+d}"])
+    _rows("program space (compile budget)",
+          ["config", "programs", "observed", "modeled_compile",
+           "budget", "delta"], rows, out)
+
     stalls = [e for e in events if e.get("cat") == "stall"]
     by_stage: Dict[str, List[float]] = {}
     for e in stalls:

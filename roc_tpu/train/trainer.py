@@ -321,9 +321,13 @@ def resolve_fuse(model: Model, config: TrainConfig) -> Model:
             f"unknown aggr_fuse {config.aggr_fuse!r}; expected "
             "'auto', 'on', or 'off'")
     fused = model.fuse_norm_aggregate()
-    n = fused.num_fused_aggregates()
-    if n == 0:
-        if config.aggr_fuse == "on":
+    # count NEWLY fused chains: an already-fused model re-entering the
+    # resolve pass (resolve_config is idempotent — the program-space
+    # auditor asserts it) has fused_aggregate ops but nothing left to
+    # rewrite, and must come back as the SAME object with no re-echo
+    n = fused.num_fused_aggregates() - model.num_fused_aggregates()
+    if n <= 0:
+        if config.aggr_fuse == "on" and not model.num_fused_aggregates():
             # an explicit request that changes nothing must say so
             emit("resolve", "aggr_fuse='on': no fusable "
                  "norm->aggregate->norm chain in this model — running "
@@ -353,11 +357,10 @@ def modeled_step_bytes(model: Model, dataset: Dataset,
     Computed for manual configs too: the autopilot only runs under
     ``memory='auto'``, but the modeled-vs-actual delta is evidence on
     every run."""
-    from ..core.memory import estimate_plan_bytes
-    keeps_bdense = (config.aggr_impl == "bdense"
-                    and not model.uses_attention()
-                    and not model.uses_max_aggregation())
-    a_tab = (config.bdense_a_budget or 0) if keeps_bdense else 0
+    from ..core.memory import charged_table_bytes, estimate_plan_bytes
+    a_tab = charged_table_bytes(
+        config.aggr_impl, model.uses_attention(),
+        model.uses_max_aggregation(), config.bdense_a_budget)
     return estimate_plan_bytes(
         dataset.graph.num_nodes, dataset.graph.num_edges,
         model_layer_dims(model), num_parts=num_parts,
@@ -386,23 +389,21 @@ def apply_memory_autopilot(model: Model, dataset: Dataset,
     if config.memory != "auto":
         return config
     import dataclasses
-    from ..core.memory import choose_memory_plan
+    from ..core.memory import charged_table_bytes, choose_memory_plan
     dims = model_layer_dims(model)
-    # bdense keeps an A-table resident next to the model; its worst
-    # case is the planner's device-byte cap.  The trainers resolve
-    # aggr_impl='auto' (incl. the bdense structure probe) BEFORE
-    # calling the autopilot, so a probe-selected bdense is charged
-    # here exactly like an explicit one — the planner and the actual
-    # residency can no longer disagree by up to the A budget (round-5
-    # advisor).  An uncapped budget is unmodelable — the occupancy
-    # echo is the warning there.  Attention/MAX models never keep the
-    # table either: resolve_attention_impl (which runs AFTER the
-    # autopilot, because it must see the chosen halo) rewrites their
-    # impl away from bdense.
-    keeps_bdense = (config.aggr_impl == "bdense"
-                    and not model.uses_attention()
-                    and not model.uses_max_aggregation())
-    a_tab = (config.bdense_a_budget or 0) if keeps_bdense else 0
+    # bdense keeps an A-table resident next to the model; the resolve
+    # pass (resolve_config) runs aggr_impl='auto' (incl. the bdense
+    # structure probe) BEFORE this autopilot, so a probe-selected
+    # bdense is charged exactly like an explicit one — the planner and
+    # the actual residency can no longer disagree by up to the A
+    # budget (round-5 advisor).  Attention/MAX models never keep the
+    # table: resolve_attention_impl (which runs AFTER the autopilot,
+    # because it must see the chosen halo) rewrites their impl away
+    # from bdense.  charged_table_bytes (core/memory.py) is the ONE
+    # home for the charge rule.
+    a_tab = charged_table_bytes(
+        config.aggr_impl, model.uses_attention(),
+        model.uses_max_aggregation(), config.bdense_a_budget)
     plan = choose_memory_plan(
         dataset.graph.num_nodes, dataset.graph.num_edges, dims,
         num_parts=num_parts,
@@ -497,6 +498,39 @@ def resolve_auto_impl_early(model: Model, config: TrainConfig, graph,
         verbose=config.verbose,
         multiprocess=multiprocess)
     return dc_replace(config, aggr_impl=impl), census
+
+
+def resolve_config(model: Model, dataset: Dataset, config: TrainConfig,
+                   num_parts: int = 1, multiprocess: bool = False):
+    """THE config resolve pass — fuse rewrite, ``aggr_impl='auto'``
+    (incl. the bdense structure probe), memory autopilot, attention
+    impl — in the ONE order that makes the memory plan honest: the
+    probe runs first so an auto→bdense outcome re-enters
+    ``choose_memory_plan`` with the A-budget charged
+    (``core/memory.charged_table_bytes``), and the attention rewrite
+    runs last because it must see the chosen halo.  Shared by BOTH
+    trainer constructors and the program-space auditor
+    (``analysis/programspace.py``) so the statically enumerated
+    program space and the programs the trainers actually build can
+    never diverge at the resolve layer.
+
+    Idempotent by construction: a resolved config re-entering this
+    pass is unchanged (fuse finds no new chains on a fused model,
+    ``memory`` is already 'manual', ``aggr_impl`` concrete), so
+    re-resolving yields the identical program-key set — the auditor
+    asserts exactly that (tests/test_programspace.py).
+
+    Returns ``(model, config, bd_census)``."""
+    model = resolve_fuse(model, config)
+    out_rows = (-(-dataset.graph.num_nodes // num_parts)
+                if num_parts > 1 else None)
+    config, bd_census = resolve_auto_impl_early(
+        model, config, dataset.graph, out_rows=out_rows,
+        multiprocess=multiprocess)
+    config = apply_memory_autopilot(model, dataset, config,
+                                    num_parts=num_parts)
+    config = resolve_attention_impl(model, config, dataset)
+    return model, config, bd_census
 
 
 def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
@@ -681,12 +715,9 @@ class Trainer:
 
     def __init__(self, model: Model, dataset: Dataset,
                  config: TrainConfig = TrainConfig()):
-        model = resolve_fuse(model, config)
+        model, config, bd_census = resolve_config(model, dataset,
+                                                  config)
         self.model = model
-        config, bd_census = resolve_auto_impl_early(
-            model, config, dataset.graph)
-        config = apply_memory_autopilot(model, dataset, config)
-        config = resolve_attention_impl(model, config, dataset)
         self.config = config
         self.compute = compute_dtype_of(config)
         self.epoch = 0

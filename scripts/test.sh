@@ -8,7 +8,11 @@ set -e
 LR=$1; WD=$2; DR=$3; DROP=$4; LAYERS=$5; EPOCHS=$6
 shift 6 || true
 # pre-flight static analysis (roc-lint): regressions against the
-# perf invariants fail HERE, before any chip time is spent
+# perf invariants fail HERE, before any chip time is spent.  The run
+# also prints the program-space compile-budget delta vs
+# scripts/lint_baseline.json (shrink-only ratchet, red on a tty when
+# it grew) — a PR that adds a compiled-program shape shows it before
+# the test tier starts.
 python -m roc_tpu.analysis --strict
 exec python -m roc_tpu.train.cli \
     -lr "$LR" -decay "$WD" -decay-rate "$DR" -dropout "$DROP" \
