@@ -433,6 +433,53 @@ def _probe_phase(progress: list) -> str:
     return re.sub(r"[0-9.]+", "N", txt)
 
 
+# ------------------------------------------- program-space preflight
+
+def _programspace_preflight(timeout: float = 240.0):
+    """Diff the auditor's CURRENT program-key sets against the cached
+    warm state (``benchmarks/programspace_warm.json``, written by
+    ``python -m roc_tpu.prewarm``).  Returns None when there is no
+    cached warm state (nothing to guard), an empty dict when every
+    warmed config's program set is unchanged (the persistent cache is
+    still hot), or ``{config: n_new_keys}`` when a config's program
+    set GREW — a probe on such a config would pay first-compile cost
+    for every new program, exactly the blank-timeout class (r01-r05)
+    this preflight refuses to re-enter.  The enumeration runs in a
+    CPU child (``python -m roc_tpu.analysis --json`` forces the CPU
+    rig itself); any preflight failure degrades to 'no guard' — the
+    probe must never be blocked by a broken preflight."""
+    # ONE path resolution + loader (utils/prewarm.py — jax-free at
+    # import), shared with the prewarm writer so reader and writer
+    # cannot drift; _ART_DIR honors the same ROC_TPU_BENCH_ARTIFACTS
+    from roc_tpu.utils.prewarm import WARM_STATE_NAME, load_warm_state
+    state = load_warm_state(os.path.join(_ART_DIR, WARM_STATE_NAME))
+    if not state:
+        return None
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "roc_tpu.analysis", "--json",
+             "--select", "compile-explosion,cache-key-drift"],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=_HERE)
+        payload = json.loads(r.stdout)
+    except Exception as e:  # noqa: BLE001 - preflight is best-effort
+        print(f"# programspace preflight unavailable: {_errstr(e)}",
+              file=sys.stderr)
+        return None
+    grown = {}
+    for rep in payload.get("program_space", []):
+        cfg = rep.get("config")
+        warmed = state.get(cfg)
+        if not warmed:
+            continue
+        new = set(rep.get("keys", [])) - set(warmed.get("keys", []))
+        if new:
+            grown[cfg] = len(new)
+    return grown
+
+
 # -------------------------------------------------- relay health check
 
 def _relay_health(port: int = None, timeout: float = 2.0) -> dict:
@@ -733,9 +780,11 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
         # actually ran, not the CLI alias.  AFTER the claim above:
         # sectioned_bounds consults the backend's device_kind, and the
         # backend claim must stay the explicitly timed step (wedge
-        # diagnosis reads that number)
+        # diagnosis reads that number).  num_edges arms the flat_sum
+        # compile-wall route past the sectioned window (core/ell.py
+        # FLAT_SUM_MIN_EDGES).
         from roc_tpu.core.ell import resolve_auto_impl
-        args.impl = resolve_auto_impl(nodes)
+        args.impl = resolve_auto_impl(nodes, num_edges=edges)
 
     t0 = time.time()
     graph = random_csr(nodes, edges, seed=0)
@@ -767,6 +816,21 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
                       symmetric=True)
     t0 = time.time()
     trainer = Trainer(model, ds, cfg)
+    # pre-warm BEFORE the timed phase: AOT-compile the trainer's whole
+    # program set against the persistent cache (run_child enabled it
+    # at min_compile_secs=0) and RECORD warm-vs-cold — the compile
+    # wall becomes a tracked metric instead of a blank timeout (the
+    # r01-r05 probe deaths were all first-compile stalls)
+    from roc_tpu.utils.prewarm import warm_trainer
+    try:
+        warm = warm_trainer(trainer, name=f"bench:{nodes}")
+        print(f"# prewarm: {warm.get('compile_warm_hits')} warm / "
+              f"{warm.get('compile_cold')} cold in "
+              f"{warm.get('prewarm_s')}s", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - warming is best-effort
+        warm = {"error": _errstr(e)}
+        print(f"# prewarm failed (continuing cold): {warm['error']}",
+              file=sys.stderr)
     trainer.train(epochs=2)  # compile lap (barriered in the loop) + 1
     trainer.sync()
     compile_s = time.time() - t0
@@ -793,8 +857,15 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
             "layers": args.layers, "impl": args.impl,
             "dtype": args.dtype, "epochs_timed": args.epochs,
             # compile_s includes persistent-cache hits (near-zero on
-            # repeat runs) — epoch_ms is the comparable metric
+            # repeat runs) — epoch_ms is the comparable metric.
+            # compile_warm_hits/compile_cold track the compile wall
+            # itself: a repeat run should be all-warm, and a cold
+            # count on an unchanged config means the program set or
+            # the cache key drifted (analysis/programspace.py).
             "compile_s": round(compile_s, 1),
+            "compile_warm_hits": warm.get("compile_warm_hits"),
+            "compile_cold": warm.get("compile_cold"),
+            "prewarm_s": warm.get("prewarm_s"),
             "epoch_ms": round(epoch_ms, 2),
             "epoch_ms_all": [round(t, 1) for t in times],
             "labels": "synthetic_random",
@@ -805,9 +876,13 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
 def run_child(args) -> None:
     # persistent XLA cache: repeat runs (driver retries, staged
     # protocol, round-over-round) skip the 1-2 min full-scale compile
-    # — directly shrinks the timeout risk the staging exists for
+    # — directly shrinks the timeout risk the staging exists for.
+    # min_compile_secs=0: prewarm is driving (child_gcn warms its
+    # whole program set before the timed phase), so even sub-second
+    # programs must persist — the 1.0 s default silently skipped the
+    # small per-block streamed-head programs.
     from roc_tpu.utils.compile_cache import enable_compile_cache
-    cache_dir = enable_compile_cache()
+    cache_dir = enable_compile_cache(min_compile_secs=0.0)
     if args.stage == "probe":
         # warm-start evidence in the progress artifact: repeat probes
         # hit the persistent cache, so a slow matmul phase on attempt
@@ -957,6 +1032,36 @@ def parent(args, argv) -> int:
                                    f"{[n for n, _, _ in STAGES]}"}))
         return 2
     results: dict = {}
+
+    if not args.cpu and not os.environ.get(
+            "ROC_TPU_BENCH_NO_PREFLIGHT"):
+        # programspace preflight: refuse to burn chip deadline on a
+        # config whose program set GREW since the cached warm state —
+        # every new program is a cold first compile on the chip, the
+        # exact blank-timeout class the staged protocol exists to
+        # avoid.  A dated programspace event + stage record replace
+        # the old silent death; re-running `python -m roc_tpu.prewarm`
+        # (which refreshes the warm state) clears the refusal.
+        grown = _programspace_preflight()
+        if grown:
+            msg = (f"program set grew since cached warm state: "
+                   f"{grown} — run `python -m roc_tpu.prewarm` "
+                   f"before burning chip deadline")
+            from roc_tpu.obs.events import emit as _emit
+            _emit("programspace", msg, grown=grown,
+                  preflight="refused")
+            _append_stage({"stage": "programspace_preflight",
+                           "t": _now_iso(), "ok": False,
+                           "grown": grown, "error": msg})
+            print(f"# {msg}", file=sys.stderr)
+            print(json.dumps({
+                "metric": METRIC_FULL, "value": None, "unit": "ms",
+                "vs_baseline": None, "stage": None,
+                "error": {"programspace_preflight": msg}}))
+            return 1
+        if grown is not None:
+            _append_stage({"stage": "programspace_preflight",
+                           "t": _now_iso(), "ok": True, "grown": {}})
 
     if not args.cpu:
         # the probe must never queue behind this session's own corpses

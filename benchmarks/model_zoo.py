@@ -112,8 +112,11 @@ def run(cfg_key: str, epochs: int, impl: str,
         # attention routing (ell below ATTN_FLAT8_MIN_EDGES, the
         # uniform flat8 layout above it — it needs the dataset, which
         # this early resolution doesn't have)
+        # num_edges arms the flat_sum compile-wall route past the
+        # sectioned window (core/ell.py FLAT_SUM_MIN_EDGES) — the
+        # products-scale zoo configs are exactly its target
         from roc_tpu.core.ell import resolve_auto_impl
-        impl = resolve_auto_impl(c["nodes"])
+        impl = resolve_auto_impl(c["nodes"], num_edges=c["edges"])
     dev = jax.devices()[0]
     print(f"# config {cfg_key}: {c['model']} V={c['nodes']} "
           f"E={c['edges']} on {dev.device_kind}", file=sys.stderr)

@@ -25,5 +25,27 @@ trainers, the 8-virtual-device mesh) and runs every rule;
 
 from .findings import Finding, load_baseline, save_baseline, split_findings
 
-__all__ = ["Finding", "load_baseline", "save_baseline",
-           "split_findings"]
+
+def force_cpu_rig() -> None:
+    """Force THE 8-virtual-device CPU rig the analysis levels, the
+    prewarm CLI, and the prewarm test workers all audit against.
+    jax is typically already imported (roc_tpu/__init__ pulls it in),
+    so the JAX_PLATFORMS env var alone would be latched-and-ignored —
+    the platform goes through jax.config (like tests/conftest.py);
+    XLA_FLAGS is still read at CPU-client init, so the virtual-device
+    append works as long as this runs before the first device use.
+    ONE implementation: a copy missing the device-count flag is how
+    the parts=2 rig got silently skipped-and-never-warmed."""
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"   # children / consistency
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+__all__ = ["Finding", "force_cpu_rig", "load_baseline",
+           "save_baseline", "split_findings"]

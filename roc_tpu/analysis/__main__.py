@@ -82,20 +82,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # the trace stage runs on the 8-virtual-device CPU rig,
         # unconditionally: the baseline fingerprints are CPU-rig
         # artifacts, and a TPU-host invocation must not spend chip
-        # time (or drift the HLO) on a lint pass.  jax is ALREADY
-        # imported by the time -m reaches here (roc_tpu/__init__
-        # pulls it in), so the env var alone is latched-and-ignored —
-        # force the platform through jax.config like tests/conftest.py
-        # does; XLA_FLAGS is still read at CPU-client init, so the
-        # virtual-device count append works.
-        os.environ["JAX_PLATFORMS"] = "cpu"   # children / consistency
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + " --xla_force_host_platform_device_count=8"
-            ).strip()
-        import jax
-        jax.config.update("jax_platforms", "cpu")
+        # time (or drift the HLO) on a lint pass
+        from . import force_cpu_rig
+        force_cpu_rig()
 
     from .driver import all_rule_names, analyze
     from .findings import (load_baseline, shrink_baseline,

@@ -83,7 +83,10 @@ class StdoutPrintRule(AstRule):
            "through roc_tpu.obs.events.emit (or file=sys.stderr for "
            "pre-bus error paths)")
     ALLOW_FILES = {"roc_tpu/obs/events.py", "roc_tpu/report.py",
-                   "roc_tpu/analysis/__main__.py"}
+                   "roc_tpu/analysis/__main__.py",
+                   # the prewarm CLI's stdout IS its product (one
+                   # machine-readable JSON report line per config)
+                   "roc_tpu/prewarm.py"}
 
     def select(self, relpath: str) -> bool:
         return relpath not in self.ALLOW_FILES

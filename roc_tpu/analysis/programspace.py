@@ -150,15 +150,17 @@ def _rig_specs() -> Dict[str, RigSpec]:
     from ..train.trainer import TrainConfig
 
     return {
-        # GIN through the width-8 flat sectioned layout on a 2-device
-        # mesh: the sum-path analog of the flat8 compile-size fix, and
+        # GIN through the uniform width-8 FLAT-SUM layout on a
+        # 2-device mesh: the sum-path uniform-scan consolidation
+        # (ops/aggregate.py aggregate_flat_sum — ONE scan program per
+        # aggregation width instead of one per degree bucket), and
         # the quantized-partition-shape config (the PR-5 splitter's
         # node/edge multiples are load-bearing in these program keys)
         "gin_flat8": RigSpec(
             name="gin_flat8",
             model=lambda: build_gin([_F, _H, _C], dropout_rate=0.5),
             config=lambda: TrainConfig(
-                verbose=False, symmetric=True, aggr_impl="sectioned",
+                verbose=False, symmetric=True, aggr_impl="flat_sum",
                 dtype=jnp.float32, compute_dtype=jnp.bfloat16),
             parts=2),
         # SGC with host-streamed features: the config whose program
@@ -249,76 +251,106 @@ def _assert_resolve_idempotent(spec: RigSpec, dataset) -> None:
             f"for rig {spec.name!r}")
 
 
+@dataclass
+class Candidate:
+    """One candidate compiled program of a trainer's lifecycle: the
+    traceable callable + args the auditor abstract-evals to a program
+    key, PLUS the zero-arg AOT compile closure (``aot``) the cache
+    prewarm driver executes (utils/prewarm.py) — one extraction, two
+    consumers, so the enumerated set and the warmed set can never
+    drift.  ``aot`` goes through the SAME jitted callable a live run
+    compiles (``jit.lower(*args).compile()``), so the persistent-cache
+    entry it writes is exactly the one the live process will hit."""
+
+    slot: str
+    fn: Any
+    args: tuple
+    donate: Tuple[int, ...] = ()
+    observed: bool = True
+    aot: Optional[Callable[[], Any]] = None
+
+
+def candidate_programs(tr) -> List["Candidate"]:
+    """The exact candidate-program list of a trainer's
+    train+eval+predict lifecycle (``run_epoch_loop`` + ``predict()``
+    — note predict compiles NOTHING of its own since it reuses the
+    eval program's logits output; the multi-process-only
+    ``dist_predict_gather`` is out of scope for single-controller
+    rigs).  Works on any built trainer — the audited rigs AND live
+    bench trainers (utils/prewarm.warm_trainer)."""
+    import jax
+    import jax.numpy as jnp
+
+    lr = jnp.asarray(0.01, jnp.float32)
+    cands: List[Candidate] = []
+
+    def add(slot, jitfn, args, donate=(), observed=True):
+        cands.append(Candidate(
+            slot=slot, fn=jitfn, args=args, donate=donate,
+            observed=observed,
+            aot=lambda j=jitfn, a=args: j.lower(*a).compile()))
+
+    if getattr(tr, "pg", None) is not None:       # distributed
+        d = tr.data
+        fuse = (d.ell_w, d.sect_w, d.ring_w, d.bd_scale)
+        graph_args = (d.edge_src, d.edge_dst, d.in_degree, d.ell_idx,
+                      d.ell_row_pos, d.ell_row_id, d.ring_idx,
+                      d.sect_idx, d.sect_sub_dst, d.bd_tabs, fuse)
+        add("dist_train_step", tr._train_step._jit,
+            (tr.params, tr.opt_state, d.feats, d.labels, d.mask)
+            + graph_args + (tr.key, lr), donate=(0, 1))
+        add("dist_eval_step", tr._eval_step._jit,
+            (tr.params, d.feats, d.labels, d.mask) + graph_args)
+    elif tr._head is None:                        # plain single-device
+        add("train_step", tr._train_step._jit,
+            (tr.params, tr.opt_state, tr.key, lr, tr.feats,
+             tr.labels, tr.mask, tr.gctx), donate=(0, 1))
+        add("eval_step", tr._eval_step._jit,
+            (tr.params, tr.feats, tr.labels, tr.mask, tr.gctx))
+    else:                                         # streamed head
+        # abstract stand-ins, never materialized: [V, H] at the >HBM
+        # tier is multi-GB, and warm_trainer runs this on LIVE bench
+        # trainers whose aot closures would otherwise pin the buffers
+        # alive for the whole warm loop.  leaf_struct renders a
+        # ShapeDtypeStruct identically to a default-placed array
+        # (spec '-'), and both make_jaxpr and jit.lower accept them,
+        # so keys and prewarmed executables are unchanged.
+        w0 = tr.params[tr._head_param]
+        y = jax.ShapeDtypeStruct(
+            (tr.feats_host.shape[0], int(w0.shape[1])),
+            jnp.dtype(tr.compute))
+        grads = jax.tree_util.tree_map(
+            lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype),
+            tr.params)
+        add("tail_grad", tr._tail_grad._jit,
+            (tr.params, y, tr.key, tr.labels, tr.mask, tr.gctx),
+            donate=(1,))
+        add("tail_eval", tr._tail_eval._jit,
+            (tr.params, y, tr.labels, tr.mask, tr.gctx))
+        add("apply_update", tr._apply_update._jit,
+            (tr.params, tr.opt_state, grads, lr),
+            donate=(0, 1, 2))
+        cands.extend(_head_block_candidates(tr, y))
+    return cands
+
+
 def enumerate_programs(spec: RigSpec, dataset=None,
                        trainer=None) -> ProgramSpace:
     """The exact set of distinct programs a train+eval+predict
     lifecycle of ``spec`` compiles — the audited lifecycle is the one
     ``run_epoch_loop`` + ``predict()`` executes, which is also what
     the parity test drives live."""
-    import jax
-    import jax.numpy as jnp
-
     ds = dataset if dataset is not None else build_rig_dataset()
     _assert_resolve_idempotent(spec, ds)
     tr = trainer if trainer is not None else build_rig_trainer(
         spec, ds)
-    lr = jnp.asarray(0.01, jnp.float32)
-    entries: List[ProgramEntry] = []
+    entries = [_entry(c.slot, c.fn, c.args, c.donate, c.observed)
+               for c in candidate_programs(tr)]
     # single-device rigs build no partition plan; the drift rule
     # still snaps against the SAME default grid the splitter uses
     nm, em = NODE_MULTIPLE, EDGE_MULTIPLE
     if spec.parts > 1:
-        d = tr.data
-        fuse = (d.ell_w, d.sect_w, d.ring_w, d.bd_scale)
-        graph_args = (d.edge_src, d.edge_dst, d.in_degree, d.ell_idx,
-                      d.ell_row_pos, d.ell_row_id, d.ring_idx,
-                      d.sect_idx, d.sect_sub_dst, d.bd_tabs, fuse)
-        entries.append(_entry(
-            "dist_train_step", tr._train_step._jit,
-            (tr.params, tr.opt_state, d.feats, d.labels, d.mask)
-            + graph_args + (tr.key, lr), donate=(0, 1)))
-        entries.append(_entry(
-            "dist_eval_step", tr._eval_step._jit,
-            (tr.params, d.feats, d.labels, d.mask) + graph_args))
-        entries.append(_entry(
-            "dist_predict_step", tr._build_predict_step(),
-            (tr.params, d.feats) + graph_args))
         nm, em = tr.pg.node_multiple, tr.pg.edge_multiple
-    elif tr._head is None:
-        entries.append(_entry(
-            "train_step", tr._train_step._jit,
-            (tr.params, tr.opt_state, tr.key, lr, tr.feats,
-             tr.labels, tr.mask, tr.gctx), donate=(0, 1)))
-        entries.append(_entry(
-            "eval_step", tr._eval_step._jit,
-            (tr.params, tr.feats, tr.labels, tr.mask, tr.gctx)))
-        entries.append(_entry(
-            "predict_step", tr._predict_step._jit,
-            (tr.params, tr.feats, tr.gctx)))
-    else:
-        from ..train.trainer import cast_floats
-        w0 = tr.params[tr._head_param]
-        y = jnp.zeros((ds.graph.num_nodes, int(w0.shape[1])),
-                      tr.compute)
-        grads = jax.tree_util.tree_map(jnp.zeros_like, tr.params)
-        entries.append(_entry(
-            "tail_grad", tr._tail_grad._jit,
-            (tr.params, y, tr.key, tr.labels, tr.mask, tr.gctx),
-            donate=(1,)))
-        entries.append(_entry(
-            "tail_eval", tr._tail_eval._jit,
-            (tr.params, y, tr.labels, tr.mask, tr.gctx)))
-        entries.append(_entry(
-            "apply_update", tr._apply_update._jit,
-            (tr.params, tr.opt_state, grads, lr),
-            donate=(0, 1, 2)))
-        entries.append(_entry(
-            "tail_predict",
-            lambda p, yy, g: tr._tail_model.apply(
-                cast_floats(p, tr.compute), yy, g, key=None,
-                train=False),
-            (tr.params, y, tr.gctx)))
-        entries.extend(_head_block_entries(tr, y))
     space = ProgramSpace(
         config=spec.name, entries=entries,
         node_multiple=nm, edge_multiple=em,
@@ -332,42 +364,52 @@ def enumerate_programs(spec: RigSpec, dataset=None,
     return space
 
 
-def _head_block_entries(tr, y) -> List[ProgramEntry]:
+def _head_block_candidates(tr, y) -> List["Candidate"]:
     """The streamed head's per-block jit variants — one program per
     distinct (block rows, train/eval statics) pair: uniform blocks
     share one compile, a ragged tail block adds one, and the forward
     compiles separately for the train (dropout-keyed) and eval paths.
     These are module-level ``jax.jit``s, not ObservedJit slots, so
-    they appear in the budget with ``observed=False``."""
+    they appear in the budget with ``observed=False``.  Their ``aot``
+    closures lower the REAL jitted block fns (statics passed
+    positionally, the dynamic ``lo`` offset as a traced arg exactly
+    like the live call) so the prewarmed executables byte-match the
+    live ones in the persistent cache."""
     import jax
     import jax.numpy as jnp
 
     from ..core.streaming import _head_fwd_block, _head_wgrad_block
     w0 = tr.params[tr._head_param].astype(tr.compute)
     rate = tr._head.rate
-    entries: List[ProgramEntry] = []
+    cands: List[Candidate] = []
     # y rows == the audited dataset's node count (NOT the rig
     # constant): enumeration must hold for whatever dataset the
     # trainer was built from
     sizes = sorted({hi - lo
                     for lo, hi in tr._head._blocks(y.shape[0])})
-    dW = jnp.zeros((w0.shape[0], y.shape[1]), jnp.float32)
+    dW = jax.ShapeDtypeStruct((int(w0.shape[0]), int(y.shape[1])),
+                              jnp.dtype(jnp.float32))
     for rows in sizes:
         x = jax.ShapeDtypeStruct((rows, w0.shape[0]),
                                  jnp.dtype(tr.compute))
         for mode, use_mask, key in (("train", True, tr.key),
                                     ("eval", False, None)):
-            entries.append(_entry(
-                f"head_fwd_block:{rows}:{mode}",
-                lambda xx, ww, kk: _head_fwd_block(
-                    xx, ww, rate, kk, use_mask),
-                (x, w0, key), observed=False))
-        entries.append(_entry(
-            f"head_wgrad_block:{rows}",
-            lambda dw, xx, dy, kk: _head_wgrad_block(
-                dw, xx, dy, 0, rows, rate, kk, True),
-            (dW, x, y, tr.key), observed=False))
-    return entries
+            cands.append(Candidate(
+                slot=f"head_fwd_block:{rows}:{mode}",
+                fn=(lambda xx, ww, kk, u=use_mask: _head_fwd_block(
+                    xx, ww, rate, kk, u)),
+                args=(x, w0, key), observed=False,
+                aot=(lambda xx=x, kk=key, u=use_mask:
+                     _head_fwd_block.lower(
+                         xx, w0, rate, kk, u).compile())))
+        cands.append(Candidate(
+            slot=f"head_wgrad_block:{rows}",
+            fn=(lambda dw, xx, dy, kk, r=rows: _head_wgrad_block(
+                dw, xx, dy, 0, r, rate, kk, True)),
+            args=(dW, x, y, tr.key), observed=False,
+            aot=(lambda xx=x, r=rows: _head_wgrad_block.lower(
+                dW, xx, y, 0, r, rate, tr.key, True).compile())))
+    return cands
 
 
 def _check_distinct(space: ProgramSpace) -> None:

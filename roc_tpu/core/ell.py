@@ -373,6 +373,56 @@ class SectionedEll:
             self, idx=tuple(a.astype(dtype) for a in self.idx))
 
 
+# Uniform flat-sum layout (aggregate_flat_sum): chunk granularity of
+# the single global section.  8192 bounds the per-chunk gathered
+# transient [seg, 8, F] at 64 MiB for F=256 fp32 — the same bound the
+# attention flat8 tables use (they are the same layout).
+FLAT_SEG_ROWS = 8192
+
+# Edge count past which the resolve pass routes an 'ell'-bound auto
+# resolution to the uniform 'flat_sum' layout instead: the per-width
+# bucket unroll compiles one gather/scan program per degree bucket
+# (doubled by autodiff and multiplied by layers), which is what pushed
+# products-scale first compiles past 15 min (ROADMAP compile wall);
+# the flat layout compiles ONE scan shape per (dtype, F).  Same
+# threshold as the attention path's ATTN_FLAT8_MIN_EDGES
+# (train/trainer.py) — the two flat routes are the same fix.
+FLAT_SUM_MIN_EDGES = 20_000_000
+
+
+def flat_sum_from_graph(row_ptr: np.ndarray, col_idx: np.ndarray,
+                        num_rows: int, src_rows: int = None,
+                        seg_rows: int = FLAT_SEG_ROWS) -> SectionedEll:
+    """The uniform flat-sum tables: a :class:`SectionedEll` with ONE
+    section spanning all ``src_rows`` sources (ids global, dummy ==
+    ``src_rows``, sub-rows of a row consecutive/ascending) — the
+    layout :func:`roc_tpu.ops.aggregate.aggregate_flat_sum` scans.
+    Shared with the attention flat8 build (train/trainer.py
+    ``make_graph_context``): one builder, two consumers."""
+    if src_rows is None:
+        src_rows = num_rows
+    return sectioned_from_graph(row_ptr, col_idx, num_rows,
+                                src_rows=src_rows,
+                                section_rows=src_rows,
+                                seg_rows=seg_rows)
+
+
+def flat_sum_from_padded_parts(part_row_ptr: np.ndarray,
+                               part_col: np.ndarray,
+                               real_nodes: np.ndarray,
+                               part_nodes: int, src_rows: int,
+                               seg_rows: int = FLAT_SEG_ROWS
+                               ) -> SectionedEll:
+    """Stacked per-part flat-sum tables (``[P, n_chunks, seg_rows, 8]``
+    — SPMD-uniform shapes like every other stacked layout); the
+    distributed twin of :func:`flat_sum_from_graph`, shared by the
+    'flat_sum' and 'attn_flat8' branches of
+    ``parallel/distributed.shard_dataset``."""
+    return sectioned_from_padded_parts(
+        part_row_ptr, part_col, real_nodes, part_nodes,
+        src_rows=src_rows, section_rows=src_rows, seg_rows=seg_rows)
+
+
 SECTION_ROWS_DEFAULT = 65_536   # 64 MiB of fp32 rows at F=256
 # Swept on-chip at Reddit scale (v5e, F=256 bf16, 2026-07-30):
 # section_rows 32768/65536/131072/262144 -> 826/776/808/1747 ms and
@@ -476,23 +526,38 @@ def sectioned_bounds(device_kind: Optional[str] = None
 
 def resolve_auto_impl(num_nodes: int,
                       out_rows: Optional[int] = None,
-                      device_kind: Optional[str] = None) -> str:
+                      device_kind: Optional[str] = None,
+                      num_edges: Optional[int] = None) -> str:
     """The data-driven ``aggr_impl='auto'`` split — ONE place for the
     rule (trainer, distributed, bench, model zoo all call this):
-    ``sectioned`` in its measured winning window, ``ell`` outside.
+    ``sectioned`` in its measured winning window, ``flat_sum`` for
+    ell-bound graphs past :data:`FLAT_SUM_MIN_EDGES` (the compile-wall
+    route: one uniform scan program instead of one program per degree
+    bucket), ``ell`` otherwise.
 
-    The two bounds scale with different sizes: the LOWER bound is the
-    gathered source-table size (global ``num_nodes`` — sectioned's win
-    is VMEM-resident section gathers, and a partition gathers from ALL
-    nodes), while the UPPER bound is the scatter-add carry ``[out_rows,
-    F]`` rewritten every chunk step — per-partition ``out_rows`` in
-    distributed runs (defaults to ``num_nodes`` single-device).  The
-    bounds are generation-keyed (:func:`sectioned_bounds`)."""
+    The two sectioned bounds scale with different sizes: the LOWER
+    bound is the gathered source-table size (global ``num_nodes`` —
+    sectioned's win is VMEM-resident section gathers, and a partition
+    gathers from ALL nodes), while the UPPER bound is the scatter-add
+    carry ``[out_rows, F]`` rewritten every chunk step — per-partition
+    ``out_rows`` in distributed runs (defaults to ``num_nodes``
+    single-device).  The bounds are generation-keyed
+    (:func:`sectioned_bounds`).  ``num_edges=None`` skips the
+    flat_sum route (legacy callers keep the old sectioned/ell
+    split)."""
     if out_rows is None:
         out_rows = num_nodes
     lo, hi = sectioned_bounds(device_kind)
     if num_nodes > lo and out_rows <= hi:
         return "sectioned"
+    if num_edges is not None and num_edges >= FLAT_SUM_MIN_EDGES:
+        # outside sectioned's window the fallback used to be the
+        # per-bucket ELL unroll — at this edge count its compile cost
+        # (one program per width bucket x autodiff x layers) dominates
+        # the first-run wall; the uniform flat layout compiles ONE
+        # scan shape and gathers from the same whole table, so the
+        # runtime is ell-class while the program space is O(1)
+        return "flat_sum"
     return "ell"
 
 

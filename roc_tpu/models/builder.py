@@ -32,7 +32,8 @@ import numpy as np
 from ..ops import dense
 from ..parallel import PARTS_AXIS
 from ..ops.aggregate import (aggregate, aggregate_ell, aggregate_ell_max,
-                             aggregate_ell_sect)
+                             aggregate_ell_sect, aggregate_flat_max,
+                             aggregate_flat_sum)
 from ..ops.dense import AC_MODE_NONE, AC_MODE_RELU, AC_MODE_SIGMOID
 from ..ops.loss import masked_softmax_cross_entropy
 from ..ops.norm import indegree_norm
@@ -111,13 +112,19 @@ class GraphContext:
     sect_idx: Tuple[jax.Array, ...] = ()
     sect_sub_dst: Tuple[jax.Array, ...] = ()
     sect_meta: Tuple[Tuple[int, int], ...] = ()
-    # Uniform width-8 attention layout (aggr_impl == "attn_flat8"):
-    # one [n_chunks, seg_rows, 8] global-id table + [n_chunks,
-    # seg_rows] output rows — the large-graph GAT path whose compile
-    # size is degree-distribution-independent (ops/attention.py
-    # gat_aggregate_flat8)
+    # Uniform width-8 flat layout: one [n_chunks, seg_rows, 8]
+    # global-id table + [n_chunks, seg_rows] output rows, whose
+    # compile size is degree-distribution-independent.  Two consumers:
+    # aggr_impl == "attn_flat8" (large-graph GAT, ops/attention.py
+    # gat_aggregate_flat8) and aggr_impl == "flat_sum" (the sum/max
+    # path's uniform-scan consolidation, ops/aggregate.py
+    # aggregate_flat_sum — ONE scan program instead of one per degree
+    # bucket).  flat8_w carries the baked fused-normalization weights
+    # for the flat_sum form (shape mirrors flat8_idx; None = derive d
+    # from in_degree and pre/post-scale in-op).
     flat8_idx: Optional[jax.Array] = None
     flat8_dst: Optional[jax.Array] = None
+    flat8_w: Optional[jax.Array] = None
     # Block-dense MXU layout (aggr_impl == "bdense"): dense [128,128]
     # adjacency tiles as uint8 multiplicity tables + tile ids, with
     # the residual (scattered) edges in the sect_* sectioned tables
@@ -146,6 +153,16 @@ class GraphContext:
     # numerics either way; False keeps the strictly sequential hop
     # order for measurement/debug (TrainConfig.ring_overlap)
     ring_overlap: bool = True
+    # Chunked output head (TrainConfig.head_chunk, resolved by
+    # train/trainer.resolve_head_chunk): when > 0, the LAST linear
+    # (the classification head) is evaluated as a lax.scan over
+    # head_chunk-row blocks (ops/dense.py linear_chunked) so the
+    # head's compiled matmul shape is [head_chunk, C] — independent of
+    # V_p — instead of the full [V_p, C] width.  0 = the plain
+    # full-width matmul.  Values and dX are bit-identical either way;
+    # dW sums the row axis blockwise (fp32 roundoff-level difference,
+    # ops/dense.py linear_chunked).
+    head_chunk: int = 0
     axis_name: str = PARTS_AXIS
 
     def _gathered_with_zero(self, x: jax.Array) -> jax.Array:
@@ -170,6 +187,9 @@ class GraphContext:
             return aggregate_ell_sect(full, self.sect_idx,
                                       self.sect_sub_dst, self.sect_meta,
                                       self.num_rows)
+        if self.aggr_impl == "flat_sum":
+            return aggregate_flat_sum(full, self.flat8_idx,
+                                      self.flat8_dst, self.num_rows)
         if self.aggr_impl == "bdense":
             from ..ops.blockdense import aggregate_block_dense
             out = None
@@ -256,6 +276,11 @@ class GraphContext:
             return aggregate_ell_sect(full, self.sect_idx,
                                       self.sect_sub_dst, self.sect_meta,
                                       self.num_rows, sect_w=self.sect_w)
+        if self.aggr_impl == "flat_sum" and self.flat8_w is not None:
+            full = self._gathered_with_zero(x)
+            return aggregate_flat_sum(full, self.flat8_idx,
+                                      self.flat8_dst, self.num_rows,
+                                      flat_w=self.flat8_w)
         if self.aggr_impl == "bdense" and self.bd_scale:
             from ..ops.blockdense import aggregate_block_dense
             full = self._gathered_with_zero(x)
@@ -399,7 +424,13 @@ class GraphContext:
         full = jnp.concatenate([full, zero], axis=0)
         dummy = full.shape[0] - 1
         neg = jnp.asarray(-jnp.inf, dtype=full.dtype)
-        if self.aggr_impl in ("ell", "pallas"):
+        if self.aggr_impl == "flat_sum":
+            # the uniform-scan MAX twin (ops/aggregate.py): one scan
+            # program, scatter-max combine — the large-graph MAX path
+            # the resolve pass routes to past FLAT_SUM_MIN_EDGES
+            out = aggregate_flat_max(full, self.flat8_idx,
+                                     self.flat8_dst, self.num_rows)
+        elif self.aggr_impl in ("ell", "pallas"):
             # "pallas" carries the same ELL tables; MAX is a cold path,
             # so the XLA ELL reduction serves both.  aggregate_ell_max
             # row-segments large buckets under the same 64 MiB budget
@@ -429,23 +460,23 @@ class GraphContext:
 def _gctx_flatten(g: GraphContext):
     children = (g.edge_src, g.edge_dst, g.in_degree, g.ell_idx,
                 g.ell_row_pos, g.ring_idx, g.sect_idx, g.sect_sub_dst,
-                g.ell_row_id, g.flat8_idx, g.flat8_dst, g.bd_a,
-                g.bd_src, g.bd_dst, g.ell_w, g.sect_w, g.ring_w,
-                g.bd_scale)
+                g.ell_row_id, g.flat8_idx, g.flat8_dst, g.flat8_w,
+                g.bd_a, g.bd_src, g.bd_dst, g.ell_w, g.sect_w,
+                g.ring_w, g.bd_scale)
     aux = (g.num_rows, g.gathered_rows, g.gather_features, g.psum,
            g.aggr_impl, g.chunk, g.symmetric, g.halo, g.axis_name,
            g.sect_meta, g.bd_vpad, g.bd_src_vpad, g.bd_group,
-           g.ring_overlap)
+           g.ring_overlap, g.head_chunk)
     return children, aux
 
 
 def _gctx_unflatten(aux, children):
     (num_rows, gathered_rows, gather_features, psum, aggr_impl, chunk,
      symmetric, halo, axis_name, sect_meta, bd_vpad, bd_src_vpad,
-     bd_group, ring_overlap) = aux
+     bd_group, ring_overlap, head_chunk) = aux
     (edge_src, edge_dst, in_degree, ell_idx, ell_row_pos, ring_idx,
      sect_idx, sect_sub_dst, ell_row_id, flat8_idx,
-     flat8_dst, bd_a, bd_src, bd_dst, ell_w, sect_w, ring_w,
+     flat8_dst, flat8_w, bd_a, bd_src, bd_dst, ell_w, sect_w, ring_w,
      bd_scale) = children
     return GraphContext(
         edge_src=edge_src, edge_dst=edge_dst, in_degree=in_degree,
@@ -456,9 +487,10 @@ def _gctx_unflatten(aux, children):
         ring_idx=ring_idx, axis_name=axis_name, sect_idx=sect_idx,
         sect_sub_dst=sect_sub_dst, sect_meta=sect_meta,
         ell_row_id=ell_row_id, flat8_idx=flat8_idx,
-        flat8_dst=flat8_dst, bd_a=bd_a, bd_src=bd_src, bd_dst=bd_dst,
-        bd_vpad=bd_vpad, bd_src_vpad=bd_src_vpad, bd_group=bd_group,
-        ring_overlap=ring_overlap,
+        flat8_dst=flat8_dst, flat8_w=flat8_w, bd_a=bd_a, bd_src=bd_src,
+        bd_dst=bd_dst, bd_vpad=bd_vpad, bd_src_vpad=bd_src_vpad,
+        bd_group=bd_group, ring_overlap=ring_overlap,
+        head_chunk=head_chunk,
         ell_w=ell_w, sect_w=sect_w, ring_w=ring_w, bd_scale=bd_scale)
 
 
@@ -839,6 +871,11 @@ class Model:
         vals: List[Optional[jax.Array]] = [None] * len(self._ops)
         vals[0] = feats
         n_dropout = 0
+        # the output head = the LAST linear (the classifier in every
+        # model family; the loss marker may sit on a later norm /
+        # propagation op, e.g. GCN's final indegree_norm)
+        head_idx = max((i for i, op in enumerate(self._ops)
+                        if op.kind == "linear"), default=-1)
         for i, op in enumerate(self._ops[1:], start=1):
             x = vals[op.inputs[0]] if op.inputs else None
             if op.kind == "dropout":
@@ -849,8 +886,20 @@ class Model:
                 n_dropout += 1
                 vals[i] = dense.dropout(x, op.attrs["rate"], sub, train)
             elif op.kind == "linear":
-                vals[i] = dense.linear(x, params[op.param],
-                                       op.attrs["activation"])
+                if gctx.head_chunk and i == head_idx \
+                        and x.shape[0] > gctx.head_chunk:
+                    # the classification head, chunked on the vertex
+                    # axis: the compiled matmul is [head_chunk, C]
+                    # regardless of V_p, so the head subprogram stays
+                    # small and shape-stable (bit-identical values —
+                    # each output row's dot product is unchanged; dW
+                    # differs only in fp summation order)
+                    vals[i] = dense.linear_chunked(
+                        x, params[op.param], op.attrs["activation"],
+                        gctx.head_chunk)
+                else:
+                    vals[i] = dense.linear(x, params[op.param],
+                                           op.attrs["activation"])
             elif op.kind == "indegree_norm":
                 vals[i] = indegree_norm(x, gctx.in_degree)
             elif op.kind == "scatter_gather":

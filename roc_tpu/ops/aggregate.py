@@ -303,6 +303,78 @@ def aggregate_ell_sect_split(feats: jax.Array, sect_idx, sect_sub_dst,
     return out[:num_rows]
 
 
+def aggregate_flat_sum(feats: jax.Array, flat_idx: jax.Array,
+                       flat_dst: jax.Array, num_rows: int,
+                       flat_w=None) -> jax.Array:
+    """Uniform width-8 sub-row SUM — the sum-path twin of the
+    attention layout's ``gat_aggregate_flat8`` (ops/attention.py) and
+    the compile-wall fix for the per-bucket ELL unroll: every row's
+    neighborhood is split into width-8 sub-rows in ONE
+    ``[n_chunks, seg_rows, 8]`` table (core/ell.py
+    ``flat_sum_from_graph`` — a :class:`SectionedEll` with a single
+    section spanning all sources, so ids are global/gathered
+    coordinates), and the aggregation is ONE ``lax.scan`` whose body
+    shape depends only on (dtype, seg_rows, F) — never on the degree
+    distribution.  ``aggregate_ell``'s per-width Python unroll
+    compiles one gather+reduce program per degree bucket (doubled by
+    autodiff); this path compiles exactly one scan program per
+    (dtype, F-quantum), which is what lets the persistent compile
+    cache and the prewarm pass (utils/prewarm.py) cover large graphs.
+
+    feats: [G+1, F] gathered features with trailing zero row (== the
+      dummy id in ``flat_idx``).
+    flat_idx: int32 [n_chunks, seg_rows, 8]; flat_dst: int32
+      [n_chunks, seg_rows] output rows, ascending within each chunk
+      (chunk padding points at ``num_rows``).
+    flat_w (optional): fp32 shaped like ``flat_idx`` — the baked
+      ``D^-1/2 A D^-1/2`` fused-normalization entries
+      (``SectionedEll.weight_tables`` of the single section), applied
+      in-register before the width reduction.
+    """
+    F = feats.shape[1]
+    out = jnp.zeros((num_rows + 1, F), dtype=feats.dtype)
+    xs = (flat_idx, flat_dst)
+    if flat_w is not None:
+        xs += (flat_w.astype(feats.dtype),)
+
+    def body(o, ch):
+        g = feats[ch[0]]
+        if len(ch) > 2:
+            g = g * ch[2][:, :, None]
+        part = g.sum(axis=1)
+        return o.at[ch[1]].add(part, indices_are_sorted=True), None
+
+    out, _ = lax.scan(body, out, xs)
+    return out[:num_rows]
+
+
+def aggregate_flat_max(feats: jax.Array, flat_idx: jax.Array,
+                       flat_dst: jax.Array, num_rows: int) -> jax.Array:
+    """Neighbor MAX over the uniform width-8 layout (MIN via negation
+    at the call site) — one scan program like
+    :func:`aggregate_flat_sum`, with the width reduction a masked max
+    and the per-chunk combine a sorted scatter-max (max is
+    associative, so a row's sub-rows spanning chunks combine
+    exactly).  Dummy/padding sources weigh -inf; rows with no real
+    neighbor yield -inf here and the caller maps non-finite rows to 0
+    (the sum path's empty-row convention, models/builder.py
+    ``_max_fwd``)."""
+    F = feats.shape[1]
+    dummy = feats.shape[0] - 1
+    neg = jnp.asarray(-jnp.inf, dtype=feats.dtype)
+    out = jnp.full((num_rows + 1, F), neg, dtype=feats.dtype)
+
+    def body(o, ch):
+        idx_ch, dst_ch = ch
+        g = feats[idx_ch]
+        m = (idx_ch != dummy)[:, :, None]
+        part = jnp.max(jnp.where(m, g, neg), axis=1)
+        return o.at[dst_ch].max(part, indices_are_sorted=True), None
+
+    out, _ = lax.scan(body, out, (flat_idx, flat_dst))
+    return out[:num_rows]
+
+
 def aggregate_ell_max(feats: jax.Array, ell_idx, ell_row_pos: jax.Array,
                       num_rows: int,
                       budget_elems: int = 1 << 24) -> jax.Array:

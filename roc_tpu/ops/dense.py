@@ -58,6 +58,38 @@ def linear(x: jax.Array, w: jax.Array,
     return _ACTIVATIONS[activation](y)
 
 
+def linear_chunked(x: jax.Array, w: jax.Array,
+                   activation: str = AC_MODE_NONE,
+                   block: int = 65536) -> jax.Array:
+    """:func:`linear` evaluated as a ``lax.scan`` over ``block``-row
+    vertex chunks — the chunked output head (models/builder.py,
+    ``TrainConfig.head_chunk``).  The compiled matmul body is
+    ``[block, in] @ [in, out]`` regardless of ``V``, so the
+    classification head stops compiling at full ``[V_p, C]`` width
+    into the step and its program is small and shape-stable; the
+    ``block`` default matches the streamed head's 65536-row staging
+    blocks (core/streaming.py StreamedHead), whose machinery this is
+    the in-jit twin of.  Values and input gradients are bit-identical
+    to :func:`linear`: each output row's dot product (and each dX
+    row's) reads the full ``in`` axis either way, and padding rows
+    are sliced back off.  The weight gradient dW sums the row axis
+    blockwise across scan iterations — a different (equally valid)
+    fp reduction order than the one-matmul reference, so dW matches
+    to fp32 roundoff (~1e-7 relative), not bit-for-bit."""
+    V, in_dim = x.shape
+    n = -(-V // block)
+    if n <= 1:
+        return linear(x, w, activation)
+    vp = n * block
+    xp = jnp.pad(x, ((0, vp - V), (0, 0))) if vp != V else x
+
+    def body(_, xb):
+        return None, linear(xb, w, activation)
+
+    _, yb = jax.lax.scan(body, None, xp.reshape(n, block, in_dim))
+    return yb.reshape(vp, -1)[:V]
+
+
 def activation(x: jax.Array, mode: str) -> jax.Array:
     return _ACTIVATIONS[mode](x)
 

@@ -287,7 +287,7 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
     else:
         col_padded = remap_to_padded(pg)
         if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
-                         "bdense"):
+                         "flat_sum", "bdense"):
             # table-driven paths never read the flat edge arrays —
             # upload stubs instead of two [P, E_p] tensors
             edge_dst = np.zeros((pg.num_parts, 1), dtype=np.int32)
@@ -406,22 +406,29 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
                     res_ptrs, res_cols, pg, src_rows=src_rows,
                     section_rows=section_rows, sect_sub_w=sect_sub_w,
                     sect_u16=sect_u16, put=put, fuse_d=fuse_d)
-        elif aggr_impl == "attn_flat8":
-            # large-graph attention, sharded: per-partition SINGLE-
+        elif aggr_impl in ("attn_flat8", "flat_sum"):
+            # the uniform flat layout, sharded: per-partition SINGLE-
             # section tables over gathered coordinates (one uniform
             # scan shape per device — the same compile-size fix as the
             # single-chip path, train/trainer.py make_graph_context).
-            # seg_rows 8192 bounds the per-chunk transient like there.
-            from ..core.ell import sectioned_from_padded_parts
+            # The flat tables ride the sect_* slots (ShardedData
+            # docstring); the step body routes them to the
+            # GraphContext flat8 fields.  FLAT_SEG_ROWS bounds the
+            # per-chunk transient like there.  For the fused flat_sum
+            # path the baked D^-1/2 weight tables ride the sect_w slot
+            # the same way.
+            from ..core.ell import flat_sum_from_padded_parts
             src_rows = pg.num_parts * pg.part_nodes
-            sect = sectioned_from_padded_parts(
+            sect = flat_sum_from_padded_parts(
                 pg.part_row_ptr, col_padded, pg.real_nodes,
-                pg.part_nodes, src_rows=src_rows,
-                section_rows=src_rows, seg_rows=8192)
+                pg.part_nodes, src_rows=src_rows)
             sect_idx = tuple(put(a) for a in sect.idx)
             sect_sub_dst = tuple(put(a) for a in sect.sub_dst)
+            if aggr_impl == "flat_sum" and fuse_d is not None:
+                sect_w = tuple(put(w) for w in sect.weight_tables(
+                    fuse_d[0], fuse_d[1]))
         if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
-                         "bdense"):
+                         "flat_sum", "bdense"):
             col_padded = np.zeros((pg.num_parts, 1), dtype=np.int32)
     return ShardedData(
         feats=put(pad_nodes(dataset.features, pg).astype(dtype)),
@@ -584,14 +591,15 @@ class DistributedTrainer:
                     "memory/halo explicitly)")
             if config.halo != "ring":
                 if config.aggr_impl in ("sectioned", "attn_flat8",
-                                        "bdense") \
+                                        "flat_sum", "bdense") \
                         and not self.data.sect_idx:
                     raise ValueError(
-                        f"injected data has no sectioned/flat8 tables "
+                        f"injected data has no sectioned/flat tables "
                         f"but the resolved aggr_impl is "
                         f"{config.aggr_impl!r} — build it with the "
-                        f"same aggr_impl (note: attention models at "
-                        f">=20M edges auto-route to 'attn_flat8')")
+                        f"same aggr_impl (note: attention/sum models "
+                        f"at >=20M edges auto-route to the flat "
+                        f"layouts)")
                 if config.aggr_impl in ("sectioned", "bdense") \
                         and self.data.sect_idx \
                         and not self.data.sect_meta:
@@ -735,10 +743,18 @@ class DistributedTrainer:
                           donate_argnums=(0, 1)),
             name="dist_train_step", donate_argnums=(0, 1),
             modeled_bytes=self._modeled_bytes, verbose=config.verbose)
+        # eval and predict share ONE compiled program: the eval step
+        # returns (replicated metrics, SHARDED per-part logits) — the
+        # logits already exist inside the step, so the extra output is
+        # one [part_nodes, C] device buffer per eval, no collective,
+        # and the program space loses a whole compiled program per
+        # config (ISSUE 7).  evaluate() fetches only the metrics.
         self._eval_step = ObservedJit(
             jitfn=jax.jit(self._build_eval_step()),
             name="dist_eval_step", verbose=config.verbose)
-        self._predict_step = None   # built lazily on first predict()
+        # multi-process predict needs the sharded logits replicated
+        # before the host fetch; built lazily, never on rigs/tests
+        self._predict_gather = None
 
     def _emit_partition_stats(self) -> dict:
         """Compute + emit the split-quality record for the CURRENT
@@ -900,8 +916,10 @@ class DistributedTrainer:
 
     def _gctx(self) -> GraphContext:
         """GraphContext for *inside* the shard_map body (local blocks)."""
+        from ..train.trainer import resolve_head_chunk
         pgr = self.pg
         return GraphContext(
+            head_chunk=resolve_head_chunk(self.config, pgr.part_nodes),
             edge_src=None, edge_dst=None, in_degree=None,  # filled per-call
             num_rows=pgr.part_nodes,
             gathered_rows=pgr.num_parts * pgr.part_nodes,
@@ -926,13 +944,15 @@ class DistributedTrainer:
                     sect_sub_dst, bd_tabs=(),
                     fuse_tabs=((), (), (), ())) -> GraphContext:
         """Local-block GraphContext for a shard_map body: slice the
-        parts axis off every table.  attn_flat8 carries its single-
-        section tables in the sect slots (ShardedData docstring) and
-        routes them to the flat8 fields the builder reads; bdense
-        carries its residual there and its dense tiles in bd_tabs.
-        ``fuse_tabs`` = (ell_w, sect_w, ring_w, bd_scale) — the baked
-        fused-normalization weights (empty tuples when unfused)."""
-        flat8 = self.config.aggr_impl == "attn_flat8"
+        parts axis off every table.  attn_flat8 and flat_sum carry
+        their single-section uniform tables in the sect slots
+        (ShardedData docstring) and route them to the flat8 fields
+        the builder reads (flat_sum's baked weight tables ride the
+        sect_w slot -> flat8_w); bdense carries its residual there
+        and its dense tiles in bd_tabs.  ``fuse_tabs`` = (ell_w,
+        sect_w, ring_w, bd_scale) — the baked fused-normalization
+        weights (empty tuples when unfused)."""
+        flat = self.config.aggr_impl in ("attn_flat8", "flat_sum")
         ell_w, sect_w, ring_w, bd_scale = fuse_tabs
         return dc_replace(
             self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
@@ -941,16 +961,22 @@ class DistributedTrainer:
             ell_row_pos=ell_row_pos[0],
             ell_row_id=tuple(a[0] for a in ell_row_id),
             ring_idx=tuple(a[0] for a in ring_idx),
-            sect_idx=() if flat8 else tuple(a[0] for a in sect_idx),
-            sect_sub_dst=(() if flat8
+            sect_idx=() if flat else tuple(a[0] for a in sect_idx),
+            sect_sub_dst=(() if flat
                           else tuple(a[0] for a in sect_sub_dst)),
-            flat8_idx=sect_idx[0][0] if flat8 else None,
-            flat8_dst=sect_sub_dst[0][0] if flat8 else None,
+            # halo='ring' uploads empty sect stubs (the ring tables
+            # fully describe the aggregation) — the flat8 fields must
+            # stay None so the builder routes to ring_aggregate
+            flat8_idx=sect_idx[0][0] if flat and sect_idx else None,
+            flat8_dst=(sect_sub_dst[0][0]
+                       if flat and sect_sub_dst else None),
+            flat8_w=(sect_w[0][0]
+                     if flat and sect_w else None),
             bd_a=bd_tabs[0][0] if bd_tabs else None,
             bd_src=bd_tabs[1][0] if bd_tabs else None,
             bd_dst=bd_tabs[2][0] if bd_tabs else None,
             ell_w=tuple(a[0] for a in ell_w),
-            sect_w=tuple(a[0] for a in sect_w),
+            sect_w=() if flat else tuple(a[0] for a in sect_w),
             ring_w=ring_w[0][0] if ring_w else None,
             bd_scale=tuple(a[0] for a in bd_scale))
 
@@ -1023,15 +1049,18 @@ class DistributedTrainer:
         def step(params, feats, labels, mask, *graph_args):
             logits = self._local_forward(params, feats, *graph_args)
             m = perf_metrics(logits, labels[0], mask[0])
+            # (replicated metrics, sharded logits): predict() reuses
+            # this program's logits output — no second compile, no
+            # collective added to the eval path
             return jax.tree_util.tree_map(
-                lambda t: lax.psum(t, PARTS_AXIS), m)
+                lambda t: lax.psum(t, PARTS_AXIS), m), logits
 
         return _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p),
-            out_specs=spec_r)
+            out_specs=(spec_r, spec_p))
 
     # ---- loop ----
 
@@ -1060,13 +1089,20 @@ class DistributedTrainer:
         from ..utils.profiling import sync
         sync(self.params)
 
-    def _eval(self, epoch: int) -> Dict[str, float]:
+    def _run_eval_step(self):
         d = self.data
-        m = summarize_metrics(jax.device_get(self._eval_step(
+        return self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
             d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
             d.ell_row_id, d.ring_idx, d.sect_idx, d.sect_sub_dst,
-            d.bd_tabs, (d.ell_w, d.sect_w, d.ring_w, d.bd_scale))))
+            d.bd_tabs, (d.ell_w, d.sect_w, d.ring_w, d.bd_scale))
+
+    def _eval(self, epoch: int) -> Dict[str, float]:
+        # fetch ONLY the metrics: the shared eval/predict program also
+        # outputs the sharded logits, which stay on device during
+        # training evals
+        m_dev, _ = self._run_eval_step()
+        m = summarize_metrics(jax.device_get(m_dev))
         m["epoch"] = epoch
         return m
 
@@ -1074,37 +1110,36 @@ class DistributedTrainer:
         return self._eval(-1)
 
     def predict(self) -> np.ndarray:
-        """[V, C] inference-mode logits in ORIGINAL vertex order.
-        The per-shard logits are all_gathered to a replicated result
-        before the fetch, so this works on multi-process meshes too
-        (a P('parts')-sharded device_get would touch non-addressable
-        shards there)."""
-        if self._predict_step is None:
-            from ..obs.compile_watch import ObservedJit
-            self._predict_step = ObservedJit(
-                jitfn=jax.jit(self._build_predict_step()),
-                name="dist_predict_step", verbose=self.config.verbose)
-        d = self.data
-        logits = jax.device_get(self._predict_step(
-            self.params, d.feats, d.edge_src, d.edge_dst, d.in_degree,
-            d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
-            d.sect_idx, d.sect_sub_dst, d.bd_tabs,
-            (d.ell_w, d.sect_w, d.ring_w, d.bd_scale)))
-        return unpad_nodes(logits, self.pg)
+        """[V, C] inference-mode logits in ORIGINAL vertex order —
+        the EVAL program's sharded logits output (one compiled program
+        serves evaluate and predict; the old standalone predict step
+        was a whole extra compile per config).  Single-controller
+        meshes fetch the sharded result directly; multi-process meshes
+        replicate it first through a tiny lazily-built all_gather
+        program (a P('parts')-sharded device_get would touch
+        non-addressable shards there) — rigs and tests never compile
+        it."""
+        _, logits = self._run_eval_step()
+        if jax.process_count() > 1:
+            if self._predict_gather is None:
+                from ..obs.compile_watch import ObservedJit
+                self._predict_gather = ObservedJit(
+                    jitfn=jax.jit(self._build_predict_gather()),
+                    name="dist_predict_gather",
+                    verbose=self.config.verbose)
+            logits = self._predict_gather(logits)
+        arr = np.asarray(jax.device_get(logits))
+        arr = arr.reshape(self.pg.num_parts, self.pg.part_nodes, -1)
+        return unpad_nodes(arr, self.pg)
 
-    def _build_predict_step(self):
+    def _build_predict_gather(self):
         mesh = self.mesh
         spec_p = P(PARTS_AXIS)
         spec_r = P()
 
-        def step(params, feats, *graph_args):
-            logits = self._local_forward(params, feats, *graph_args)
-            # replicated [P, part_nodes, C]
+        def step(logits):
+            # local [part_nodes, C] -> replicated [P, part_nodes, C]
             return lax.all_gather(logits, PARTS_AXIS, axis=0)
 
-        return _shard_map(
-            step, mesh=mesh,
-            in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p),
-            out_specs=spec_r)
+        return _shard_map(step, mesh=mesh, in_specs=spec_p,
+                          out_specs=spec_r)

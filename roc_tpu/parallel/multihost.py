@@ -274,7 +274,7 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     cols = {p: remap_col_to_padded(pg, partition_col(pg, src.col_slice, p))
             for p in local}
     use_stub = aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
-                             "bdense")
+                             "flat_sum", "bdense")
 
     def edge_src_build(p):
         return cols[p]
@@ -317,11 +317,15 @@ def shard_dataset_local(dataset, pg, mesh: Mesh, dtype=None,
     sect_idx = ()
     sect_sub_dst = ()
     sect_meta = ()
-    if aggr_impl == "attn_flat8":
-        # large-graph attention tables, partition-local: ONE section
+    if aggr_impl in ("attn_flat8", "flat_sum"):
+        # the uniform flat layout (attention's attn_flat8 and the sum
+        # path's flat_sum share it), partition-local: ONE section
         # spanning all gathered sources (same layout shard_dataset
         # builds; DistributedTrainer routes these to the flat8 gctx
-        # fields), chunk plan agreed via the O(P) collective
+        # fields), chunk plan agreed via the O(P) collective.  No
+        # baked fused weights multihost (shard_dataset_local has no
+        # fuse path for any impl) — the builder's generic d-scaling
+        # fallback covers fused configs when flat8_w is None
         from ..core.ell import (clean_part_ptr, section_sub_counts,
                                 sectioned_from_graph, sectioned_plan)
         src_rows = P * pn

@@ -1,0 +1,165 @@
+"""``python -m roc_tpu.prewarm`` — pre-pay the compile wall.
+
+Feeds the program-space auditor's exact static enumeration
+(``analysis/programspace.py`` — keyed by the quantized plan shapes the
+rebalancer preserves) into AOT ``lower().compile()`` against the
+persistent compile cache, so rebalance / resume / serving / the bench
+probe all start warm.  Compile-only: nothing executes on a device.
+
+Usage:
+    python -m roc_tpu.prewarm                      # every hosted rig
+    python -m roc_tpu.prewarm --config gin_flat8   # one rig
+    python -m roc_tpu.prewarm --jobs 2             # parallel procs
+    python -m roc_tpu.prewarm --cpu                # force CPU backend
+
+Writes the warm-state artifact (``programspace_warm.json`` next to the
+bench artifacts) recording each warmed config's program-key set — the
+bench probe preflight diffs ``python -m roc_tpu.analysis --json``
+against it and refuses to burn chip deadline on a config whose program
+set grew since the cache was warmed.  Stdout gets one JSON line per
+warmed config (machine-readable; `# ...` diagnostics go to stderr).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+from typing import List, Optional
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    ap = argparse.ArgumentParser(
+        prog="python -m roc_tpu.prewarm", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--config", default="all",
+                    help="rig config name (analysis/programspace.py "
+                         "rig_configs) or 'all' (default)")
+    ap.add_argument("--cache-dir", default=None,
+                    help="persistent cache directory (default: "
+                         "$ROC_TPU_CACHE_DIR or ~/.cache/roc_tpu/xla)")
+    ap.add_argument("--state", default=None,
+                    help="warm-state artifact path (default: "
+                         "benchmarks/programspace_warm.json, honoring "
+                         "ROC_TPU_BENCH_ARTIFACTS)")
+    ap.add_argument("--no-state", action="store_true",
+                    help="do not write the warm-state artifact")
+    ap.add_argument("--jobs", type=int, default=1,
+                    help="warm configs in N parallel child processes. "
+                         "The cache itself is file-based and multi-"
+                         "process safe, but (a) on a TPU host keep "
+                         "the default 1 — libtpu owns the accelerator "
+                         "exclusively, so a second concurrent child "
+                         "fails backend init — and (b) concurrent "
+                         "children sharing one cache dir make the "
+                         "warm-vs-cold attribution best-effort (a "
+                         "sibling's write inside a candidate's "
+                         "before/after window counts as cold); the "
+                         "warm-state KEY sets stay exact either way")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend (CI / cache priming "
+                         "for CPU-rig tests)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    return ap.parse_args(argv)
+
+
+def _parallel(names: List[str], args) -> int:
+    """One child process per config, ``--jobs`` at a time.  Children
+    print their JSON report line; the parent relays it and merges the
+    warm state (children run --no-state so the artifact is written
+    once, by the parent)."""
+    base = [sys.executable, "-m", "roc_tpu.prewarm", "--no-state",
+            "--jobs", "1"]
+    for flag, val in (("--cache-dir", args.cache_dir),):
+        if val:
+            base += [flag, val]
+    if args.cpu:
+        base.append("--cpu")
+    if args.verbose:
+        base.append("-v")
+    reports, rc = [], 0
+    pending = list(names)
+    running: List = []
+    while pending or running:
+        while pending and len(running) < max(1, args.jobs):
+            name = pending.pop(0)
+            running.append((name, subprocess.Popen(
+                base + ["--config", name], stdout=subprocess.PIPE,
+                stderr=sys.stderr, text=True)))
+        name, proc = running.pop(0)
+        out, _ = proc.communicate()
+        for line in out.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    reports.append(json.loads(line))
+                except ValueError:
+                    pass
+            if line:
+                print(line)
+        if proc.returncode != 0:
+            print(f"# prewarm child {name} exited "
+                  f"{proc.returncode}", file=sys.stderr)
+            rc = 1
+    if reports and not args.no_state:
+        from .utils.prewarm import write_warm_state
+        # keep keys=[] reports: an all-failed config must be RECORDED
+        # as warmed-nothing so the preflight sees its whole program
+        # set as growth and refuses — dropping it would skip the
+        # guard entirely (same semantics as the sequential path)
+        path = write_warm_state(
+            [r for r in reports if "config" in r], args.state)
+        print(f"# warm state -> {path}", file=sys.stderr)
+    return rc
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.cpu:
+        # before any backend init; children inherit the env too.  The
+        # 8-virtual-device flag must land before CPU-client init or
+        # the multi-device rigs (gin_flat8 parts=2) are SILENTLY
+        # skipped and never warmed — the exact masked cold-compile
+        # the warm state exists to surface
+        from .analysis import force_cpu_rig
+        force_cpu_rig()
+    from .analysis.programspace import rig_configs
+    names = (sorted(rig_configs()) if args.config == "all"
+             else [args.config])
+    unknown = [n for n in names if n not in rig_configs()]
+    if unknown:
+        print(f"error: unknown config(s) {unknown}; known: "
+              f"{sorted(rig_configs())}", file=sys.stderr)
+        return 2
+    if args.jobs > 1 and len(names) > 1:
+        return _parallel(names, args)
+
+    from .utils.prewarm import prewarm_config, write_warm_state
+    reports = []
+    for name in names:
+        rep = prewarm_config(name, cache_dir=args.cache_dir,
+                             verbose=args.verbose)
+        if rep is not None:
+            reports.append(rep)
+            print(json.dumps({k: v for k, v in rep.items()
+                              if k != "slots"}))
+        else:
+            print(f"# prewarm {name}: skipped — backend cannot host "
+                  f"the rig mesh (with --cpu the 8-virtual-device "
+                  f"flag is set automatically)", file=sys.stderr)
+    if reports and not args.no_state:
+        path = write_warm_state(reports, args.state)
+        print(f"# warm state -> {path}", file=sys.stderr)
+    # a failed candidate was NOT warmed, and an unavailable cache dir
+    # means NOTHING was warmed (keys withheld either way, so the
+    # preflight sees growth) — surface both in the exit code so
+    # round6_chain.sh step 0 can't report success over them
+    if any(r.get("failed") or r.get("cache_unavailable")
+           for r in reports):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

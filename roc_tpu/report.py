@@ -127,6 +127,25 @@ def summarize(events: List[Dict[str, Any]],
           ["step", "lower+compile", "flops", "bytes", "peak_hbm",
            "modeled", "actual/model"], rows, out)
 
+    # compile-cache prewarm: per-config warm-vs-cold summaries
+    # (utils/prewarm.py emits one summary event per warmed config;
+    # the bench children emit the same shape before their timed
+    # phase) — a repeat run should be all-warm, and cold counts on an
+    # unchanged config mean program-set or cache-key drift
+    pre = [e for e in events if e.get("cat") == "compile"
+           and e.get("summary") and "prewarm" in e]
+    rows = []
+    for e in pre:
+        rows.append([
+            str(e.get("prewarm")), str(e.get("programs")),
+            str(e.get("compile_warm_hits")),
+            str(e.get("compile_cold")),
+            str(e.get("failed", 0)),
+            f"{float(e.get('prewarm_s', 0)):.1f}s"])
+    _rows("compile cache (prewarm warm-vs-cold)",
+          ["config", "programs", "warm_hits", "cold", "failed",
+           "total"], rows, out)
+
     # phase spans: the trainer emits a final spans summary; fall back
     # to aggregating the per-eval epoch events / metrics records
     span_events = [e for e in events

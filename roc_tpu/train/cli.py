@@ -75,11 +75,16 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "reference's numMachines*numGPUs)")
     ap.add_argument("--impl", default="auto",
                     choices=["auto", "segment", "blocked", "scan", "ell",
-                             "sectioned", "pallas", "bdense"],
+                             "sectioned", "pallas", "bdense",
+                             "flat_sum"],
                     help="aggregation backend; auto = 'sectioned' (the "
                          "source-sectioned fast-gather layout, measured "
                          "2.3x over 'ell' at Reddit scale) for graphs "
-                         "past VMEM table size, else 'ell'")
+                         "past VMEM table size, 'flat_sum' (the uniform "
+                         "width-8 single-scan layout — ONE compiled "
+                         "scan program per feature width instead of "
+                         "one per degree bucket) past the sectioned "
+                         "window at >=20M edges, else 'ell'")
     ap.add_argument("--allow-slow-impl", action="store_true",
                     help="permit --impl pallas, the one-launch DMA ELL "
                          "kernel measured 8.4x SLOWER than the XLA "
@@ -145,6 +150,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "reference).  Epoch records then carry "
                          "overlap_frac / h2d_wait_p50_ms "
                          "(python -m roc_tpu.report)")
+    ap.add_argument("--head-chunk", default="auto",
+                    help="chunked output head: evaluate the "
+                         "classification-head linear as a scan over "
+                         "this many vertex rows per block so its "
+                         "compiled matmul is [block, C] instead of "
+                         "[V_p, C] (bit-identical forward values; "
+                         "dW matches to fp32 roundoff); 'auto' "
+                         "(default) chunks at 65536 rows once the "
+                         "local row count reaches 262144, 0 disables")
+    ap.add_argument("--cache-min-secs", type=float, default=None,
+                    help="persistent compile cache write threshold "
+                         "(seconds): programs compiling faster are "
+                         "not persisted.  Default: "
+                         "$ROC_TPU_CACHE_MIN_SECS or 1.0; pass 0 to "
+                         "persist every program (what `python -m "
+                         "roc_tpu.prewarm` and the bench children do "
+                         "— the 1.0 s default silently skips the "
+                         "small per-block streamed-head programs)")
     ap.add_argument("--eval-every", type=int, default=5)
     ap.add_argument("--checkpoint", type=str, default=None,
                     help="save params+opt state here after training")
@@ -207,7 +230,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         jax.config.update("jax_platforms", "cpu")
     if not args.no_compile_cache:
         from ..utils.compile_cache import enable_compile_cache
-        enable_compile_cache()
+        enable_compile_cache(min_compile_secs=args.cache_min_secs)
     from ..core.graph import load_dataset, synthetic_dataset
     from ..models.gcn import build_gcn
     from ..models.sage import build_sage
@@ -234,11 +257,19 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     # ONE validator (train/trainer.py resolve_prefetch) so the CLI and
     # the trainer can never accept different --prefetch vocabularies
-    from .trainer import resolve_prefetch
+    from .trainer import resolve_head_chunk, resolve_prefetch
     try:
         resolve_prefetch(TrainConfig(prefetch=args.prefetch))
     except ValueError as e:
         print(f"error: --prefetch: {e}", file=sys.stderr)
+        return 2
+    # ONE validator (train/trainer.py resolve_head_chunk), same policy
+    # as --prefetch: the CLI and the trainer share the vocabulary
+    try:
+        resolve_head_chunk(TrainConfig(head_chunk=args.head_chunk),
+                           1 << 30)
+    except ValueError as e:
+        print(f"error: --head-chunk: {e}", file=sys.stderr)
         return 2
     if args.rebalance and args.parts <= 1:
         print("error: --rebalance requires --parts > 1 (rebalancing "
@@ -366,7 +397,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         aggr_impl=args.impl, aggr_fuse=args.fuse, halo=args.halo,
         memory=memory, features=args.features, remat=args.remat,
         prefetch=args.prefetch, partition=args.partition,
-        rebalance=args.rebalance,
+        rebalance=args.rebalance, head_chunk=args.head_chunk,
+        cache_min_compile_secs=args.cache_min_secs,
         dtype=dt, compute_dtype=cdt, metrics_path=args.metrics)
 
     if args.parts > 1:

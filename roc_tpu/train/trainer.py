@@ -158,6 +158,26 @@ class TrainConfig:
     rebalance: bool = False
     rebalance_gain: float = 0.10
     rebalance_max: int = 2
+    # Chunked output head (the compile-wall fix for the classification
+    # head): "auto" chunks the loss-op linear on the vertex axis in
+    # HEAD_CHUNK_ROWS blocks once the local row count reaches
+    # HEAD_CHUNK_AUTO_MIN_ROWS (below that the full-width matmul is
+    # already small), an int >= 0 is a literal block size (0 = off).
+    # Values and dX bit-identical either way; dW matches to fp32
+    # roundoff (blockwise row-sum order, ops/dense.py linear_chunked);
+    # the chunked head's compiled matmul is [block, C] instead of
+    # [V_p, C], shape-stable across graph sizes.
+    head_chunk: Any = "auto"
+    # Persistent compile cache write threshold (utils/compile_cache.py
+    # enable_compile_cache min_compile_secs): None defers to the
+    # harness default (ROC_TPU_CACHE_MIN_SECS env, else 1.0 s).  The
+    # 1.0 s default silently skips caching the many small per-block
+    # streamed-head programs; the prewarm driver (utils/prewarm.py)
+    # and the bench children pass 0.0 so EVERY program lands in the
+    # cache.  Recorded in the run manifest; consumed by the harnesses
+    # (CLI/bench) that enable the cache — trainers never touch the
+    # cache themselves.
+    cache_min_compile_secs: Optional[float] = None
 
 
 def resolve_dtypes(name: str):
@@ -191,6 +211,38 @@ def resolve_prefetch(config: TrainConfig) -> int:
     if depth < 0:
         raise ValueError(f"prefetch must be >= 0, got {depth}")
     return depth
+
+
+# Chunked-head resolution constants: the block matches the streamed
+# head's staging granularity (core/streaming.py StreamedHead
+# block_rows — the machinery linear_chunked is the in-jit twin of);
+# the auto threshold keeps small graphs on the plain matmul (a
+# [262k, C] head is the scale where the full-width program starts
+# mattering to compile size and the scan adds nothing below it).
+HEAD_CHUNK_ROWS = 65_536
+HEAD_CHUNK_AUTO_MIN_ROWS = 262_144
+
+
+def resolve_head_chunk(config: TrainConfig, num_rows: int) -> int:
+    """``TrainConfig.head_chunk`` -> the concrete block size the
+    GraphContext carries (0 = unchunked).  ONE validator — the CLI
+    routes --head-chunk through this same function.  'auto' chunks at
+    :data:`HEAD_CHUNK_ROWS` once ``num_rows`` reaches
+    :data:`HEAD_CHUNK_AUTO_MIN_ROWS`; an explicit block >= the row
+    count degenerates to 0 (a single block would only add scan
+    overhead)."""
+    hc = config.head_chunk
+    if hc == "auto":
+        return (HEAD_CHUNK_ROWS
+                if num_rows >= HEAD_CHUNK_AUTO_MIN_ROWS else 0)
+    try:
+        block = int(hc)
+    except (TypeError, ValueError):
+        raise ValueError(f"unknown head_chunk {hc!r}; expected 'auto' "
+                         "or an int >= 0") from None
+    if block < 0:
+        raise ValueError(f"head_chunk must be >= 0, got {block}")
+    return 0 if block >= num_rows else block
 
 
 def resolve_partition(config: TrainConfig) -> str:
@@ -292,12 +344,31 @@ def resolve_attention_impl(model, config: TrainConfig,
         return dataclasses.replace(config, aggr_impl="attn_flat8")
     if config.aggr_impl in ("ell", "pallas"):
         return config
-    if why == "MAX/MIN aggregation" and config.aggr_impl == "segment":
-        # _max_fwd has a real segment path (jax.ops.segment_max) — an
-        # explicitly requested 'segment' must not be silently
-        # overridden (ADVICE r3); only the chunked-sum impls
-        # (blocked/scan/pallas_csr/sectioned) lack a MAX form
-        return config
+    if why == "MAX/MIN aggregation":
+        if config.aggr_impl == "segment":
+            # _max_fwd has a real segment path (jax.ops.segment_max) —
+            # an explicitly requested 'segment' must not be silently
+            # overridden (ADVICE r3); only the chunked-sum impls
+            # (blocked/scan/pallas_csr/sectioned) lack a MAX form
+            return config
+        if config.aggr_impl == "flat_sum":
+            # the uniform flat layout has a MAX twin
+            # (ops/aggregate.py aggregate_flat_max) — an explicit
+            # flat_sum stands
+            return config
+        from ..core.ell import FLAT_SUM_MIN_EDGES
+        if dataset is not None and \
+                dataset.graph.num_edges >= FLAT_SUM_MIN_EDGES:
+            # large MAX graphs get the same uniform-scan consolidation
+            # as the sum path: the ELL fallback's per-bucket unroll is
+            # exactly the compile wall the flat layout removes
+            import dataclasses
+            emit("resolve",
+                 f"aggr_impl={config.aggr_impl!r} -> 'flat_sum' "
+                 f"(MAX/MIN at E={dataset.graph.num_edges:,}: uniform "
+                 "layout keeps the compile small)",
+                 requested=config.aggr_impl, resolved="flat_sum")
+            return dataclasses.replace(config, aggr_impl="flat_sum")
     # echo unconditionally: this changes user-selected behavior, so it
     # must never be silent (ADVICE r3)
     emit("resolve", f"aggr_impl={config.aggr_impl!r} -> 'ell' "
@@ -449,7 +520,19 @@ def resolve_auto_impl_probed(graph, out_rows: Optional[int] = None, *,
     arithmetic (set aggr_impl explicitly to use bdense there)."""
     from ..core.ell import resolve_auto_impl
     from ..ops import blockdense as _BD
-    impl = resolve_auto_impl(graph.num_nodes, out_rows=out_rows)
+    impl = resolve_auto_impl(graph.num_nodes, out_rows=out_rows,
+                             num_edges=graph.num_edges)
+    if impl == "flat_sum":
+        # the compile-wall route (core/ell.py FLAT_SUM_MIN_EDGES):
+        # outside sectioned's measured window at this edge count the
+        # per-bucket ELL unroll would compile one program per degree
+        # bucket — changes the execution path, so it echoes
+        # unconditionally.  Pure arithmetic: multi-process safe.
+        emit("resolve", f"aggr_impl='auto' -> 'flat_sum' "
+             f"(E={graph.num_edges:,} past the sectioned window: ONE "
+             f"uniform scan program instead of one per degree bucket)",
+             resolved="flat_sum", num_edges=int(graph.num_edges))
+        return impl, None
     if (impl != "sectioned" or multiprocess
             or graph.num_edges < _BD.BDENSE_AUTO_MIN_EDGES):
         return impl, None
@@ -543,7 +626,8 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
                        bdense_group: int = 1,
                        verbose: bool = False,
                        fuse: bool = False,
-                       bd_census=None) -> GraphContext:
+                       bd_census=None,
+                       head_chunk: int = 0) -> GraphContext:
     """Single-device GraphContext: edges padded to the chunk multiple,
     dummy source id == num_nodes (the appended zero row).
     ``sect_sub_w``/``sect_u16`` tune the sectioned layout and
@@ -572,14 +656,14 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
     sect_idx: tuple = ()
     sect_sub_dst: tuple = ()
     sect_meta: tuple = ()
-    flat8_idx = flat8_dst = None
+    flat8_idx = flat8_dst = flat8_w = None
     bd_a = bd_src = bd_dst = None
     bd_vpad = 0
     ell_w: tuple = ()
     sect_w: tuple = ()
     bd_scale: tuple = ()
     if aggr_impl in ("ell", "pallas", "sectioned", "attn_flat8",
-                     "bdense"):
+                     "flat_sum", "bdense"):
         # these paths never read the flat edge arrays — don't upload
         # two [E] int32 tensors (~920 MB at Reddit scale) they'd ignore
         edge_src = np.zeros(1, dtype=np.int32)
@@ -668,20 +752,24 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
             if fuse:
                 sect_w = tuple(jnp.asarray(w)
                                for w in sect.weight_tables(d_np, d_np))
-    elif aggr_impl == "attn_flat8":
-        # large-graph attention: ONE section spanning all sources
+    elif aggr_impl in ("attn_flat8", "flat_sum"):
+        # the uniform flat layout: ONE section spanning all sources
         # (global ids, dummy == num_nodes == the appended zero row),
-        # sub-rows of a row consecutive/ascending — the uniform layout
-        # gat_aggregate_flat8 scans (compile size independent of the
-        # degree distribution).  seg_rows 8192 bounds the per-chunk
-        # transient [seg, 8, F] at 64 MiB for F=256 fp32.
-        from ..core.ell import sectioned_from_graph
-        sect = sectioned_from_graph(g.row_ptr, g.col_idx, g.num_nodes,
-                                    src_rows=g.num_nodes,
-                                    section_rows=g.num_nodes,
-                                    seg_rows=8192)
+        # sub-rows of a row consecutive/ascending — compile size
+        # independent of the degree distribution.  Two consumers of
+        # the same tables: gat_aggregate_flat8 (attention) and
+        # aggregate_flat_sum/_max (the sum/MAX consolidation).
+        # FLAT_SEG_ROWS bounds the per-chunk transient [seg, 8, F] at
+        # 64 MiB for F=256 fp32.
+        from ..core.ell import flat_sum_from_graph
+        sect = flat_sum_from_graph(g.row_ptr, g.col_idx, g.num_nodes)
         flat8_idx = jnp.asarray(sect.idx[0])
         flat8_dst = jnp.asarray(sect.sub_dst[0])
+        if fuse and aggr_impl == "flat_sum":
+            # baked D^-1/2 A D^-1/2 entries of the single section —
+            # zero runtime normalization on the fused flat path
+            flat8_w = jnp.asarray(
+                sect.weight_tables(d_np, d_np)[0])
     return GraphContext(
         edge_src=jnp.asarray(edge_src),
         edge_dst=jnp.asarray(edge_dst),
@@ -699,6 +787,8 @@ def make_graph_context(dataset: Dataset, aggr_impl: str = "segment",
         sect_meta=sect_meta,
         flat8_idx=flat8_idx,
         flat8_dst=flat8_dst,
+        flat8_w=flat8_w,
+        head_chunk=head_chunk,
         bd_a=bd_a,
         bd_src=bd_src,
         bd_dst=bd_dst,
@@ -733,7 +823,8 @@ class Trainer:
         self.opt_state = adam_init(self.params)
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
         self._head = None
-        self._tail_predict = None
+        self._head_chunk = resolve_head_chunk(
+            config, dataset.graph.num_nodes)
         if config.features == "host":
             # host-resident features streamed through the first layer
             # (the reference's ZC tier, types.cu:22-32)
@@ -813,6 +904,7 @@ class Trainer:
                 in_degree=jnp.asarray(g.in_degree),
                 num_rows=g.num_nodes, gathered_rows=g.num_nodes,
                 aggr_impl="segment", chunk=config.chunk,
+                head_chunk=self._head_chunk,
                 # only the scatter_gather VJP reads symmetric, and this
                 # branch is taken only when the tail has none — a
                 # constant avoids check_symmetric's O(E log E) sort
@@ -828,7 +920,8 @@ class Trainer:
                 bdense_group=config.bdense_group,
                 verbose=config.verbose,
                 fuse=model.num_fused_aggregates() > 0,
-                bd_census=bd_census)
+                bd_census=bd_census,
+                head_chunk=self._head_chunk)
             if config.aggr_impl == "auto":
                 # attention/MAX models reach here with 'auto' already
                 # rewritten by resolve_attention_impl; any other
@@ -849,12 +942,15 @@ class Trainer:
                                        donate_argnums=(0, 1),
                                        modeled_bytes=self._modeled_bytes,
                                        verbose=config.verbose)
+        # eval and predict share ONE compiled program: the eval step
+        # returns (metrics, logits) — the logits already exist inside
+        # the step, so outputting them costs one [V, C] buffer write
+        # per eval while removing a whole compiled program from every
+        # config's space (program-space consolidation, ISSUE 7;
+        # evaluate() fetches only the metrics leaf)
         self._eval_step = ObservedJit(self._eval_step_impl,
                                       name="eval_step",
                                       verbose=config.verbose)
-        self._predict_step = ObservedJit(self._predict_impl,
-                                         name="predict_step",
-                                         verbose=config.verbose)
         from ..obs.manifest import run_manifest
         run_manifest(config=self.config, dataset=dataset, model=model,
                      extra={"modeled_step_bytes": self._modeled_bytes},
@@ -886,11 +982,7 @@ class Trainer:
     def _eval_step_impl(self, params, feats, labels, mask, gctx):
         logits = self.model.apply(cast_floats(params, self.compute),
                                   feats, gctx, key=None, train=False)
-        return perf_metrics(logits, labels, mask)
-
-    def _predict_impl(self, params, feats, gctx):
-        return self.model.apply(cast_floats(params, self.compute),
-                                feats, gctx, key=None, train=False)
+        return perf_metrics(logits, labels, mask), logits
 
     # ---- host-feature streaming path (config.features == "host") ----
 
@@ -910,9 +1002,11 @@ class Trainer:
         return loss, gp, gy
 
     def _tail_eval_impl(self, params, y, labels, mask, gctx):
+        # (metrics, logits) like _eval_step_impl: the streamed tier's
+        # predict reuses this one compiled program (no tail_predict)
         logits = self._tail_model.apply(cast_floats(params, self.compute),
                                         y, gctx, key=None, train=False)
-        return perf_metrics(logits, labels, mask)
+        return perf_metrics(logits, labels, mask), logits
 
     def _apply_update_impl(self, params, opt_state, grads, lr):
         return adam_update(params, grads, opt_state, lr, self.adam_cfg)
@@ -997,31 +1091,34 @@ class Trainer:
     def predict(self) -> jax.Array:
         """[V, C] inference-mode logits (the tensor the reference only
         ever reduces to metrics, softmax_kernel.cu:41-79 — exposed so
-        a user can export predictions).  Jitted — the eager interpreter
-        would hold every intermediate activation alive."""
+        a user can export predictions).  Runs the EVAL program and
+        takes its logits output — predict compiles nothing of its own
+        (program-space consolidation: one compiled program serves
+        evaluate and predict; still jitted, so the eager interpreter
+        never holds every intermediate activation alive)."""
         if self._head is not None:
             w0 = self.params[self._head_param].astype(self.compute)
             y = self._head.forward(w0, self.feats_host, None, False)
-            if self._tail_predict is None:
-                from ..obs.compile_watch import ObservedJit
-                self._tail_predict = ObservedJit(
-                    lambda p, yy, g: self._tail_model.apply(
-                        cast_floats(p, self.compute), yy, g,
-                        key=None, train=False),
-                    name="tail_predict", verbose=self.config.verbose)
-            return self._tail_predict(self.params, y, self.gctx)
-        return self._predict_step(self.params, self.feats, self.gctx)
+            _, logits = self._tail_eval(self.params, y, self.labels,
+                                        self.mask, self.gctx)
+            return logits
+        _, logits = self._eval_step(self.params, self.feats,
+                                    self.labels, self.mask, self.gctx)
+        return logits
 
     def evaluate(self) -> Dict[str, float]:
+        # fetch ONLY the metrics leaf: the shared eval/predict program
+        # also outputs the [V, C] logits, which must stay on device
+        # during training evals
         if self._head is not None:
             w0 = self.params[self._head_param].astype(self.compute)
             y = self._head.forward(w0, self.feats_host, None, False)
-            return summarize_metrics(jax.device_get(
-                self._tail_eval(self.params, y, self.labels, self.mask,
-                                self.gctx)))
-        return summarize_metrics(jax.device_get(
-            self._eval_step(self.params, self.feats, self.labels,
-                            self.mask, self.gctx)))
+            m, _ = self._tail_eval(self.params, y, self.labels,
+                                   self.mask, self.gctx)
+            return summarize_metrics(jax.device_get(m))
+        m, _ = self._eval_step(self.params, self.feats, self.labels,
+                               self.mask, self.gctx)
+        return summarize_metrics(jax.device_get(m))
 
 
 def run_epoch_loop(tr, epochs: Optional[int], do_step,

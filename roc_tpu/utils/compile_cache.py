@@ -21,14 +21,29 @@ DEFAULT_DIR = os.path.join(os.path.expanduser("~"), ".cache",
 
 
 def enable_compile_cache(cache_dir: Optional[str] = None,
-                         min_compile_secs: float = 1.0) -> Optional[str]:
+                         min_compile_secs: Optional[float] = None
+                         ) -> Optional[str]:
     """Point JAX's persistent compilation cache at ``cache_dir``
     (default: $ROC_TPU_CACHE_DIR or ~/.cache/roc_tpu/xla).  Safe to
     call any time before the first compilation; returns the directory
     used, or None when the directory cannot be created (read-only
     HOME, sandboxed CI) — the cache is an optimization, so callers
-    must keep working without it."""
+    must keep working without it.
+
+    ``min_compile_secs`` is the write threshold: programs whose
+    compile is faster are NOT persisted.  ``None`` defers to
+    $ROC_TPU_CACHE_MIN_SECS, else 1.0 s — which silently skips the
+    many small per-block streamed-head programs, so the prewarm
+    driver (utils/prewarm.py) and the bench children pass 0.0
+    explicitly (TrainConfig.cache_min_compile_secs /
+    --cache-min-secs expose it to users)."""
     import jax
+    if min_compile_secs is None:
+        try:
+            min_compile_secs = float(
+                os.environ.get("ROC_TPU_CACHE_MIN_SECS", 1.0))
+        except ValueError:
+            min_compile_secs = 1.0
     d = cache_dir or os.environ.get("ROC_TPU_CACHE_DIR") or DEFAULT_DIR
     try:
         os.makedirs(d, exist_ok=True)

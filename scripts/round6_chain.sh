@@ -8,6 +8,25 @@
 # numbers as a written-up negative result.
 cd "$(dirname "$0")/.."
 set -x
+# 0. compile-wall preflight (ISSUE 7): enumerate the exact program
+#    space (ratchet-checked against scripts/lint_baseline.json), then
+#    pre-warm the persistent compile cache with AOT lower().compile()
+#    so every later step starts warm and writes the warm-state
+#    artifact bench.py's probe preflight diffs against — a probe
+#    refuses to burn chip deadline on a config whose program set grew
+#    since this warm state (dated programspace event, not a blank
+#    timeout).
+#    exit codes ENFORCED (the rest of the chain records per-step
+#    artifacts and may continue past a failed step; the gate must
+#    not): a grown program set or a failed/unpersisted prewarm means
+#    every later step pays cold first-compiles on the chip
+python -m roc_tpu.analysis --json \
+  --select compile-explosion,cache-key-drift \
+  > benchmarks/programspace_report.json || exit 1
+#    --jobs stays 1 on the chip host: libtpu owns the accelerator
+#    exclusively, so parallel prewarm children would fail backend
+#    init (sequential children each claim and release it)
+python -m roc_tpu.prewarm --config all || exit 1
 # 1. staged headline refresh (regression guard before the new rows)
 python bench.py
 # 2. fused vs chain micro race, UNIFORM substrate, Reddit V/E

@@ -20,6 +20,20 @@ from roc_tpu.train.trainer import (TrainConfig, Trainer, cast_floats,
                                    compute_dtype_of)
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _fresh_executables():
+    """Long single-process suite runs on this host intermittently
+    corrupt params mid-module (sign-flips / denormal garbage in the
+    exact-equality roundtrip below; reproduced on unmodified seed
+    trees, never in isolation) — shed the ~200 prior tests'
+    accumulated native JIT state before the knife-edge bf16 module
+    runs.  Assertions are untouched: a real checkpoint-field
+    regression still fails deterministically."""
+    jax.clear_caches()
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="module")
 def dataset():
     return synthetic_dataset(256, 8, in_dim=16, num_classes=4, seed=0)

@@ -279,6 +279,46 @@ def test_local_flat8_matches_global_and_trains():
     assert np.isfinite(tr.evaluate()["train_loss"])
 
 
+def test_local_flat_sum_matches_global_and_trains():
+    """shard_dataset_local's flat_sum tables must equal
+    shard_dataset's and train through the injected-data path — the
+    resolve pass auto-routes multi-process >=20M-edge configs to
+    flat_sum, so the multihost builder must host it (parity vs the
+    single-device segment reference <= 1e-5)."""
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel.distributed import (DistributedTrainer,
+                                              shard_dataset)
+    from roc_tpu.train.trainer import Trainer, TrainConfig
+
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=11)
+    pg = partition_graph(ds.graph, 4, node_multiple=8, edge_multiple=64)
+    mesh = mh.make_parts_mesh(4)
+    loc = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="flat_sum")
+    glo = shard_dataset(ds, pg, mesh, aggr_impl="flat_sum")
+    assert len(loc.sect_idx) == 1 == len(glo.sect_idx)
+    np.testing.assert_array_equal(np.asarray(loc.sect_idx[0]),
+                                  np.asarray(glo.sect_idx[0]))
+    np.testing.assert_array_equal(np.asarray(loc.sect_sub_dst[0]),
+                                  np.asarray(glo.sect_sub_dst[0]))
+    # the flat edge arrays are stubs, not [P, E_p] uploads
+    assert loc.edge_src.shape[-1] == 1
+    cfg = TrainConfig(epochs=3, verbose=False, aggr_impl="flat_sum",
+                      symmetric=True, dropout_rate=0.0,
+                      eval_every=1 << 30)
+    tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
+                            ds, 4, cfg, mesh=mesh, data=loc, pg=pg)
+    tr.train(epochs=3)
+    ref = Trainer(build_gcn([12, 8, 3], dropout_rate=0.0), ds,
+                  TrainConfig(epochs=3, verbose=False,
+                              aggr_impl="segment", symmetric=True,
+                              dropout_rate=0.0, eval_every=1 << 30))
+    ref.train(epochs=3)
+    p0 = np.asarray(ref.predict(), np.float64)
+    p1 = np.asarray(tr.predict(), np.float64)
+    err = np.max(np.abs(p1 - p0)) / max(1.0, np.max(np.abs(p0)))
+    assert err < 1e-5
+
+
 def test_injected_data_without_flat8_tables_fails_fast():
     """Resolved attn_flat8 + injected data lacking the tables must be
     a construction-time ValueError, not a mid-trace IndexError."""
