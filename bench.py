@@ -911,9 +911,13 @@ _TERM_GRACE = 45.0
 
 
 def _run_stage(name: str, timeout: float, argv,
-               grace: float = _TERM_GRACE) -> dict:
+               grace: float = _TERM_GRACE,
+               partial_extra: dict = None) -> dict:
     """Run one stage child under ``timeout``; returns its record
     (``ok`` key tells success).  Persists the attempt immediately.
+    ``partial_extra`` merges into a failed probe's partial result
+    (the retry loop records the attempt index + the backoff that
+    preceded it, so the artifact shows the retry cadence).
 
     The wait runs under a stall heartbeat (roc_tpu/obs): a wedged
     stage emits "still waiting in bench:<stage>" events to stderr and
@@ -927,8 +931,13 @@ def _run_stage(name: str, timeout: float, argv,
         [sys.executable, os.path.abspath(__file__), "--child",
          "--stage", name] + argv,
         stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+    # deadline_s=0: the stall deadline (ROC_TPU_STALL_TIMEOUT_S) is
+    # for the CHILD's hanging regions (first compile, backend claim)
+    # — the parent already bounds this wait with its own stage
+    # timeout, and an env-armed deadline here would cut communicate()
+    # short and mis-classify a slow-but-alive stage as a stall
     hb = Heartbeat(f"bench:{name}", heartbeat_interval(),
-                   timeout_s=round(timeout, 0))
+                   deadline_s=0, timeout_s=round(timeout, 0))
     try:
         with hb:
             out, _ = proc.communicate(timeout=timeout)
@@ -967,7 +976,8 @@ def _run_stage(name: str, timeout: float, argv,
         rec["progress"] = prog
         rec["partial"] = {"t": _now_iso(), "last_phase": _probe_phase(prog),
                           "heartbeats": hb.fired,
-                          "elapsed_s": rec["elapsed_s"]}
+                          "elapsed_s": rec["elapsed_s"],
+                          **(partial_extra or {})}
     _append_stage(rec)
     from roc_tpu.obs.events import emit
     emit("bench", f"stage {name}: "
@@ -1103,10 +1113,16 @@ def parent(args, argv) -> int:
         eff_timeout = min(timeout, budget)
         if name == "probe":
             # the claim can be busy or the relay wedged for tens of
-            # minutes: spread attempts ~_PROBE_INTERVAL apart across
-            # the WHOLE deadline, stopping only when one more probe
-            # plus the cheapest measurement stage could no longer fit
+            # minutes: back attempts off EXPONENTIALLY (with jitter)
+            # up to the _PROBE_INTERVAL cap, spread across the WHOLE
+            # deadline, stopping only when one more probe plus the
+            # cheapest measurement stage could no longer fit.  The
+            # r04/r05 deadline burn was immediate identical retries —
+            # the same-phase abort below caps the COUNT, the backoff
+            # caps the CADENCE; each attempt's partial records the
+            # spacing that preceded it.
             last_phase = None
+            prev_wait = 0.0
             for attempt in range(args.probe_retries + 1):
                 t_attempt = time.time()
                 try:  # fresh progress file per attempt
@@ -1116,7 +1132,9 @@ def parent(args, argv) -> int:
                 rec = _run_stage(
                     name,
                     min(eff_timeout,
-                        remaining() - 20 - _TERM_GRACE), argv)
+                        remaining() - 20 - _TERM_GRACE), argv,
+                    partial_extra={"attempt": attempt + 1,
+                                   "backoff_s": round(prev_wait, 1)})
                 if rec.get("ok") or attempt == args.probe_retries:
                     break
                 # same-phase abort: two consecutive attempts that died
@@ -1146,12 +1164,22 @@ def parent(args, argv) -> int:
                           + (min(later_mins) if later_mins else 0) + 60)
                 if remaining() < needed:
                     break
-                wait = max(0.0, _probe_interval()
-                           - (time.time() - t_attempt))
+                # exponential backoff: attempt n targets
+                # interval/4 * 2^n seconds between attempt STARTS,
+                # capped at the interval (the spread-across-deadline
+                # bound), jittered +/-25% so parallel rounds never
+                # re-bunch their probes on the wedged relay
+                import random
+                target = min(_probe_interval(),
+                             _probe_interval() / 4.0 * (2 ** attempt))
+                target *= random.uniform(0.75, 1.25)
+                wait = max(0.0, target - (time.time() - t_attempt))
                 wait = min(wait, max(remaining() - needed, 0.0))
+                prev_wait = wait
                 if wait > 0:
-                    print(f"# probe retry in {wait:.0f}s "
-                          f"({remaining():.0f}s of deadline left)",
+                    print(f"# probe retry in {wait:.0f}s (backoff "
+                          f"attempt {attempt + 1}, "
+                          f"{remaining():.0f}s of deadline left)",
                           file=sys.stderr)
                     time.sleep(wait)
         else:

@@ -268,9 +268,61 @@ class PallasInterpretRule(AstRule):
                           key=f"pallas@{node.lineno}")
 
 
+class SwallowedExceptionRule(AstRule):
+    """Silently swallowed exceptions in the recovery/streaming/
+    checkpoint paths: a bare ``except:`` (any body — it eats
+    KeyboardInterrupt and SystemExit too), or any handler whose body
+    is only ``pass``/``...``.  These are exactly the modules whose job
+    is to SURFACE faults — a swallow here converts a diagnosable
+    failure (corrupt checkpoint, dead stager, half-written file) into
+    silent data loss, the reference's ``exit(1)`` failure model with
+    the exit removed.  Genuinely-benign swallows (best-effort cleanup)
+    suppress with ``# roc-lint: ok=swallowed-exception`` and a reason,
+    like every rule."""
+
+    name = "swallowed-exception"
+    why = ("recovery/streaming/checkpoint paths must surface "
+           "failures: route them to the resilience event stream or "
+           "re-raise, or pragma the line with the why")
+    PREFIXES = ("roc_tpu/resilience/",)
+    FILES = {"roc_tpu/utils/checkpoint.py",
+             "roc_tpu/utils/resilience.py",
+             "roc_tpu/core/streaming.py"}
+
+    def select(self, relpath: str) -> bool:
+        return (relpath.startswith(self.PREFIXES)
+                or relpath in self.FILES)
+
+    @staticmethod
+    def _body_is_noop(body) -> bool:
+        return all(isinstance(s, ast.Pass)
+                   or (isinstance(s, ast.Expr)
+                       and isinstance(s.value, ast.Constant)
+                       and s.value.value is Ellipsis)
+                   for s in body)
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                yield Finding(self.name, relpath,
+                              "bare except: swallows KeyboardInterrupt"
+                              "/SystemExit too — name the exception",
+                              line=node.lineno,
+                              key=f"bare-except@{node.lineno}")
+            elif self._body_is_noop(node.body):
+                yield Finding(self.name, relpath,
+                              "exception handler body is only pass — "
+                              "the failure vanishes without a trace",
+                              line=node.lineno,
+                              key=f"except-pass@{node.lineno}")
+
+
 RULES: List[AstRule] = [StdoutPrintRule(), HostSyncHotPathRule(),
                         SyncH2dInLoopRule(), BareJitRule(),
-                        PallasInterpretRule()]
+                        PallasInterpretRule(),
+                        SwallowedExceptionRule()]
 
 
 def run_ast_lint(root: str,

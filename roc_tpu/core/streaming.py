@@ -44,6 +44,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .graph import Graph
+# import-light (os/signal/dataclasses + the jax-free event bus): the
+# fault-drill hook below sits on the per-block staging path, so the
+# lookup must not repeat per block
+from ..resilience.inject import maybe_staging_error
 
 
 class _StageError:
@@ -210,7 +214,10 @@ def _stage_block(feats_host: np.ndarray, lo: int, hi: int) -> jax.Array:
     contiguous host copy + async ``device_put`` of one row block.
     Loops never call this directly — they route through
     :meth:`StagingPool.stream` (enforced by roc-lint
-    ``sync-h2d-in-loop``)."""
+    ``sync-h2d-in-loop``).  Also the streamed tier's fault-drill
+    site: an armed ``staging_io`` fault raises OSError here once, and
+    the recovery loop must restore-and-retry (tests/test_drills.py)."""
+    maybe_staging_error()
     return jax.device_put(np.ascontiguousarray(feats_host[lo:hi]))
 
 

@@ -48,9 +48,13 @@ from typing import Any, Dict, List, Optional
 #   programspace  compile-budget reports from the program-space
 #             auditor (analysis/programspace.py): per-config program
 #             counts, modeled compile cost, budget deltas
+#   resilience  fault-tolerance lifecycle (roc_tpu/resilience):
+#             injected faults, recovery retries, corrupt-checkpoint
+#             fallbacks, preemption + emergency checkpoints, elastic
+#             restores onto a different partition count
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
-              "costmodel", "programspace")
+              "costmodel", "programspace", "resilience")
 
 
 def _jsonable(v: Any) -> Any:

@@ -693,6 +693,11 @@ class DistributedTrainer:
         self._obs_edges = int(dataset.graph.num_edges)
         self._modeled_bytes = modeled_step_bytes(
             model, dataset, config, num_parts=num_parts)
+        # dataset identity for the checkpoint config fingerprint; the
+        # elastic half (num_parts + quantized plan shapes) reads
+        # self.pg directly (utils/checkpoint.trainer_fingerprint)
+        self._fp_dataset = {"V": int(dataset.graph.num_nodes),
+                            "E": int(dataset.graph.num_edges)}
         self._build_steps()
         # split-quality record: per-part padded shapes + halo rows +
         # imbalance ratios, into the manifest (every run records the

@@ -144,7 +144,13 @@ def _allreduce_part_vec_max(mesh: Mesh, local: List[int],
     # step — compile telemetry would be noise: roc-lint: ok=bare-jit
     reduce = jax.jit(lambda a: jnp.max(a, axis=0),
                      out_shardings=NamedSharding(mesh, P()))
-    return np.asarray(reduce(arr))
+    # a peer process that died before this DCN rendezvous hangs every
+    # survivor here forever; the watchdog dates the stall and — with
+    # ROC_TPU_STALL_TIMEOUT_S armed — converts it into a StallFailure
+    # the recovery loop can checkpoint-restart (obs/heartbeat.py)
+    from ..obs.heartbeat import Heartbeat
+    with Heartbeat("multihost_collective", op="part_vec_max"):
+        return np.asarray(reduce(arr))
 
 
 def _allreduce_part_stats(mesh: Mesh, local: List[int],
@@ -168,7 +174,11 @@ def _allreduce_part_stats(mesh: Mesh, local: List[int],
     reduce = jax.jit(
         lambda a: jnp.stack([jnp.max(a[:, 0]), jnp.sum(a[:, 1])]),
         out_shardings=NamedSharding(mesh, P()))
-    out = np.asarray(reduce(arr))
+    # same DCN-rendezvous hazard (and the same deadline promotion) as
+    # _allreduce_part_vec_max above
+    from ..obs.heartbeat import Heartbeat
+    with Heartbeat("multihost_collective", op="part_stats"):
+        out = np.asarray(reduce(arr))
     return int(out[0]), int(out[1])
 
 

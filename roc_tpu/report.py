@@ -248,6 +248,20 @@ def summarize(events: List[Dict[str, Any]],
           ["config", "programs", "observed", "modeled_compile",
            "budget", "delta"], rows, out)
 
+    # resilience: the fault-tolerance lifecycle (roc_tpu/resilience) —
+    # injected drill faults, recovery retries, corrupt-checkpoint
+    # fallbacks, preemptions/emergency checkpoints, elastic restores.
+    # A clean run shows (none); every row here is either a drill or an
+    # incident the run survived.
+    res = [e for e in events if e.get("cat") == "resilience"]
+    by_kind: Dict[str, List[Dict[str, Any]]] = {}
+    for e in res:
+        by_kind.setdefault(str(e.get("kind", "?")), []).append(e)
+    rows = [[kind, str(len(es)), str(es[-1].get("msg", ""))[:84]]
+            for kind, es in sorted(by_kind.items())]
+    _rows("resilience (faults injected / recoveries)",
+          ["kind", "n", "last"], rows, out)
+
     stalls = [e for e in events if e.get("cat") == "stall"]
     by_stage: Dict[str, List[float]] = {}
     for e in stalls:

@@ -1,0 +1,43 @@
+"""Preemption-safe elastic training: the resilience subsystem.
+
+Four coordinated pieces (ISSUE 8):
+
+- **Checkpointing** — ``utils/checkpoint.py`` writes versioned,
+  CRC32-validated, config-fingerprinted atomic ``.npz`` checkpoints;
+  both trainers (and the multihost path: process 0 writes, every
+  process restores through ``put_replicated``) save/restore through
+  it, including *elastic* restores onto a different partition count.
+- **Recovery** (:mod:`.recovery`) — keep-last-k rotation with
+  corrupt-checkpoint fallback + the bounded retry loop
+  ``train_with_recovery`` covering numeric failures, watchdog stalls,
+  and transient I/O.
+- **Preemption** (:mod:`.preempt`) — SIGTERM/SIGINT grace handling:
+  finish the in-flight step, emergency-checkpoint, exit with the
+  restartable code (75).
+- **Fault injection** (:mod:`.inject`) — the drill harness: one armed
+  fault per process (``ROC_TPU_FAULT=site:epoch[:proc]``), each site
+  proven by an e2e subprocess test (tests/test_drills.py).
+
+This ``__init__`` stays import-light (inject/preempt only — they sit
+on hot hook paths); the recovery layer loads lazily on first use.
+"""
+
+from . import inject, preempt  # noqa: F401  (import-light)
+from .preempt import Preempted, PreemptionGuard, RESTARTABLE_EXIT_CODE  # noqa: F401
+
+_LAZY = ("NumericFailure", "RECOVERABLE", "CheckpointRotation",
+         "check_finite", "check_params_finite", "train_with_recovery")
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        from . import recovery
+        return getattr(recovery, name)
+    if name == "StallFailure":
+        from ..obs.heartbeat import StallFailure
+        return StallFailure
+    if name in ("CheckpointCorrupt", "trainer_fingerprint"):
+        from ..utils import checkpoint
+        return getattr(checkpoint, name)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

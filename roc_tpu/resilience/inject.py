@@ -1,0 +1,266 @@
+"""Site-based fault injection: drill the failure paths for real.
+
+The reference's only answer to a fault is ``assert``/``exit(1)``
+(``cuda_helper.h:6-28``) — and a recovery stack that is never
+*exercised* is indistinguishable from one that does not work.  This
+module arms exactly one fault per process (``ROC_TPU_FAULT=
+site:epoch[:proc]`` or ``TrainConfig.fault``) and fires it at the
+matching hook point; every site is covered by an e2e subprocess drill
+(tests/test_drills.py) that injects, restarts, and asserts the run
+still reaches the target epoch with the uninterrupted run's loss.
+
+Sites (each fires AT MOST ONCE per process — ``FaultSpec.fired``):
+
+- ``nan_grads``        poison one param leaf with NaN after the armed
+                       epoch's step (the silent numeric-failure mode).
+- ``sigkill``          SIGKILL this process mid-run at the armed epoch.
+- ``sigterm``          deliver SIGTERM to this process at the armed
+                       epoch (drills the preemption grace path).
+- ``kill_in_save``     SIGKILL between the checkpoint tmp-file write
+                       and the atomic rename (atomicity drill).
+- ``bitflip_checkpoint``  flip one byte of the just-written checkpoint,
+                       then SIGKILL (integrity-validation drill: the
+                       restart must fall back to the previous one).
+- ``staging_io``       raise OSError from the StagingPool's staging
+                       call site at the armed epoch (streamed tier).
+- ``stall_compile``    hang the first-compile barrier (the watchdog
+                       deadline must convert it into a StallFailure).
+
+Import-light by design: the hook points live in hot setup paths
+(checkpoint save, staging, the epoch loop) and an unarmed check is a
+couple of attribute reads.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from ..obs.events import emit
+
+ENV_VAR = "ROC_TPU_FAULT"
+
+SITES = ("nan_grads", "sigkill", "sigterm", "kill_in_save",
+         "bitflip_checkpoint", "staging_io", "stall_compile")
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: ``site:epoch[:proc]``.  ``proc`` restricts the
+    fault to one SPMD process index (multihost drills); None fires on
+    any process."""
+    site: str
+    epoch: int
+    proc: Optional[int] = None
+    fired: bool = False
+
+    def spec_str(self) -> str:
+        s = f"{self.site}:{self.epoch}"
+        return s if self.proc is None else f"{s}:{self.proc}"
+
+
+_SPEC: Optional[FaultSpec] = None
+_ENV_CHECKED = False
+# the epoch the training loop last entered (run_epoch_loop notes it) —
+# lets sites without epoch context (staging_io) match the armed epoch
+_EPOCH: Optional[int] = None
+
+
+def parse(spec: str) -> FaultSpec:
+    parts = spec.split(":")
+    if len(parts) not in (2, 3) or parts[0] not in SITES:
+        raise ValueError(
+            f"bad fault spec {spec!r}; expected site:epoch[:proc] with "
+            f"site in {SITES}")
+    try:
+        epoch = int(parts[1])
+        proc = int(parts[2]) if len(parts) == 3 else None
+    except ValueError:
+        raise ValueError(f"bad fault spec {spec!r}: epoch/proc must "
+                         "be integers") from None
+    if epoch < 0 or (proc is not None and proc < 0):
+        # every site is epoch-gated; a negative epoch can never match
+        # and would silently arm a no-op drill
+        raise ValueError(f"bad fault spec {spec!r}: epoch/proc must "
+                         "be >= 0")
+    return FaultSpec(site=parts[0], epoch=epoch, proc=proc)
+
+
+def arm(spec: Optional[str]) -> Optional[FaultSpec]:
+    """Arm a fault from its spec string (idempotent: re-arming the
+    identical spec keeps the existing record, ``fired`` included — a
+    second ``train()`` call must not re-fire a spent fault)."""
+    global _SPEC
+    if not spec:
+        return _SPEC
+    new = parse(spec)
+    if _SPEC is not None and (_SPEC.site, _SPEC.epoch, _SPEC.proc) == \
+            (new.site, new.epoch, new.proc):
+        return _SPEC
+    _SPEC = new
+    return _SPEC
+
+
+def disarm() -> None:
+    """Reset (tests)."""
+    global _SPEC, _ENV_CHECKED, _EPOCH
+    _SPEC = None
+    _ENV_CHECKED = False
+    _EPOCH = None
+
+
+def current() -> Optional[FaultSpec]:
+    """The armed fault, arming lazily from ``ROC_TPU_FAULT`` on first
+    use (an explicit :func:`arm` wins over the environment)."""
+    global _ENV_CHECKED
+    if _SPEC is None and not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        env = os.environ.get(ENV_VAR)
+        if env:
+            arm(env)
+    return _SPEC
+
+
+def note_epoch(epoch: int) -> None:
+    global _EPOCH
+    _EPOCH = int(epoch)
+
+
+def _proc_ok(spec: FaultSpec) -> bool:
+    if spec.proc is None:
+        return True
+    try:
+        import jax
+        return jax.process_index() == spec.proc
+    except Exception:  # jax not initialized: single process
+        return spec.proc == 0
+
+
+def _fire(spec: FaultSpec, detail: str, **fields) -> None:
+    """Mark the fault spent and leave a dated resilience event BEFORE
+    acting — a SIGKILL site must still be attributable from the JSONL
+    artifact alone."""
+    spec.fired = True
+    emit("resilience", f"fault injected: {spec.spec_str()} — {detail}",
+         kind="fault", site=spec.site, epoch=spec.epoch, **fields)
+
+
+def _ready(site: str, epoch: Optional[int] = None, *,
+           mode: str = "exact") -> Optional[FaultSpec]:
+    """The ONE readiness gate every site fires through: armed, not
+    yet spent, right site, right process, and the epoch condition —
+    ``exact`` (caller-passed epoch == armed epoch; None skips the
+    check), ``at_least`` (caller-passed epoch >= armed epoch), or
+    ``noted`` (the loop-noted ``_EPOCH`` == armed epoch — for sites
+    without caller epoch context; None never matches, so staging done
+    OUTSIDE the epoch loop can never eat an epoch-gated fault)."""
+    spec = current()
+    if spec is None or spec.fired or spec.site != site \
+            or not _proc_ok(spec):
+        return None
+    if mode == "exact":
+        if epoch is not None and epoch != spec.epoch:
+            return None
+    elif mode == "at_least":
+        if epoch is None or epoch < spec.epoch:
+            return None
+    elif mode == "noted":
+        if _EPOCH != spec.epoch:
+            return None
+    else:
+        raise ValueError(f"unknown readiness mode {mode!r}")
+    return spec
+
+
+def _poison_params(trainer) -> None:
+    import jax
+    import jax.numpy as jnp
+    done = [False]
+
+    def poison(leaf):
+        if not done[0] and jnp.issubdtype(leaf.dtype, jnp.floating):
+            done[0] = True
+            return leaf.at[(0,) * leaf.ndim].set(jnp.nan)
+        return leaf
+
+    trainer.params = jax.tree_util.tree_map(poison, trainer.params)
+
+
+def epoch_hooks(trainer, epoch: int) -> None:
+    """Epoch-boundary sites, called by ``run_epoch_loop`` after the
+    in-flight step of ``epoch`` has been dispatched."""
+    spec = _ready("nan_grads", epoch) or _ready("sigkill", epoch) \
+        or _ready("sigterm", epoch)
+    if spec is None:
+        return
+    if spec.site == "nan_grads":
+        _fire(spec, "NaN written into one param leaf")
+        _poison_params(trainer)
+    elif spec.site == "sigkill":
+        _fire(spec, "SIGKILL mid-epoch")
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif spec.site == "sigterm":
+        _fire(spec, "SIGTERM delivered (preemption drill)")
+        os.kill(os.getpid(), signal.SIGTERM)
+
+
+def maybe_kill_in_save(epoch: int) -> None:
+    """Between the checkpoint tmp write and the atomic rename
+    (utils/checkpoint.save_checkpoint): die with the ``.npz.tmp`` on
+    disk — restore must never pick it up."""
+    spec = _ready("kill_in_save", int(epoch))
+    if spec is not None:
+        _fire(spec, "SIGKILL mid-checkpoint-write (.npz.tmp on disk)")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_corrupt_checkpoint(path: str, epoch: int) -> None:
+    """After a successful rotation save: flip one byte mid-file, then
+    SIGKILL — the restarted run must detect CheckpointCorrupt and fall
+    back to the previous checkpoint."""
+    spec = _ready("bitflip_checkpoint", int(epoch), mode="at_least")
+    if spec is None:
+        return
+    _fire(spec, f"bit-flipped {os.path.basename(path)}, then SIGKILL",
+          path=path)
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        mid = f.tell() // 2
+        f.seek(mid)
+        b = f.read(1)
+        f.seek(mid)
+        f.write(bytes([b[0] ^ 0xFF]))
+        f.flush()
+        os.fsync(f.fileno())
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_staging_error() -> None:
+    """StagingPool fault site (core/streaming._stage_block): an
+    injected I/O error at the armed epoch — the recovery loop treats
+    OSError from a training round as transient-retryable."""
+    spec = _ready("staging_io", mode="noted")
+    if spec is None:
+        return
+    _fire(spec, "OSError raised from the staging call site")
+    raise OSError("injected StagingPool I/O fault "
+                  f"({spec.spec_str()})")
+
+
+def maybe_stall() -> None:
+    """Compile-barrier stall site: sleep far past any sane deadline.
+    Only the watchdog's ``ROC_TPU_STALL_TIMEOUT_S`` can end this
+    (obs/heartbeat.py delivers SIGINT and converts it to
+    StallFailure) — exactly the silent-hang class it exists for.
+    Epoch-gated like every site: ``stall_compile:0`` stalls a fresh
+    trainer's first compile, a later epoch stalls the recompile
+    barrier of a run that reaches that epoch's barrier (e.g. after a
+    shape-changing rebalance)."""
+    spec = _ready("stall_compile", mode="noted")
+    if spec is None:
+        return
+    _fire(spec, "stalling the compile barrier")
+    time.sleep(3600.0)

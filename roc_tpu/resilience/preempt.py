@@ -1,0 +1,134 @@
+"""Preemption handling: turn SIGTERM/SIGINT into a graceful restart.
+
+TPU capacity is preemptible: the scheduler delivers SIGTERM and gives
+the process a grace window before SIGKILL.  The reference loses the
+whole run; here an installed :class:`PreemptionGuard` records the
+signal, the epoch loop finishes the in-flight step and raises
+:class:`Preempted` at the next epoch boundary, the recovery layer
+writes an emergency checkpoint through the normal rotation, and the
+CLI exits with :data:`RESTARTABLE_EXIT_CODE` — the distinct code a
+supervisor (or the e2e drills) uses to re-invoke the identical
+command, which resumes from the emergency checkpoint.
+
+Signal handlers only set flags (no I/O: the event bus lock is not
+reentrant and a signal can land inside ``emit``); the dated
+``resilience`` event is emitted from the normal control flow that
+handles the raise.  A second signal restores the default disposition
+and re-delivers itself — a stuck teardown can always be killed.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Dict, Optional
+
+# os.EX_TEMPFAIL: "temporary failure, retry later" — the one exit code
+# a supervisor may treat as "re-invoke the same command"
+RESTARTABLE_EXIT_CODE = 75
+
+DEFAULT_GRACE_S = 30.0
+
+
+class Preempted(RuntimeError):
+    """Raised at an epoch boundary after a preemption signal; carries
+    the restartable-exit contract (never a failure of the model)."""
+
+
+class PreemptionGuard:
+    """Installs SIGTERM/SIGINT handlers that request a graceful stop.
+
+    ``requested()`` flips after the first signal; the epoch loop polls
+    it once per epoch (``run_epoch_loop``) so the in-flight step always
+    completes before the stop is acted on.  ``grace_s`` is advisory
+    context for the emergency-checkpoint path (how long the scheduler
+    gives us), recorded in the resilience event."""
+
+    def __init__(self, grace_s: float = DEFAULT_GRACE_S):
+        self.grace_s = float(grace_s)
+        self.requested_at: Optional[float] = None
+        self.signum: Optional[int] = None
+        self._prev: Dict[int, object] = {}
+
+    def install(self) -> "PreemptionGuard":
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            self._prev[sig] = signal.signal(sig, self._handle)
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+
+    def _handle(self, signum, frame) -> None:
+        if signum == signal.SIGINT:
+            # a Heartbeat stall deadline interrupts the main thread by
+            # simulating SIGINT (obs/heartbeat.py); owning the handler
+            # must not swallow it — re-raise so the guarded region's
+            # __exit__ converts it into StallFailure
+            from ..obs.heartbeat import stall_interrupt_pending
+            if stall_interrupt_pending():
+                raise KeyboardInterrupt
+        if self.requested_at is not None:
+            # second signal: stop being graceful — restore the default
+            # disposition and re-deliver, so a wedged teardown dies
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+            return
+        self.requested_at = time.monotonic()
+        self.signum = int(signum)
+        # flag-only (async-signal-safe-ish): the raw note below avoids
+        # the event-bus lock; the structured resilience event is
+        # emitted by whoever handles the Preempted raise
+        try:
+            os.write(2, b"# preemption signal received; finishing the "
+                        b"in-flight epoch step\n")
+        # stderr gone mid-teardown: nowhere left to tell anyone
+        except OSError:  # roc-lint: ok=swallowed-exception
+            pass
+
+    def requested(self) -> bool:
+        return self.requested_at is not None
+
+
+_GUARD: Optional[PreemptionGuard] = None
+
+
+def install(grace_s: float = DEFAULT_GRACE_S) -> PreemptionGuard:
+    """Install (or re-use) the process-wide guard."""
+    global _GUARD
+    if _GUARD is None:
+        _GUARD = PreemptionGuard(grace_s=grace_s).install()
+    else:
+        _GUARD.grace_s = float(grace_s)
+    return _GUARD
+
+
+def reset() -> None:
+    """Uninstall and forget the process guard (tests)."""
+    global _GUARD
+    if _GUARD is not None:
+        _GUARD.uninstall()
+        _GUARD = None
+
+
+def guard() -> Optional[PreemptionGuard]:
+    return _GUARD
+
+
+def requested() -> bool:
+    return _GUARD is not None and _GUARD.requested()
+
+
+def raise_if_preempted(epoch: Optional[int] = None) -> None:
+    """Epoch-boundary check (run_epoch_loop): raise :class:`Preempted`
+    once a signal has been recorded."""
+    if requested():
+        sig = _GUARD.signum
+        name = signal.Signals(sig).name if sig is not None else "?"
+        raise Preempted(
+            f"{name} received"
+            + (f" (epoch {epoch} step completed)" if epoch is not None
+               else "")
+            + f"; grace {_GUARD.grace_s:.0f}s")

@@ -1,0 +1,243 @@
+"""Checkpoint-restart recovery: the loop that makes faults survivable.
+
+Grown out of ``utils/resilience.py`` (which remains as a compat shim)
+into the resilience subsystem's driver: :func:`train_with_recovery`
+now serves BOTH trainers (the distributed path checkpoints replicated
+state once via utils/checkpoint.py and restores through the partition
+rebuild), retries every *recoverable* failure class — numeric
+poisoning (:class:`NumericFailure`), watchdog-detected stalls
+(:class:`StallFailure`, obs/heartbeat.py), and transient I/O errors
+(``OSError``, e.g. the streamed tier's staging path) — and cooperates
+with the preemption guard (:mod:`roc_tpu.resilience.preempt`): a
+Preempted raise writes an emergency checkpoint through the SAME
+rotation and propagates, so the CLI can exit restartable.
+
+Every decision leaves a dated ``resilience`` event; the drill matrix
+(tests/test_drills.py) proves each failure class end to end.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Callable, Dict, List, Optional
+
+from ..obs.events import emit
+from ..obs.heartbeat import StallFailure
+from ..utils.checkpoint import (CheckpointCorrupt, checkpoint_trainer,
+                                restore_trainer)
+from .preempt import Preempted
+
+
+class NumericFailure(RuntimeError):
+    """Raised when training metrics or parameters go NaN/Inf."""
+
+
+# the failure classes the retry loop may restore-and-retry: numeric
+# poisoning (restored state discards the poison), watchdog-detected
+# stalls, and transient I/O (staging/storage hiccups).  Anything else
+# is a bug and must propagate.
+RECOVERABLE = (NumericFailure, StallFailure, OSError)
+
+
+def check_finite(metrics: Dict[str, float]) -> None:
+    loss = metrics.get("train_loss")
+    if loss is not None and not math.isfinite(loss):
+        raise NumericFailure(f"non-finite train loss: {loss!r} "
+                             f"at epoch {metrics.get('epoch')}")
+
+
+_ALL_FINITE = None
+
+
+def check_params_finite(params, opt_state=None) -> None:
+    """Raise if any param (or optimizer-state) leaf holds NaN/Inf —
+    the guard that keeps a poisoned state out of every checkpoint.
+
+    ONE device sync total: the whole pytree folds into a single jitted
+    all-finite reduction (the old per-leaf ``bool(isfinite(leaf)
+    .all())`` walk synced the dispatch pipeline once per leaf — dozens
+    of round trips per checkpoint on deep models).  The per-leaf walk
+    survives only on the failure path, to name the culprit."""
+    import jax
+    import jax.numpy as jnp
+    global _ALL_FINITE
+    if _ALL_FINITE is None:
+        def _impl(trees):
+            ok = jnp.asarray(True)
+            for leaf in jax.tree_util.tree_leaves(trees):
+                if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    ok = jnp.logical_and(ok, jnp.isfinite(leaf).all())
+            return ok
+        _ALL_FINITE = jax.jit(_impl)
+    if bool(_ALL_FINITE((params, opt_state))):
+        return
+    for label, tree in (("param", params), ("opt_state", opt_state)):
+        if tree is None:
+            continue
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if jnp.issubdtype(leaf.dtype, jnp.inexact) and \
+                    not bool(jnp.isfinite(leaf).all()):
+                raise NumericFailure(
+                    f"non-finite {label} at "
+                    f"{jax.tree_util.keystr(path)}")
+    raise NumericFailure("non-finite value in params/opt state")
+
+
+class CheckpointRotation:
+    """Keep the most recent ``keep`` checkpoints of a trainer as
+    ``<prefix>.<epoch>.npz`` (saves are atomic via checkpoint.py).
+
+    ``save`` finite-checks params AND optimizer state (one device
+    sync, :func:`check_params_finite` via ``checkpoint_trainer`` —
+    the guard covers EVERY trainer save, not just rotation rounds) so
+    a poisoned state is never persisted; ``restore_latest`` validates
+    integrity on the way
+    in and falls back to the next-newest checkpoint when the newest is
+    corrupt (:class:`~roc_tpu.utils.checkpoint.CheckpointCorrupt`),
+    with a dated resilience event either way."""
+
+    def __init__(self, prefix: str, keep: int = 3):
+        self.prefix = prefix
+        self.keep = keep
+
+    def path(self, epoch: int) -> str:
+        return f"{self.prefix}.{epoch}.npz"
+
+    def existing(self) -> List[int]:
+        d = os.path.dirname(self.prefix) or "."
+        base = os.path.basename(self.prefix)
+        out = []
+        if not os.path.isdir(d):
+            return out
+        for name in os.listdir(d):
+            # in-flight ``.npz.tmp`` writers are structurally excluded
+            # (suffix + random mkstemp name): a save killed mid-write
+            # can never be restored (tests/test_drills.py kill_in_save)
+            if name.startswith(base + ".") and name.endswith(".npz"):
+                mid = name[len(base) + 1:-4]
+                if mid.isdigit():
+                    out.append(int(mid))
+        return sorted(out)
+
+    def save(self, trainer) -> str:
+        p = self.path(trainer.epoch)
+        # checkpoint_trainer runs the single-sync finite guard over
+        # params + opt state before anything touches disk
+        checkpoint_trainer(trainer, p)
+        for old in self.existing()[:-self.keep]:
+            try:
+                os.remove(self.path(old))
+            # best-effort prune: a leftover old checkpoint wastes disk
+            # but harms nothing, and the next save retries the prune
+            except OSError:   # roc-lint: ok=swallowed-exception
+                pass
+        return p
+
+    def restore_latest(self, trainer,
+                       only_if_ahead: bool = False) -> Optional[int]:
+        """Restore the newest intact checkpoint into ``trainer``;
+        returns its epoch or None if none restored.  ``only_if_ahead``
+        skips the restore when the trainer has already progressed past
+        the newest checkpoint (never rewind live progress)."""
+        epochs = self.existing()
+        if not epochs:
+            return None
+        if only_if_ahead and epochs[-1] <= trainer.epoch:
+            return None
+        for ep in reversed(epochs):
+            if only_if_ahead and ep <= trainer.epoch:
+                # the newest was ahead but corrupt, and every intact
+                # fallback is at/behind the live trainer — rewinding
+                # live progress is exactly what only_if_ahead forbids
+                return None
+            path = self.path(ep)
+            try:
+                restore_trainer(trainer, path)
+                return ep
+            except CheckpointCorrupt as e:
+                emit("resilience",
+                     f"checkpoint {os.path.basename(path)} failed "
+                     f"integrity validation ({e}) — falling back to "
+                     f"the previous one", kind="corrupt_fallback",
+                     path=path, epoch=ep)
+        return None
+
+
+def train_with_recovery(trainer, target_epoch: int,
+                        rotation: CheckpointRotation,
+                        checkpoint_every: int = 50,
+                        max_retries: int = 3,
+                        on_failure: Optional[Callable[[Exception], None]]
+                        = None) -> List[Dict[str, float]]:
+    """Train until ``trainer.epoch == target_epoch`` in checkpointed
+    rounds, with bounded retry-from-last-good-checkpoint on every
+    recoverable failure class (:data:`RECOVERABLE`).
+
+    Resumes from the newest intact checkpoint first, so re-invoking
+    the same command after a crash — SIGKILL, preemption, OOM —
+    continues the run (elastic restart; the restore also rides onto a
+    different partition count, utils/checkpoint.py).  On retry the
+    trainer's PRNG key is perturbed — an identical key would
+    deterministically replay the same failing trajectory (dropout
+    masks included).  A :class:`~roc_tpu.resilience.preempt.Preempted`
+    raise is NOT retried: it writes an emergency checkpoint through
+    the same rotation and propagates, so the caller exits with the
+    restartable code.
+    """
+    import jax
+    history: List[Dict[str, float]] = []
+    # resume a crashed run, but never rewind a live trainer that is
+    # already past the newest checkpoint
+    rotation.restore_latest(trainer, only_if_ahead=True)
+    retries = 0
+    while trainer.epoch < target_epoch:
+        round_epochs = min(checkpoint_every, target_epoch - trainer.epoch)
+        try:
+            hist = trainer.train(epochs=round_epochs)
+            for m in hist:
+                check_finite(m)
+            # save() validates params+opt state finiteness (one sync)
+            # before persisting — a NaN that arose between the round's
+            # last eval and the boundary is caught here, BEFORE the
+            # round's records join the returned history (a refused
+            # round is retried, so keeping its metrics would duplicate
+            # the replayed epochs)
+            path = rotation.save(trainer)
+            history.extend(hist)
+            retries = 0
+            from . import inject
+            inject.maybe_corrupt_checkpoint(path, trainer.epoch)
+        except Preempted as e:
+            # emergency checkpoint through the SAME rotation; a
+            # poisoned state still refuses to persist (the previous
+            # good checkpoint then serves the restart)
+            saved: Optional[str]
+            try:
+                saved = rotation.save(trainer)
+            except NumericFailure:
+                saved = None
+            emit("resilience",
+                 f"preempted at epoch {trainer.epoch}: "
+                 + (f"emergency checkpoint {os.path.basename(saved)}"
+                    if saved else "state non-finite, not persisted")
+                 + " — exiting restartable", kind="preempt",
+                 epoch=trainer.epoch, checkpoint=saved,
+                 reason=str(e))
+            raise
+        except RECOVERABLE as e:
+            if on_failure:
+                on_failure(e)
+            retries += 1
+            emit("resilience",
+                 f"recovering from {type(e).__name__} at epoch "
+                 f"{trainer.epoch} (retry {retries}/{max_retries}): "
+                 f"{e}", kind="recovery", error=type(e).__name__,
+                 epoch=trainer.epoch, retry=retries,
+                 max_retries=max_retries)
+            if retries > max_retries:
+                raise
+            if rotation.restore_latest(trainer) is None:
+                raise
+            trainer.key = jax.random.fold_in(trainer.key, retries)
+    return history

@@ -175,6 +175,41 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="also save every N epochs")
     ap.add_argument("--resume", type=str, default=None,
                     help="restore a checkpoint before training")
+    ap.add_argument("--recovery", action="store_true",
+                    help="checkpoint-restart recovery "
+                         "(roc_tpu/resilience): train in checkpointed "
+                         "rounds under a keep-last-3 rotation at the "
+                         "--checkpoint PREFIX (files "
+                         "<prefix>.<epoch>.npz), resume from the "
+                         "newest intact checkpoint on start — "
+                         "re-invoking the identical command after ANY "
+                         "crash continues the run, including onto a "
+                         "different --parts (elastic restart) — and "
+                         "retry numeric failures / watchdog stalls / "
+                         "transient I/O errors from the last good "
+                         "checkpoint (bounded by --max-retries).  "
+                         "Arms the SIGTERM/SIGINT preemption handler; "
+                         "exits 75 (restartable) on preemption")
+    ap.add_argument("--max-retries", type=int, default=3,
+                    help="recovery retry budget per failure streak "
+                         "(--recovery; default 3)")
+    ap.add_argument("--preempt-grace", type=float, default=None,
+                    dest="preempt_grace",
+                    help="arm the SIGTERM/SIGINT preemption handler "
+                         "with this grace window in seconds (also "
+                         "armed by --recovery, default 30): the first "
+                         "signal finishes the in-flight epoch step, "
+                         "writes an emergency checkpoint, and exits "
+                         "75 (restartable); a second signal kills "
+                         "immediately")
+    ap.add_argument("--fault", type=str, default=None,
+                    help="fault-injection drill (resilience/"
+                         "inject.py): arm ONE fault as "
+                         "site:epoch[:proc] — sites nan_grads, "
+                         "sigkill, sigterm, kill_in_save, "
+                         "bitflip_checkpoint, staging_io, "
+                         "stall_compile.  Equivalent env: "
+                         "ROC_TPU_FAULT")
     ap.add_argument("--eval-only", action="store_true",
                     help="run one inference pass (the reference's "
                          "every-5th-epoch infer, gnn.cc:107-110, as a "
@@ -276,6 +311,22 @@ def main(argv: Optional[List[str]] = None) -> int:
               "moves partition boundaries over a device mesh)",
               file=sys.stderr)
         return 2
+    if args.recovery and not args.checkpoint:
+        print("error: --recovery needs --checkpoint PREFIX (the "
+              "rotation writes <prefix>.<epoch>.npz files there)",
+              file=sys.stderr)
+        return 2
+    if args.max_retries < 0:
+        print("error: --max-retries must be >= 0", file=sys.stderr)
+        return 2
+    if args.fault:
+        # fail fast on a typo'd drill spec, before the dataset load
+        from ..resilience import inject
+        try:
+            inject.parse(args.fault)
+        except ValueError as e:
+            print(f"error: --fault: {e}", file=sys.stderr)
+            return 2
     if args.model != "gat" and args.heads != 1:
         print("error: --heads applies to --model gat only",
               file=sys.stderr)
@@ -399,16 +450,34 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefetch=args.prefetch, partition=args.partition,
         rebalance=args.rebalance, head_chunk=args.head_chunk,
         cache_min_compile_secs=args.cache_min_secs,
+        fault=args.fault,
         dtype=dt, compute_dtype=cdt, metrics_path=args.metrics)
 
-    if args.parts > 1:
-        trainer = DistributedTrainer(model, ds, args.parts, cfg)
-    else:
-        if args.halo == "ring":
-            print("error: --halo ring requires --parts > 1 (the ring "
-                  "rotates shards over a device mesh)", file=sys.stderr)
-            return 2
-        trainer = Trainer(model, ds, cfg)
+    from ..obs.heartbeat import StallFailure
+    from ..resilience import preempt
+    from ..resilience.preempt import Preempted, RESTARTABLE_EXIT_CODE
+    if args.recovery or args.preempt_grace is not None:
+        preempt.install(args.preempt_grace
+                        if args.preempt_grace is not None
+                        else preempt.DEFAULT_GRACE_S)
+
+    if args.halo == "ring" and args.parts <= 1:
+        print("error: --halo ring requires --parts > 1 (the ring "
+              "rotates shards over a device mesh)", file=sys.stderr)
+        return 2
+    try:
+        if args.parts > 1:
+            trainer = DistributedTrainer(model, ds, args.parts, cfg)
+        else:
+            trainer = Trainer(model, ds, cfg)
+    except StallFailure as e:
+        # a watchdog-promoted setup hang (dead multihost peer at the
+        # DCN rendezvous, wedged first table build) is restartable —
+        # a fresh process against a recovered fleet IS the retry
+        emit("resilience", f"{e} during trainer setup — exiting "
+             f"{RESTARTABLE_EXIT_CODE} (restartable)",
+             kind="restartable_exit")
+        return RESTARTABLE_EXIT_CODE
 
     if args.resume:
         restore_trainer(trainer, args.resume)
@@ -446,19 +515,66 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     t0 = time.time()
     remaining = args.epochs - trainer.epoch
-    if args.checkpoint and args.checkpoint_every > 0:
-        while trainer.epoch < args.epochs:
-            n = min(args.checkpoint_every, args.epochs - trainer.epoch)
-            trainer.train(epochs=n)
-            checkpoint_trainer(trainer, args.checkpoint)
-    else:
-        trainer.train(epochs=max(remaining, 0))
+    try:
+        if args.recovery:
+            from ..resilience.recovery import (CheckpointRotation,
+                                               train_with_recovery)
+            rotation = CheckpointRotation(args.checkpoint, keep=3)
+            every = (args.checkpoint_every if args.checkpoint_every > 0
+                     else max(args.eval_every, 1))
+            train_with_recovery(trainer, args.epochs, rotation,
+                                checkpoint_every=every,
+                                max_retries=args.max_retries)
+        elif args.checkpoint and args.checkpoint_every > 0:
+            while trainer.epoch < args.epochs:
+                n = min(args.checkpoint_every,
+                        args.epochs - trainer.epoch)
+                trainer.train(epochs=n)
+                checkpoint_trainer(trainer, args.checkpoint)
+        else:
+            trainer.train(epochs=max(remaining, 0))
+    except Preempted as e:
+        # --recovery already wrote the emergency checkpoint through
+        # its rotation; the plain path persists --checkpoint here
+        # (the finite guard may refuse a poisoned state — still exit
+        # restartable, the restart simply starts from whatever good
+        # checkpoint exists)
+        if not args.recovery and args.checkpoint:
+            from ..resilience.recovery import NumericFailure
+            try:
+                checkpoint_trainer(trainer, args.checkpoint)
+            except (NumericFailure, OSError) as nf:
+                # a refused (poisoned) or unwritable emergency save
+                # must not cost the restartable exit code — the
+                # restart resumes from whatever good checkpoint exists
+                emit("resilience", f"emergency checkpoint failed: "
+                     f"{nf}", kind="preempt", epoch=trainer.epoch)
+        emit("resilience", f"preempted at epoch {trainer.epoch} "
+             f"({e}) — exiting {RESTARTABLE_EXIT_CODE} (restartable)",
+             kind="restartable_exit", epoch=trainer.epoch)
+        return RESTARTABLE_EXIT_CODE
+    except StallFailure as e:
+        # watchdog-promoted hang with nothing restored to retry from:
+        # a fresh process (same command) IS the retry
+        emit("resilience", f"{e} — exiting {RESTARTABLE_EXIT_CODE} "
+             f"(restartable)", kind="restartable_exit",
+             epoch=trainer.epoch)
+        return RESTARTABLE_EXIT_CODE
+    except OSError as e:
+        if not args.recovery:
+            raise
+        emit("resilience", f"I/O failure {e!r} — exiting "
+             f"{RESTARTABLE_EXIT_CODE} (restartable)",
+             kind="restartable_exit", epoch=trainer.epoch)
+        return RESTARTABLE_EXIT_CODE
     dt = time.time() - t0
     if remaining > 0:
         emit("run", f"{remaining} epochs in {dt:.1f}s "
              f"({1000.0 * dt / max(remaining, 1):.1f} ms/epoch)",
              epochs=remaining, wall_s=round(dt, 2))
-    if args.checkpoint:
+    if args.checkpoint and not args.recovery:
+        # under --recovery the rotation already holds the final state
+        # (and --checkpoint is a prefix there, not a file)
         checkpoint_trainer(trainer, args.checkpoint)
         emit("run", f"checkpoint saved to {args.checkpoint}",
              path=args.checkpoint)

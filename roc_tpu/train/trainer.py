@@ -178,6 +178,14 @@ class TrainConfig:
     # (CLI/bench) that enable the cache — trainers never touch the
     # cache themselves.
     cache_min_compile_secs: Optional[float] = None
+    # Fault injection (resilience/inject.py): arm ONE drill fault for
+    # this process as "site:epoch[:proc]" (sites: nan_grads, sigkill,
+    # sigterm, kill_in_save, bitflip_checkpoint, staging_io,
+    # stall_compile).  None = no fault; the ROC_TPU_FAULT env var is
+    # the equivalent out-of-band switch.  Each fault fires at most
+    # once per process — the drill harness (tests/test_drills.py)
+    # injects, restarts, and asserts the run still finishes.
+    fault: Optional[str] = None
 
 
 def resolve_dtypes(name: str):
@@ -815,6 +823,10 @@ class Trainer:
         # model's estimate the compile observer checks XLA against
         self._obs_edges = int(dataset.graph.num_edges)
         self._modeled_bytes = modeled_step_bytes(model, dataset, config)
+        # dataset identity for the checkpoint config fingerprint
+        # (utils/checkpoint.trainer_fingerprint strict half)
+        self._fp_dataset = {"V": int(dataset.graph.num_nodes),
+                            "E": int(dataset.graph.num_edges)}
         self.labels = jnp.asarray(dataset.labels)
         self.mask = jnp.asarray(dataset.mask)
         key = jax.random.PRNGKey(config.seed)
@@ -1146,8 +1158,11 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
     ``gnn.cc:107-110``; same cadence, phase-shifted off the compile
     epoch)."""
     from ..obs.heartbeat import Heartbeat
+    from ..resilience import inject, preempt
     from ..utils.profiling import trace
     cfg = tr.config
+    if cfg.fault:
+        inject.arm(cfg.fault)
     epochs = epochs if epochs is not None else cfg.epochs
     history: List[Dict[str, float]] = []
     t_last = time.perf_counter()
@@ -1160,6 +1175,7 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
         with trace(cfg.profile_dir):
             for _ in range(epochs):
                 epoch = tr.epoch
+                inject.note_epoch(epoch)
                 lr = decayed_lr(cfg.learning_rate, jnp.asarray(epoch),
                                 cfg.decay_rate, cfg.decay_steps)
                 tr.key, step_key = jax.random.split(tr.key)
@@ -1167,8 +1183,11 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                 if not compiled:
                     # barrier the compile step out of the steady laps;
                     # the heartbeat turns the historical blank
-                    # "claiming backend" hang into dated stall events
+                    # "claiming backend" hang into dated stall events —
+                    # and, with ROC_TPU_STALL_TIMEOUT_S armed, into a
+                    # StallFailure the recovery loop can restart
                     with Heartbeat("first_compile"):
+                        inject.maybe_stall()
                         tr.sync()
                     now = time.perf_counter()
                     compile_ms = (now - t_last) * 1e3
@@ -1232,6 +1251,12 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                     if cfg.verbose:
                         print(format_metrics(epoch, m))
                 tr.epoch += 1
+                # epoch-boundary fault sites (nan_grads / sigkill /
+                # sigterm drills) and the preemption grace check: the
+                # in-flight step has been dispatched, so a graceful
+                # stop here "finishes the epoch step" by construction
+                inject.epoch_hooks(tr, epoch)
+                preempt.raise_if_preempted(epoch)
     finally:
         # bound fds across many trainers — on exceptions too; the log
         # lazily reopens in append mode if train() is called again

@@ -1,24 +1,62 @@
-"""Checkpoint / resume.
+"""Checkpoint / resume with integrity + identity validation.
 
-The reference has **no** persistence at all (SURVEY §5: weights are never
-saved; the only cache is the feature-CSV binary).  This fills that gap
-with a minimal, dependency-light checkpointer: the params pytree, Adam
-state, epoch counter and PRNG key are flattened to a single ``.npz``
-(atomic rename on save), restored against a template built from the
-model — robust across JAX versions and trivially inspectable.
+The reference has **no** persistence at all (SURVEY §5: weights are
+never saved; the only cache is the feature-CSV binary).  This fills
+that gap with a minimal, dependency-light checkpointer: the params
+pytree, Adam state, epoch counter and PRNG key are flattened to a
+single ``.npz`` (atomic rename on save), restored against a template
+built from the model — robust across JAX versions and trivially
+inspectable.
+
+Format v2 (resilience PR) hardens the file itself:
+
+- a JSON ``__header__`` member carries the format version, a
+  **per-array CRC32** table, and the saving trainer's **config
+  fingerprint** — the resolve signature (dtype, impl/halo/features)
+  plus the quantized partition-plan shapes
+  (``core/partition.quantize_plan_shapes`` via ``pg.part_nodes/
+  part_edges``);
+- restore validates every CRC and the *strict* fingerprint half
+  (model/dataset/dtype identity) and raises a distinct
+  :class:`CheckpointCorrupt` on any mismatch — the guard for the
+  observed bit-rot/denormal-garbage corruption class (CHANGES.md
+  PR 7);
+- the *elastic* fingerprint half (partition count + quantized plan
+  shapes) may differ: replicated params ride through untouched while
+  the restoring trainer rebuilds its partition — that IS the elastic
+  restart onto a different P, announced with a dated ``resilience``
+  event;
+- v1 checkpoints (no header) still load, with a loud warning.
+
+Both trainers share this module: the distributed/multihost path
+writes the replicated state ONCE (process 0) and every process
+restores through ``put_replicated``.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import tempfile
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.events import emit
 from ..train.optimizer import AdamState
+
+CHECKPOINT_VERSION = 2
+_HEADER_KEY = "__header__"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint failed integrity (CRC32/structure) or strict
+    config-fingerprint validation.  Distinct from load errors of a
+    missing file: the rotation layer catches this and falls back to
+    the previous checkpoint."""
 
 
 def _flatten(tree: Any, prefix: str) -> Dict[str, np.ndarray]:
@@ -30,59 +68,227 @@ def _flatten(tree: Any, prefix: str) -> Dict[str, np.ndarray]:
     return out
 
 
-def _unflatten(tree_template: Any, data, prefix: str) -> Any:
+def _unflatten(tree_template: Any, data, prefix: str, path: str) -> Any:
     leaves, treedef = jax.tree_util.tree_flatten(tree_template)
     paths = [p for p, _ in jax.tree_util.tree_leaves_with_path(
         tree_template)]
     new_leaves = []
-    for path, tmpl in zip(paths, leaves):
-        key = prefix + jax.tree_util.keystr(path)
+    for kpath, tmpl in zip(paths, leaves):
+        key = prefix + jax.tree_util.keystr(kpath)
+        if key not in data:
+            raise CheckpointCorrupt(
+                f"{path}: missing array {key!r} (template/"
+                f"checkpoint mismatch)")
         arr = data[key]
-        assert arr.shape == tuple(tmpl.shape), (
-            f"checkpoint/model mismatch at {key}: "
-            f"{arr.shape} vs {tmpl.shape}")
+        if arr.shape != tuple(tmpl.shape):
+            raise CheckpointCorrupt(
+                f"{path}: shape mismatch at {key}: "
+                f"{arr.shape} vs {tmpl.shape}")
         new_leaves.append(jnp.asarray(arr, dtype=tmpl.dtype))
     return jax.tree_util.tree_unflatten(treedef, new_leaves)
 
 
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def trainer_fingerprint(trainer) -> Dict[str, Any]:
+    """The saving/restoring trainer's identity, in two halves:
+
+    - ``strict`` — what a checkpoint can never survive changing: the
+      param-tree signature (paths + shapes + dtypes), the param/
+      compute dtypes, and the dataset's V/E.  A mismatch is a
+      :class:`CheckpointCorrupt` at restore.
+    - ``elastic`` — what an elastic restart may legally change: the
+      partition count and its quantized plan shapes
+      (``quantize_plan_shapes`` output, carried on the
+      PartitionedGraph) plus the resolved residency knobs.  A
+      mismatch restores anyway (replicated params are partition-
+      independent) and leaves a dated resilience event.
+    """
+    import hashlib
+    sigs = [f"{jax.tree_util.keystr(p)}:"
+            f"{tuple(int(d) for d in leaf.shape)}:{leaf.dtype}"
+            for p, leaf in
+            jax.tree_util.tree_leaves_with_path(trainer.params)]
+    strict: Dict[str, Any] = {
+        "params_sig":
+            hashlib.sha1("|".join(sigs).encode()).hexdigest()[:16]}
+    cfg = getattr(trainer, "config", None)
+    if cfg is not None:
+        strict["dtype"] = str(jnp.dtype(cfg.dtype))
+        strict["compute_dtype"] = (
+            None if cfg.compute_dtype is None
+            else str(jnp.dtype(cfg.compute_dtype)))
+    ds = getattr(trainer, "_fp_dataset", None)
+    if ds:
+        strict["dataset"] = {k: int(v) for k, v in ds.items()}
+    pg = getattr(trainer, "pg", None)
+    elastic: Dict[str, Any] = {
+        "num_parts": int(pg.num_parts) if pg is not None else 1,
+        "part_nodes": int(pg.part_nodes) if pg is not None else None,
+        "part_edges": int(pg.part_edges) if pg is not None else None}
+    if cfg is not None:
+        elastic.update(aggr_impl=cfg.aggr_impl, halo=cfg.halo,
+                       features=cfg.features)
+    return {"strict": strict, "elastic": elastic}
+
+
 def save_checkpoint(path: str, params: Any, opt_state: AdamState,
-                    epoch: int, key: Optional[jax.Array] = None) -> None:
-    """Atomically write params + optimizer state + loop counters."""
+                    epoch: int, key: Optional[jax.Array] = None,
+                    fingerprint: Optional[Dict[str, Any]] = None
+                    ) -> None:
+    """Atomically write params + optimizer state + loop counters, with
+    a v2 integrity header (per-array CRC32 + config fingerprint)."""
     data = _flatten(jax.device_get(params), "params")
     data.update(_flatten(jax.device_get(opt_state), "opt"))
     data["__epoch__"] = np.asarray(epoch, dtype=np.int64)
     if key is not None:
         data["__key__"] = np.asarray(jax.device_get(key))
+    header = {"version": CHECKPOINT_VERSION,
+              "crc32": {k: _crc(v) for k, v in data.items()},
+              "fingerprint": fingerprint or {}}
+    data[_HEADER_KEY] = np.frombuffer(
+        json.dumps(header).encode("utf-8"), dtype=np.uint8)
     d = os.path.dirname(os.path.abspath(path)) or "."
     os.makedirs(d, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
     try:
         with os.fdopen(fd, "wb") as f:
             np.savez(f, **data)
+            f.flush()
+            os.fsync(f.fileno())
+        # fault drill site: a SIGKILL here leaves only the .npz.tmp —
+        # which restore structurally never picks up (atomicity test)
+        from ..resilience import inject
+        inject.maybe_kill_in_save(epoch)
         os.replace(tmp, path)
+        # the rename itself is not durable until the DIRECTORY entry
+        # is on disk — without this a host crash after "checkpoint
+        # saved" can still lose the file (process death alone cannot:
+        # the kernel keeps completed renames)
+        dfd = os.open(d, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
+def _read_checkpoint(path: str) -> Dict[str, np.ndarray]:
+    try:
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    except FileNotFoundError:
+        raise
+    except Exception as e:
+        # torn write, zip-CRC failure, truncation: all one corruption
+        # class for the rotation's fallback
+        raise CheckpointCorrupt(
+            f"{path}: unreadable ({type(e).__name__}: {e})") from e
+
+
+def _parse_header(data: Dict[str, np.ndarray],
+                  path: str) -> Optional[Dict[str, Any]]:
+    raw = data.pop(_HEADER_KEY, None)
+    if raw is None:
+        return None
+    try:
+        return json.loads(bytes(
+            np.asarray(raw, dtype=np.uint8)).decode("utf-8"))
+    except Exception as e:
+        raise CheckpointCorrupt(
+            f"{path}: integrity header unparseable "
+            f"({type(e).__name__}: {e})") from e
+
+
+def _validate_integrity(data: Dict[str, np.ndarray],
+                        header: Dict[str, Any], path: str) -> None:
+    crcs = header.get("crc32") or {}
+    missing = sorted(set(crcs) - set(data))
+    extra = sorted(set(data) - set(crcs))
+    if missing or extra:
+        raise CheckpointCorrupt(
+            f"{path}: array set mismatch (missing={missing}, "
+            f"unexpected={extra})")
+    for name, want in crcs.items():
+        got = _crc(data[name])
+        if got != int(want):
+            raise CheckpointCorrupt(
+                f"{path}: CRC32 mismatch at {name!r} "
+                f"({got:#010x} != {int(want):#010x})")
+
+
+def _validate_fingerprint(header: Dict[str, Any],
+                          expect: Optional[Dict[str, Any]],
+                          path: str) -> None:
+    saved = header.get("fingerprint") or {}
+    if not expect or not saved:
+        return
+    ss, es = saved.get("strict") or {}, expect.get("strict") or {}
+    bad = sorted(k for k in set(ss) & set(es) if ss[k] != es[k])
+    if bad:
+        raise CheckpointCorrupt(
+            f"{path}: config fingerprint mismatch at {bad} — this "
+            f"checkpoint belongs to a different model/dataset/dtype "
+            f"(saved {({k: ss[k] for k in bad})}, "
+            f"restoring {({k: es[k] for k in bad})})")
+    sv, ev = saved.get("elastic") or {}, expect.get("elastic") or {}
+    if sv and ev and sv != ev:
+        emit("resilience",
+             f"elastic restore: checkpoint partition "
+             f"P={sv.get('num_parts')} "
+             f"({sv.get('part_nodes')}x{sv.get('part_edges')}) -> "
+             f"current P={ev.get('num_parts')} "
+             f"({ev.get('part_nodes')}x{ev.get('part_edges')}); "
+             f"replicated params ride through, the partition is "
+             f"rebuilt from the current plan", kind="elastic_restore",
+             saved=sv, current=ev)
+
+
 def load_checkpoint(path: str, params_template: Any,
-                    opt_template: AdamState
+                    opt_template: AdamState,
+                    expect_fingerprint: Optional[Dict[str, Any]] = None
                     ) -> Tuple[Any, AdamState, int, Optional[jax.Array]]:
     """Restore against templates (e.g. a fresh ``model.init_params`` +
-    ``adam_init``); shapes are validated leaf by leaf."""
-    with np.load(path) as z:
-        data = {k: z[k] for k in z.files}
-    params = _unflatten(params_template, data, "params")
-    opt_state = _unflatten(opt_template, data, "opt")
+    ``adam_init``); shapes are validated leaf by leaf, array bytes
+    against the stored CRC32 table, and the strict fingerprint half
+    against ``expect_fingerprint`` — all failures raise
+    :class:`CheckpointCorrupt`.  v1 checkpoints (no header) load with
+    a loud warning instead of validation."""
+    data = _read_checkpoint(path)
+    header = _parse_header(data, path)
+    if header is None:
+        emit("resilience",
+             f"{os.path.basename(path)}: v1 checkpoint (no integrity "
+             f"header) — loading WITHOUT CRC/fingerprint validation",
+             kind="v1_checkpoint", path=path)
+    else:
+        _validate_integrity(data, header, path)
+        _validate_fingerprint(header, expect_fingerprint, path)
+    params = _unflatten(params_template, data, "params", path)
+    opt_state = _unflatten(opt_template, data, "opt", path)
     epoch = int(data["__epoch__"])
     key = jnp.asarray(data["__key__"]) if "__key__" in data else None
     return params, opt_state, epoch, key
 
 
 def restore_trainer(trainer, path: str) -> None:
-    """Resume a Trainer/DistributedTrainer in place."""
+    """Resume a Trainer/DistributedTrainer in place.  Distributed
+    trainers re-replicate the restored host state across their mesh
+    (multihost-safe: ``put_replicated`` assembles from addressable
+    shards) — the partition itself was already rebuilt by the
+    trainer's own constructor, so a checkpoint from a different P
+    restores cleanly (elastic restart)."""
     params, opt_state, epoch, key = load_checkpoint(
-        path, trainer.params, trainer.opt_state)
+        path, trainer.params, trainer.opt_state,
+        expect_fingerprint=trainer_fingerprint(trainer))
+    mesh = getattr(trainer, "mesh", None)
+    if mesh is not None:
+        from ..parallel.distributed import put_replicated
+        params, opt_state = put_replicated((params, opt_state), mesh)
     trainer.params = params
     trainer.opt_state = opt_state
     trainer.epoch = epoch
@@ -91,5 +297,18 @@ def restore_trainer(trainer, path: str) -> None:
 
 
 def checkpoint_trainer(trainer, path: str) -> None:
+    """Save a trainer's state.  EVERY trainer save passes the finite
+    guard first (params + opt state in one jitted reduction, one
+    device sync — resilience/recovery.check_params_finite): a
+    poisoned state must never persist, whether the save came from the
+    recovery rotation, the CLI's --checkpoint paths, or an emergency
+    preemption save.  Replicated distributed state is written ONCE
+    per job: under multi-process SPMD only process 0 touches the
+    filesystem (every process holds the same replicated values)."""
+    from ..resilience.recovery import check_params_finite
+    check_params_finite(trainer.params, trainer.opt_state)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        return
     save_checkpoint(path, trainer.params, trainer.opt_state,
-                    trainer.epoch, trainer.key)
+                    trainer.epoch, getattr(trainer, "key", None),
+                    fingerprint=trainer_fingerprint(trainer))

@@ -1,144 +1,14 @@
-"""Failure detection + elastic recovery.
-
-The reference has **none** of this: every error path is an ``assert``
-or ``exit(1)`` (``cuda_helper.h:6-28``, ``nccl_helper.h:6-13``) and a
-killed run loses all progress since weights are never saved (SURVEY §5
-lists both as gaps to fill).  The TPU-idiomatic recovery model is
-checkpoint-restart:
-
-- :func:`check_finite` — numeric failure detection: masked-loss
-  NaN/Inf is the one silent failure mode of this workload (the XLA
-  runtime turns everything else into a raised exception).
-- :class:`CheckpointRotation` — keep-last-k atomic checkpoints.
-- :func:`train_with_recovery` — drives ``trainer.train()`` in
-  checkpointed rounds; on a numeric failure or crash it restores the
-  most recent good checkpoint and retries (bounded), resuming the
-  epoch counter / lr schedule / PRNG key exactly where the checkpoint
-  left them.
-"""
+"""Back-compat shim: the recovery machinery grew into the
+:mod:`roc_tpu.resilience` subsystem (rotation + retry loop in
+``resilience/recovery.py``, preemption in ``resilience/preempt.py``,
+fault injection in ``resilience/inject.py``).  Import from there; this
+module re-exports the original surface so existing callers keep
+working."""
 
 from __future__ import annotations
 
-import math
-import os
-from typing import Callable, Dict, List, Optional
-
-from .checkpoint import checkpoint_trainer, restore_trainer
-
-
-class NumericFailure(RuntimeError):
-    """Raised when training metrics go NaN/Inf."""
-
-
-def check_finite(metrics: Dict[str, float]) -> None:
-    loss = metrics.get("train_loss")
-    if loss is not None and not math.isfinite(loss):
-        raise NumericFailure(f"non-finite train loss: {loss!r} "
-                             f"at epoch {metrics.get('epoch')}")
-
-
-def check_params_finite(params) -> None:
-    """Raise if any parameter leaf holds NaN/Inf (guards checkpoints
-    against persisting a poisoned state)."""
-    import jax
-    import jax.numpy as jnp
-    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
-        if not bool(jnp.isfinite(leaf).all()):
-            raise NumericFailure(
-                f"non-finite parameter at {jax.tree_util.keystr(path)}")
-
-
-class CheckpointRotation:
-    """Keep the most recent ``keep`` checkpoints of a trainer as
-    ``<prefix>.<epoch>.npz`` (saves are atomic via checkpoint.py)."""
-
-    def __init__(self, prefix: str, keep: int = 3):
-        self.prefix = prefix
-        self.keep = keep
-
-    def path(self, epoch: int) -> str:
-        return f"{self.prefix}.{epoch}.npz"
-
-    def existing(self) -> List[int]:
-        d = os.path.dirname(self.prefix) or "."
-        base = os.path.basename(self.prefix)
-        out = []
-        if not os.path.isdir(d):
-            return out
-        for name in os.listdir(d):
-            if name.startswith(base + ".") and name.endswith(".npz"):
-                mid = name[len(base) + 1:-4]
-                if mid.isdigit():
-                    out.append(int(mid))
-        return sorted(out)
-
-    def save(self, trainer) -> str:
-        p = self.path(trainer.epoch)
-        checkpoint_trainer(trainer, p)
-        for old in self.existing()[:-self.keep]:
-            try:
-                os.remove(self.path(old))
-            except OSError:
-                pass
-        return p
-
-    def restore_latest(self, trainer,
-                       only_if_ahead: bool = False) -> Optional[int]:
-        """Restore the newest checkpoint into ``trainer``; returns its
-        epoch or None if there is none.  ``only_if_ahead`` skips the
-        restore when the trainer has already progressed past the newest
-        checkpoint (never rewind live progress)."""
-        epochs = self.existing()
-        if not epochs:
-            return None
-        if only_if_ahead and epochs[-1] <= trainer.epoch:
-            return None
-        restore_trainer(trainer, self.path(epochs[-1]))
-        return epochs[-1]
-
-
-def train_with_recovery(trainer, target_epoch: int,
-                        rotation: CheckpointRotation,
-                        checkpoint_every: int = 50,
-                        max_retries: int = 3,
-                        on_failure: Optional[Callable[[Exception], None]]
-                        = None) -> List[Dict[str, float]]:
-    """Train until ``trainer.epoch == target_epoch`` in checkpointed
-    rounds, with bounded retry-from-last-good-checkpoint on numeric
-    failure.
-
-    Resumes from the newest existing checkpoint first, so re-invoking
-    the same command after a crash continues the run (elastic
-    restart).  On retry the trainer's PRNG key is perturbed — an
-    identical key would deterministically replay the same failing
-    trajectory (dropout masks included).
-    """
-    import jax
-    history: List[Dict[str, float]] = []
-    # resume a crashed run, but never rewind a live trainer that is
-    # already past the newest checkpoint
-    rotation.restore_latest(trainer, only_if_ahead=True)
-    retries = 0
-    while trainer.epoch < target_epoch:
-        round_epochs = min(checkpoint_every, target_epoch - trainer.epoch)
-        try:
-            hist = trainer.train(epochs=round_epochs)
-            for m in hist:
-                check_finite(m)
-            # metrics only exist on eval epochs; a NaN can arise
-            # between the round's last eval and the round boundary, so
-            # validate the params themselves before persisting
-            check_params_finite(trainer.params)
-            history.extend(hist)
-            rotation.save(trainer)
-            retries = 0
-        except NumericFailure as e:
-            if on_failure:
-                on_failure(e)
-            retries += 1
-            if retries > max_retries:
-                raise
-            if rotation.restore_latest(trainer) is None:
-                raise
-            trainer.key = jax.random.fold_in(trainer.key, retries)
-    return history
+from ..obs.heartbeat import StallFailure  # noqa: F401
+from ..resilience.preempt import Preempted, RESTARTABLE_EXIT_CODE  # noqa: F401
+from ..resilience.recovery import (  # noqa: F401
+    RECOVERABLE, CheckpointRotation, NumericFailure, check_finite,
+    check_params_finite, train_with_recovery)

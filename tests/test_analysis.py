@@ -124,6 +124,38 @@ def test_pallas_interpret_fires(tmp_path):
     assert [(f.rule, f.line) for f in got] == [("pallas-interpret", 3)]
 
 
+def test_swallowed_exception_fires_and_allows(tmp_path):
+    """Recovery/streaming/checkpoint paths: bare except (any body)
+    and except-with-pass-only body both fire; a handler that handles
+    (or re-raises) is clean, the pragma suppresses, and the same code
+    OUTSIDE the scoped paths is not flagged."""
+    code = ("import os\n"
+            "def f(p):\n"
+            "    try:\n"
+            "        os.remove(p)\n"
+            "    except:\n"                               # line 5
+            "        print('x', file=None)\n"
+            "    try:\n"
+            "        os.remove(p)\n"
+            "    except OSError:\n"                       # line 9
+            "        pass\n"
+            "    try:\n"
+            "        os.remove(p)\n"
+            "    except OSError as e:\n"
+            "        raise RuntimeError('ctx') from e\n"  # handled: ok
+            "    try:\n"
+            "        os.remove(p)\n"
+            "    # why: roc-lint: ok=swallowed-exception\n"
+            "    except OSError:\n"                       # pragma'd
+            "        pass\n")
+    _plant(tmp_path, "roc_tpu/resilience/rec.py", code)
+    _plant(tmp_path, "roc_tpu/ops/cold.py", code)  # out of scope
+    got = run_ast_lint(str(tmp_path), select=["swallowed-exception"])
+    assert [(f.rule, f.unit, f.line) for f in got] == [
+        ("swallowed-exception", "roc_tpu/resilience/rec.py", 5),
+        ("swallowed-exception", "roc_tpu/resilience/rec.py", 9)]
+
+
 # ----------------------------------------------------- jaxpr fixtures
 
 def _unit(fn, *args, name="fix", **ctx):

@@ -290,7 +290,11 @@ def test_checkpoint_roundtrip(dataset, tmp_path):
 
 
 def test_checkpoint_shape_mismatch_rejected(dataset, tmp_path):
-    from roc_tpu.utils.checkpoint import (checkpoint_trainer,
+    # a mismatched model raises the DISTINCT CheckpointCorrupt error
+    # (resilience PR: the strict config fingerprint catches it before
+    # any leaf is even compared)
+    from roc_tpu.utils.checkpoint import (CheckpointCorrupt,
+                                          checkpoint_trainer,
                                           restore_trainer)
     cfg = TrainConfig(epochs=1, verbose=False)
     t1 = Trainer(build_gcn([dataset.in_dim, 16, dataset.num_classes]),
@@ -299,7 +303,7 @@ def test_checkpoint_shape_mismatch_rejected(dataset, tmp_path):
     checkpoint_trainer(t1, path)
     t2 = Trainer(build_gcn([dataset.in_dim, 32, dataset.num_classes]),
                  dataset, cfg)
-    with pytest.raises(AssertionError, match="mismatch"):
+    with pytest.raises(CheckpointCorrupt, match="mismatch"):
         restore_trainer(t2, path)
 
 

@@ -1,6 +1,13 @@
-"""Failure detection + checkpoint-restart recovery tests."""
+"""Failure detection + checkpoint-restart recovery tests, plus the
+resilience-subsystem units: checkpoint integrity (CRC32/fingerprint),
+corrupt-checkpoint fallback, the single-sync finite guard, watchdog
+deadlines, preemption, and distributed/elastic restore parity.  The
+end-to-end subprocess drill matrix lives in tests/test_drills.py."""
 
+import contextlib
 import math
+import os
+import signal
 
 import numpy as np
 import pytest
@@ -10,6 +17,42 @@ from roc_tpu.models.gcn import build_gcn
 from roc_tpu.train.trainer import TrainConfig, Trainer
 from roc_tpu.utils.resilience import (CheckpointRotation, NumericFailure,
                                       check_finite, train_with_recovery)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shed_native_jit_state():
+    """This module builds many short-lived trainers (plus the jitted
+    all-finite guard); shed the accumulated native JIT state at module
+    end — the PR-7 mitigation for the known jaxlib-0.4.x XLA:CPU
+    corruption flake under per-process compile churn (test_flat_sum /
+    test_mixed_precision / test_drills carry the same fixture)."""
+    yield
+    import jax
+    jax.clear_caches()
+
+
+@contextlib.contextmanager
+def _capture_events():
+    """Attach a list sink to the event bus for the duration."""
+    from roc_tpu.obs.events import get_bus
+
+    class _Cap:
+        def __init__(self):
+            self.records = []
+
+        def write(self, rec):
+            self.records.append(dict(rec))
+
+        def close(self):
+            pass
+
+    bus = get_bus()
+    cap = _Cap()
+    bus.add_sink(cap)
+    try:
+        yield cap.records
+    finally:
+        bus.sinks.remove(cap)
 
 
 @pytest.fixture()
@@ -85,3 +128,311 @@ def test_recovery_gives_up_after_max_retries(trainer, tmp_path):
     with pytest.raises(NumericFailure):
         train_with_recovery(trainer, 8, rot, checkpoint_every=2,
                             max_retries=1)
+
+
+def test_recovery_retries_transient_io_error(trainer, tmp_path):
+    """OSError from a training round (the streamed tier's staging
+    path, storage hiccups) is a recoverable class: restore + retry."""
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    train_with_recovery(trainer, 2, rot, checkpoint_every=2)
+    orig_train = trainer.train
+    fails = {"n": 0}
+
+    def flaky_io(epochs=None):
+        if fails["n"] < 1:
+            fails["n"] += 1
+            raise OSError("injected transient staging failure")
+        return orig_train(epochs=epochs)
+
+    trainer.train = flaky_io
+    train_with_recovery(trainer, 6, rot, checkpoint_every=2,
+                        max_retries=2)
+    assert trainer.epoch == 6
+
+
+# ---- checkpoint integrity: v2 header, CRC32, fingerprint ----
+
+def _fresh_trainer(num_nodes=64, seed=0):
+    ds = synthetic_dataset(num_nodes, 6, in_dim=8, num_classes=3,
+                           seed=seed)
+    cfg = TrainConfig(epochs=100, eval_every=2, verbose=False,
+                      symmetric=True)
+    return Trainer(build_gcn([8, 8, 3]), ds, cfg)
+
+
+def _flip_byte(path, offset=None):
+    size = os.path.getsize(path)
+    off = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_checkpoint_v2_header_and_roundtrip(trainer, tmp_path):
+    import json
+    from roc_tpu.utils.checkpoint import (checkpoint_trainer,
+                                          restore_trainer)
+    trainer.train(epochs=2)
+    p = str(tmp_path / "ck.npz")
+    checkpoint_trainer(trainer, p)
+    with np.load(p) as z:
+        header = json.loads(bytes(
+            np.asarray(z["__header__"], dtype=np.uint8)).decode())
+    assert header["version"] == 2
+    assert header["crc32"]  # every array covered
+    fp = header["fingerprint"]
+    assert fp["strict"]["params_sig"]
+    assert fp["strict"]["dataset"] == {"V": 64, "E": trainer._obs_edges}
+    assert fp["elastic"]["num_parts"] == 1
+    t2 = _fresh_trainer()
+    restore_trainer(t2, p)
+    assert t2.epoch == 2
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(trainer.params),
+                    jax.tree_util.tree_leaves(t2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_corrupt_checkpoint_raises_distinct_error(trainer, tmp_path):
+    """The PR-7 denormal-garbage corruption class: a flipped byte must
+    surface as CheckpointCorrupt, never as silently-wrong params."""
+    from roc_tpu.utils.checkpoint import (CheckpointCorrupt,
+                                          checkpoint_trainer,
+                                          restore_trainer)
+    trainer.train(epochs=1)
+    p = str(tmp_path / "ck.npz")
+    checkpoint_trainer(trainer, p)
+    _flip_byte(p)
+    with pytest.raises(CheckpointCorrupt):
+        restore_trainer(trainer, p)
+
+
+def test_v1_checkpoint_loads_with_warning(trainer, tmp_path):
+    """Pre-header checkpoints still restore — with a loud resilience
+    event instead of validation."""
+    from roc_tpu.utils.checkpoint import (checkpoint_trainer,
+                                          restore_trainer)
+    trainer.train(epochs=1)
+    p2 = str(tmp_path / "v2.npz")
+    checkpoint_trainer(trainer, p2)
+    with np.load(p2) as z:
+        data = {k: z[k] for k in z.files if k != "__header__"}
+    p1 = str(tmp_path / "v1.npz")
+    np.savez(p1, **data)
+    t2 = _fresh_trainer()
+    with _capture_events() as recs:
+        restore_trainer(t2, p1)
+    assert t2.epoch == trainer.epoch
+    assert any(r.get("cat") == "resilience"
+               and r.get("kind") == "v1_checkpoint" for r in recs)
+
+
+def test_fingerprint_mismatch_raises(trainer, tmp_path):
+    """Same param shapes, different dataset: the strict fingerprint
+    half refuses the restore loudly."""
+    from roc_tpu.utils.checkpoint import (CheckpointCorrupt,
+                                          checkpoint_trainer,
+                                          restore_trainer)
+    trainer.train(epochs=1)
+    p = str(tmp_path / "ck.npz")
+    checkpoint_trainer(trainer, p)
+    other = _fresh_trainer(num_nodes=96, seed=3)
+    with pytest.raises(CheckpointCorrupt, match="fingerprint"):
+        restore_trainer(other, p)
+
+
+def test_rotation_falls_back_on_corrupt_newest(trainer, tmp_path):
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=3)
+    trainer.train(epochs=1)
+    rot.save(trainer)
+    trainer.train(epochs=1)
+    rot.save(trainer)
+    assert rot.existing() == [1, 2]
+    _flip_byte(rot.path(2))
+    t2 = _fresh_trainer()
+    with _capture_events() as recs:
+        assert rot.restore_latest(t2) == 1
+    assert t2.epoch == 1
+    assert any(r.get("kind") == "corrupt_fallback" for r in recs)
+
+
+def test_rotation_only_if_ahead_never_rewinds_past_corrupt(trainer,
+                                                           tmp_path):
+    """only_if_ahead + a corrupt newest checkpoint: the fallback loop
+    must STOP rather than restore an older checkpoint at/behind the
+    live trainer (rewinding live progress is what the flag forbids)."""
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=3)
+    for _ in range(3):
+        trainer.train(epochs=1)
+        rot.save(trainer)
+    assert rot.existing() == [1, 2, 3]
+    _flip_byte(rot.path(3))
+    t2 = _fresh_trainer()
+    t2.epoch = 2  # live progress equal to the best intact fallback
+    assert rot.restore_latest(t2, only_if_ahead=True) is None
+    assert t2.epoch == 2
+    # without the flag the fallback still serves the newest intact one
+    assert rot.restore_latest(t2) == 2
+
+
+def test_rotation_save_refuses_poisoned_state(trainer, tmp_path):
+    """check_params_finite guards EVERY checkpoint save (params AND
+    opt state, one device sync): a poisoned state never persists."""
+    import jax
+    import jax.numpy as jnp
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    trainer.train(epochs=1)
+    done = [False]
+
+    def poison(leaf):
+        if not done[0]:
+            done[0] = True
+            return leaf.at[(0,) * leaf.ndim].set(jnp.nan)
+        return leaf
+
+    trainer.params = jax.tree_util.tree_map(poison, trainer.params)
+    with pytest.raises(NumericFailure):
+        rot.save(trainer)
+    assert rot.existing() == []
+
+
+def test_check_params_finite_covers_opt_state(trainer):
+    import jax
+    import jax.numpy as jnp
+    from roc_tpu.utils.resilience import check_params_finite
+    trainer.train(epochs=1)
+    check_params_finite(trainer.params, trainer.opt_state)
+    done = [False]
+
+    def poison(leaf):
+        if not done[0] and jnp.issubdtype(leaf.dtype, jnp.inexact):
+            done[0] = True
+            return leaf.at[(0,) * leaf.ndim].set(jnp.inf)
+        return leaf
+
+    bad_opt = jax.tree_util.tree_map(poison, trainer.opt_state)
+    with pytest.raises(NumericFailure, match="opt_state"):
+        check_params_finite(trainer.params, bad_opt)
+
+
+# ---- watchdog deadline + preemption + fault-spec parsing ----
+
+def test_heartbeat_deadline_raises_stallfailure():
+    import time
+    from roc_tpu.obs.heartbeat import Heartbeat, StallFailure
+    t0 = time.monotonic()
+    with pytest.raises(StallFailure):
+        with Heartbeat("unit_stall", interval_s=0.05, deadline_s=0.3):
+            time.sleep(30.0)
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_heartbeat_no_deadline_stays_observational():
+    import time
+    from roc_tpu.obs.heartbeat import Heartbeat
+    with Heartbeat("unit_fast", interval_s=0.05) as hb:
+        time.sleep(0.12)
+    assert hb.fired >= 1 and not hb.deadline_hit
+
+
+def test_preemption_guard_graceful():
+    from roc_tpu.resilience import preempt
+    try:
+        g = preempt.install(grace_s=5.0)
+        assert not preempt.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        assert preempt.requested()
+        with pytest.raises(preempt.Preempted):
+            preempt.raise_if_preempted(epoch=3)
+    finally:
+        preempt.reset()
+    assert g.signum == signal.SIGTERM
+
+
+def test_fault_spec_parse_and_arm_idempotent():
+    from roc_tpu.resilience import inject
+    try:
+        s = inject.parse("sigkill:5")
+        assert (s.site, s.epoch, s.proc) == ("sigkill", 5, None)
+        s = inject.parse("nan_grads:3:1")
+        assert s.proc == 1
+        with pytest.raises(ValueError):
+            inject.parse("bogus:1")
+        with pytest.raises(ValueError):
+            inject.parse("sigkill")
+        inject.disarm()
+        a = inject.arm("sigterm:4")
+        a.fired = True
+        # re-arming the identical spec keeps the spent record
+        assert inject.arm("sigterm:4") is a
+        assert inject.arm("sigterm:4").fired
+        # a different spec replaces it
+        assert not inject.arm("sigterm:5").fired
+    finally:
+        inject.disarm()
+
+
+def test_recovery_adds_zero_new_compiled_programs(trainer, tmp_path):
+    """Restore must reuse the compiled steps when shapes hold: a full
+    poison->restore->replay cycle emits ZERO new compile-observer
+    events (the acceptance gate for 'recovery adds zero new compiled
+    programs')."""
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    train_with_recovery(trainer, 2, rot, checkpoint_every=2)
+    orig_train = trainer.train
+    fails = {"n": 0}
+
+    def flaky_train(epochs=None):
+        hist = orig_train(epochs=epochs)
+        if fails["n"] < 1:
+            fails["n"] += 1
+            hist[-1]["train_loss"] = float("nan")
+        return hist
+
+    trainer.train = flaky_train
+    with _capture_events() as recs:
+        train_with_recovery(trainer, 6, rot, checkpoint_every=2)
+    assert trainer.epoch == 6
+    compiles = [r for r in recs
+                if r.get("cat") == "compile" and "lower_s" in r]
+    assert not compiles, compiles
+
+
+# ---- distributed: restore parity across a rebalance boundary ----
+
+def test_distributed_restore_across_rebalance_boundary(tmp_path):
+    """Checkpoint taken AFTER an epoch-boundary repartition, restored
+    into a fresh trainer (which partitions from scratch): full-batch
+    training is split-invariant, so the resumed run must match the
+    uninterrupted never-repartitioned run <= 1e-5.  (The subprocess
+    drill matrix covers crash-restart and elastic-P restores; this
+    pins the PR-5 rebalance machinery composing with restore.)"""
+    import jax
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    ds = synthetic_dataset(96, 7, in_dim=12, num_classes=3, seed=7)
+    cfg = TrainConfig(verbose=False, aggr_impl="ell", symmetric=True,
+                      dropout_rate=0.0, eval_every=1 << 30)
+
+    def mk():
+        return DistributedTrainer(
+            build_gcn([12, 8, 3], dropout_rate=0.0), ds, 2, cfg)
+
+    ref = mk()
+    ref.train(epochs=8)
+    t1 = mk()
+    t1.train(epochs=4)
+    # force a repartition (move the split point by one node multiple)
+    (l0, r0), (l1, r1) = [tuple(b) for b in t1.pg.bounds]
+    t1._repartition([(l0, r0 - 8), (r0 - 7, r1)])
+    t1.train(epochs=2)
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    rot.save(t1)
+    t2 = mk()
+    assert rot.restore_latest(t2) == 6
+    t2.train(epochs=2)
+    for a, b in zip(jax.tree_util.tree_leaves(ref.params),
+                    jax.tree_util.tree_leaves(t2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
