@@ -882,6 +882,8 @@ def run_child(args) -> None:
     # programs must persist — the 1.0 s default silently skipped the
     # small per-block streamed-head programs.
     from roc_tpu.utils.compile_cache import enable_compile_cache
+    from roc_tpu.obs.events import install_excepthook
+    install_excepthook()   # crash flight recorder for dead children
     cache_dir = enable_compile_cache(min_compile_secs=0.0)
     if args.stage == "probe":
         # warm-start evidence in the progress artifact: repeat probes
@@ -910,6 +912,84 @@ def run_child(args) -> None:
 _TERM_GRACE = 45.0
 
 
+# ------------------------------------------------ stderr dedupe filter
+#
+# The r05 driver tail was 5x the same "Platform 'axon' is experimental"
+# jax warning — one per probe retry — drowning the useful stall lines.
+# Stage-child stderr is forwarded through this filter: third-party
+# lines that normalize identically (digits collapsed, so re-dated
+# warnings match) print once, repeats are counted and summarized.  Our
+# own "# ..." diagnostics pass through untouched — heartbeats and
+# retry notes are the evidence the tail exists to preserve.
+
+_STDERR_SEEN: dict = {}
+
+# dedupe-eligible shapes: python logging / absl prefixes — the spam
+# class the r05 tail drowned in.  Deliberately NOT "everything
+# non-'#'": tracebacks and error messages must never dedupe (two
+# different crashes can share frame lines once digits normalize, and
+# a half-suppressed traceback is worse than a repeated one).
+_DEDUP_ELIGIBLE = re.compile(
+    r"^\s*(WARNING|ERROR|INFO|DEBUG|CRITICAL)[:\s]|^[WEIF]\d{4}\s")
+
+
+def _dedup_key(line: str):
+    """Normalization key for dedupe-eligible stderr lines (digits
+    collapsed so re-dated repeats of one warning match); None means
+    always forward (everything that is not a logging-prefixed line —
+    this repo's own '# ' diagnostics, tracebacks, error text)."""
+    s = line.strip()
+    if not s or not _DEDUP_ELIGIBLE.match(s):
+        return None
+    return re.sub(r"[0-9.]+", "N", s)
+
+
+def _forward_stderr(pipe, counts: dict) -> None:
+    """Reader-thread body: forward child stderr line by line, deduping
+    repeated identical (normalized) third-party lines across ALL
+    stage children of this parent run."""
+    try:
+        for line in iter(pipe.readline, ""):
+            line = line.rstrip("\n")
+            key = _dedup_key(line)
+            if key is None:
+                print(line, file=sys.stderr)
+                continue
+            n = _STDERR_SEEN.get(key, 0)
+            _STDERR_SEEN[key] = n + 1
+            if n == 0:
+                print(line, file=sys.stderr)
+            else:
+                counts["suppressed"] = counts.get("suppressed", 0) + 1
+                if n == 1:
+                    print(f"# [stderr dedup] repeat suppressed from "
+                          f"here on: {line.strip()[:110]}",
+                          file=sys.stderr)
+    except (OSError, ValueError):
+        pass  # child torn down mid-line
+    finally:
+        try:
+            pipe.close()
+        except OSError:
+            pass
+
+
+def _sentinel_verdict(epoch_ms, dtype=None, compile_s=None,
+                      stage=None):
+    """Regression-sentinel verdict for a headline epoch value vs the
+    checked-in BENCH_r*.json round history (roc_tpu/obs/sentinel.py —
+    stdlib-only, so the jax-free parent can call it).  Best-effort:
+    the headline must never be blocked by a broken sentinel."""
+    try:
+        _light_obs_imports()
+        from roc_tpu.obs.sentinel import bench_verdict
+        return bench_verdict(epoch_ms, dtype=dtype,
+                             compile_s=compile_s, bench_dir=_HERE,
+                             stage=stage)
+    except Exception as e:  # noqa: BLE001 - verdict is best-effort
+        return {"verdict": "unavailable", "error": _errstr(e)}
+
+
 def _run_stage(name: str, timeout: float, argv,
                grace: float = _TERM_GRACE,
                partial_extra: dict = None) -> dict:
@@ -930,7 +1010,17 @@ def _run_stage(name: str, timeout: float, argv,
     proc = subprocess.Popen(
         [sys.executable, os.path.abspath(__file__), "--child",
          "--stage", name] + argv,
-        stdout=subprocess.PIPE, stderr=sys.stderr, text=True)
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    # child stderr rides through the dedupe filter on its own reader
+    # thread; detach the pipe from proc so communicate() below never
+    # races the reader for it
+    import threading
+    dd_counts: dict = {}
+    stderr_pipe, proc.stderr = proc.stderr, None
+    reader = threading.Thread(target=_forward_stderr,
+                              args=(stderr_pipe, dd_counts),
+                              name=f"stderr:{name}", daemon=True)
+    reader.start()
     # deadline_s=0: the stall deadline (ROC_TPU_STALL_TIMEOUT_S) is
     # for the CHILD's hanging regions (first compile, backend claim)
     # — the parent already bounds this wait with its own stage
@@ -962,6 +1052,9 @@ def _run_stage(name: str, timeout: float, argv,
             proc.kill()
             proc.communicate()
         rec.update(ok=False, error=f"timeout after {timeout:.0f}s")
+    reader.join(timeout=5.0)
+    if dd_counts.get("suppressed"):
+        rec["stderr_suppressed"] = dd_counts["suppressed"]
     rec["elapsed_s"] = round(time.time() - t0, 1)
     if hb.fired:
         rec["heartbeats"] = hb.fired
@@ -1245,6 +1338,12 @@ def parent(args, argv) -> int:
             line.update(_baseline_compare_fields(
                 _load_baselines().get(metric), r.get("platform"),
                 epoch_ms))
+            # regression sentinel: the live value vs the checked-in
+            # round history, recorded INTO this round's BENCH artifact
+            # so the trajectory carries its own verdicts
+            line["sentinel"] = _sentinel_verdict(
+                epoch_ms, dtype=r.get("dtype"),
+                compile_s=r.get("compile_s"), stage=name)
             print(json.dumps(line))
             return 0
     # no GCN stage completed — promote the freshest in-round on-chip
@@ -1261,6 +1360,9 @@ def parent(args, argv) -> int:
     if not args.cpu and gcn_failed:
         promo = _promote_stage_record(args, stage_summary, errs)
         if promo is not None:
+            promo["sentinel"] = _sentinel_verdict(
+                promo["value"], dtype=promo.get("dtype"),
+                stage=promo.get("stage"))
             print(json.dumps(promo))
             return 0
     print(json.dumps({"metric": METRIC_FULL, "value": None, "unit": "ms",

@@ -86,7 +86,11 @@ class StdoutPrintRule(AstRule):
                    "roc_tpu/analysis/__main__.py",
                    # the prewarm CLI's stdout IS its product (one
                    # machine-readable JSON report line per config)
-                   "roc_tpu/prewarm.py"}
+                   "roc_tpu/prewarm.py",
+                   # same for the timeline merger and the regression
+                   # sentinel: their stdout is the report/verdict
+                   "roc_tpu/obs/timeline.py", "roc_tpu/timeline.py",
+                   "roc_tpu/obs/sentinel.py", "roc_tpu/sentinel.py"}
 
     def select(self, relpath: str) -> bool:
         return relpath not in self.ALLOW_FILES
@@ -319,10 +323,61 @@ class SwallowedExceptionRule(AstRule):
                               key=f"except-pass@{node.lineno}")
 
 
+class EventClockRule(AstRule):
+    """Events must go through the bus helper that stamps the clock
+    tuple (``obs/events.py emit``): the cross-process timeline merger
+    aligns per-process streams on the ``(t, mono, host, proc)`` stamps
+    the bus owns, so (a) no call site may hand-pass any of those
+    reserved fields to ``emit`` (a caller-supplied ``t=``/``proc=``
+    would silently mis-lane the record in the merged trace), and
+    (b) no module outside the bus may hand-roll an event record (a
+    dict literal carrying both ``"cat"`` and ``"msg"`` keys) — a
+    hand-rolled dict written straight to a JSONL file has no clock
+    tuple and falls off the merged time axis."""
+
+    name = "event-clock"
+    why = ("the bus stamps the (wall, monotonic, host, proc) clock "
+           "tuple; hand-stamped or hand-rolled event records break "
+           "the cross-process timeline alignment")
+    RESERVED = {"t", "mono", "host", "proc"}
+    ALLOW_FILES = {"roc_tpu/obs/events.py"}
+
+    def select(self, relpath: str) -> bool:
+        return relpath not in self.ALLOW_FILES
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and (
+                    _is_name(node.func, "emit")
+                    or _is_attr(node.func, "emit")):
+                bad = sorted(kw.arg for kw in node.keywords
+                             if kw.arg in self.RESERVED)
+                if bad:
+                    yield Finding(
+                        self.name, relpath,
+                        f"emit() hand-passes reserved clock field(s) "
+                        f"{bad} — the bus stamps the clock tuple",
+                        line=node.lineno,
+                        key=f"emit-clock@{node.lineno}")
+            elif isinstance(node, ast.Dict):
+                keys = {k.value for k in node.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+                if {"cat", "msg"} <= keys:
+                    yield Finding(
+                        self.name, relpath,
+                        "hand-rolled event record (dict literal with "
+                        "'cat' and 'msg' keys) — construct events "
+                        "through obs.events.emit so the clock tuple "
+                        "is stamped",
+                        line=node.lineno,
+                        key=f"event-dict@{node.lineno}")
+
+
 RULES: List[AstRule] = [StdoutPrintRule(), HostSyncHotPathRule(),
                         SyncH2dInLoopRule(), BareJitRule(),
                         PallasInterpretRule(),
-                        SwallowedExceptionRule()]
+                        SwallowedExceptionRule(), EventClockRule()]
 
 
 def run_ast_lint(root: str,

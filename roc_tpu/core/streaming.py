@@ -90,6 +90,11 @@ class StagingPool:
             raise ValueError(f"prefetch depth must be >= 0, got {depth}")
         self.h2d_wait_ms: List[float] = []
         self.stage_ms: List[float] = []
+        # monotonic start times parallel to the two series — the
+        # cross-process timeline (obs/timeline.py) places each block's
+        # wait/stage on the merged time axis
+        self.h2d_wait_t0: List[float] = []
+        self.stage_t0: List[float] = []
         self.max_live = 0
         self._live = 0
         self._lock = threading.Lock()
@@ -110,9 +115,12 @@ class StagingPool:
         micro_stream) reports identical semantics."""
         with self._lock:
             wait, stage = self.h2d_wait_ms, self.stage_ms
+            wait_t0, stage_t0 = self.h2d_wait_t0, self.stage_t0
             self.h2d_wait_ms, self.stage_ms = [], []
+            self.h2d_wait_t0, self.stage_t0 = [], []
         out: Dict[str, object] = {
             "n": len(wait), "wait_ms": wait, "stage_ms": stage,
+            "wait_t0": wait_t0, "stage_t0": stage_t0,
             "max_live": self.max_live, "depth": self.depth,
             "wait_p50_ms": None, "stage_p50_ms": None,
             "overlap_frac": None}
@@ -149,14 +157,17 @@ class StagingPool:
                 if not first:
                     self._note_live(-1)  # previous block superseded
                 first = False
+                mono0 = time.monotonic()
                 t0 = time.perf_counter()
                 val = fn()
                 ms = (time.perf_counter() - t0) * 1e3
                 with self._lock:
                     self.stage_ms.append(ms)
+                    self.stage_t0.append(mono0)
                     # synchronous: the whole stage sits on the critical
                     # path, so the wait IS the stage time
                     self.h2d_wait_ms.append(ms)
+                    self.h2d_wait_t0.append(mono0)
                 self._note_live(+1)
                 yield val
             return
@@ -173,11 +184,13 @@ class StagingPool:
                             return
                     if cancel.is_set():
                         return
+                    mono0 = time.monotonic()
                     t0 = time.perf_counter()
                     val = fn()
                     with self._lock:
                         self.stage_ms.append(
                             (time.perf_counter() - t0) * 1e3)
+                        self.stage_t0.append(mono0)
                     self._note_live(+1)
                     q.put(val)
                     val = None  # the queue owns the only worker ref
@@ -189,11 +202,13 @@ class StagingPool:
         worker.start()
         try:
             for i in range(len(fns)):
+                mono0 = time.monotonic()
                 t0 = time.perf_counter()
                 item = q.get()
                 with self._lock:
                     self.h2d_wait_ms.append(
                         (time.perf_counter() - t0) * 1e3)
+                    self.h2d_wait_t0.append(mono0)
                 if isinstance(item, _StageError):
                     raise item.exc
                 if i > 0:

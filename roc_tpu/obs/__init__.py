@@ -21,6 +21,8 @@ This package makes every run self-describing:
 """
 
 from .events import (CATEGORIES, ConsoleSink, EventLog,  # noqa: F401
-                     JsonlSink, configure, emit, get_bus)
+                     JsonlSink, clock_identity, configure,
+                     dump_flight_record, emit, get_bus,
+                     install_excepthook, set_clock_identity)
 from .heartbeat import Heartbeat  # noqa: F401
 from .manifest import run_manifest  # noqa: F401

@@ -22,8 +22,10 @@ a watchdog thread while the main thread is blocked inside a fetch.
 
 from __future__ import annotations
 
+import collections
 import json
 import os
+import socket
 import sys
 import threading
 import time
@@ -52,9 +54,51 @@ from typing import Any, Dict, List, Optional
 #             injected faults, recovery retries, corrupt-checkpoint
 #             fallbacks, preemption + emergency checkpoints, elastic
 #             restores onto a different partition count
+#   timeline  clock-sync handshakes and per-phase span batches the
+#             cross-process trace merger consumes
+#             (obs/timeline.py; python -m roc_tpu.timeline)
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
-              "costmodel", "programspace", "resilience")
+              "costmodel", "programspace", "resilience", "timeline")
+
+
+# ---------------------------------------------------------- clock tuple
+#
+# Every event carries a ``(wall, monotonic, host, proc)`` clock tuple —
+# ``t`` (epoch seconds, human-alignable but NTP-skewed), ``mono``
+# (monotonic seconds, skew-free within a process but with an arbitrary
+# per-process epoch), ``host``/``proc`` (the stream's identity).  The
+# cross-process timeline merger (obs/timeline.py) aligns per-process
+# monotonic clocks on the ``clock_sync`` handshake the trainers emit at
+# the first-step barrier (train/trainer.py run_epoch_loop), so N
+# per-process JSONL streams render on ONE time axis.  The bus stamps
+# the tuple; call sites never hand-roll it (roc-lint ``event-clock``).
+
+_HOST = socket.gethostname().split(".")[0]
+_PROC: Optional[int] = None
+
+
+def set_clock_identity(proc: Optional[int] = None,
+                       host: Optional[str] = None) -> None:
+    """Pin the process identity stamped on every event.  Called by the
+    run manifest once jax knows ``process_index()``; before that the
+    ``JAX_PROCESS_ID`` env var (or 0) serves."""
+    global _PROC, _HOST
+    if proc is not None:
+        _PROC = int(proc)
+    if host is not None:
+        _HOST = host
+
+
+def clock_identity() -> Dict[str, Any]:
+    """The ``host``/``proc`` half of the clock tuple."""
+    global _PROC
+    if _PROC is None:
+        try:
+            _PROC = int(os.environ.get("JAX_PROCESS_ID", "0"))
+        except ValueError:
+            _PROC = 0
+    return {"host": _HOST, "proc": _PROC}
 
 
 def _jsonable(v: Any) -> Any:
@@ -124,18 +168,32 @@ class JsonlSink:
 class EventLog:
     """A bus fanning events out to its sinks.  Sink failures are
     swallowed after a one-time stderr note — telemetry must never take
-    down the run it observes."""
+    down the run it observes.
 
-    def __init__(self, sinks: Optional[List] = None):
+    Every record is stamped with the clock tuple (``t``/``mono``/
+    ``host``/``proc``) and retained in a bounded ring buffer — the
+    crash flight recorder :func:`dump_flight_record` writes on fatal
+    paths, so a dead process's last seconds of telemetry survive even
+    when no JSONL sink was configured."""
+
+    def __init__(self, sinks: Optional[List] = None,
+                 ring_events: Optional[int] = None):
         self.sinks: List = list(sinks) if sinks is not None else []
         self._lock = threading.Lock()
         self._sink_warned = False
+        self.ring: collections.deque = collections.deque(
+            maxlen=flight_ring_events() if ring_events is None
+            else ring_events)
 
     def emit(self, cat: str, msg: str, console: bool = True,
              **fields: Any) -> Dict[str, Any]:
-        record = {"t": round(time.time(), 3), "cat": cat, "msg": msg,
+        record = {"t": round(time.time(), 3),
+                  "mono": round(time.monotonic(), 6),
+                  **clock_identity(),
+                  "cat": cat, "msg": msg,
                   "console": console, **fields}
         with self._lock:
+            self.ring.append(record)
             for sink in self.sinks:
                 try:
                     sink.write(record)
@@ -206,3 +264,105 @@ def emit(cat: str, msg: str, console: bool = True,
     of the stderr stream (it still lands in the JSONL artifact) — the
     call-site analog of today's ``if config.verbose:`` gates."""
     return get_bus().emit(cat, msg, console=console, **fields)
+
+
+# ------------------------------------------------ crash flight recorder
+#
+# The JSONL sink flushes per line, but a process that dies WITHOUT a
+# sink configured — or whose interesting telemetry was console-only —
+# takes its last seconds of events with it (the r01-r05 probes died
+# exactly like that).  The bus therefore keeps a bounded ring of recent
+# records, and the fatal paths (preemption guard, stall watchdog,
+# fault-injection sites about to SIGKILL, the unhandled-exception hook)
+# dump it to a dated ``flightrecord_*.json`` for the post-mortem.
+
+# ring capacity (events, not bytes): ~30 s of a chatty run
+FLIGHT_RING_EVENTS = 256
+
+
+def flight_ring_events() -> int:
+    try:
+        return int(os.environ.get("ROC_TPU_FLIGHT_EVENTS",
+                                  FLIGHT_RING_EVENTS))
+    except ValueError:
+        return FLIGHT_RING_EVENTS
+
+
+def flight_record_dir() -> str:
+    """Where dumps land: ``ROC_TPU_FLIGHT_DIR``, else next to the JSONL
+    events artifact, else the cwd."""
+    env = os.environ.get("ROC_TPU_FLIGHT_DIR")
+    if env:
+        return env
+    jl = get_bus().jsonl_path()
+    if jl:
+        return os.path.dirname(os.path.abspath(jl)) or "."
+    return "."
+
+
+def dump_flight_record(reason: str,
+                       path: Optional[str] = None) -> Optional[str]:
+    """Write the ring buffer to a dated flight-record JSON; returns the
+    path, or None on failure (a dump must never mask the failure that
+    triggered it).  Filename carries the date, pid, and a slug of the
+    reason so multiple dumps of one incident coexist."""
+    bus = get_bus()
+    try:
+        ident = clock_identity()
+        if path is None:
+            slug = "".join(c if c.isalnum() else "-"
+                           for c in reason)[:40].strip("-")
+            name = (f"flightrecord_"
+                    f"{time.strftime('%Y%m%d-%H%M%S')}_"
+                    f"p{ident['proc']}_pid{os.getpid()}_{slug}.json")
+            path = os.path.join(flight_record_dir(), name)
+        with bus._lock:
+            events = [
+                {k: _jsonable(v) for k, v in r.items() if k != "console"}
+                for r in bus.ring]
+        payload = {"reason": reason,
+                   "t": round(time.time(), 3),
+                   "mono": round(time.monotonic(), 6),
+                   "pid": os.getpid(), **ident,
+                   "n_events": len(events), "events": events}
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except Exception as e:  # noqa: BLE001 - never mask the trigger
+        try:
+            print(f"# flight-record dump failed: {e!r}",
+                  file=sys.stderr)
+        except OSError:
+            pass
+        return None
+    try:
+        print(f"# flight record ({reason}): {path}", file=sys.stderr)
+    except OSError:
+        pass
+    return path
+
+
+_EXCEPTHOOK_INSTALLED = False
+
+
+def install_excepthook() -> None:
+    """Chain a flight-record dump onto ``sys.excepthook`` so an
+    unhandled exception leaves the last telemetry window behind.
+    Idempotent; the previous hook always runs."""
+    global _EXCEPTHOOK_INSTALLED
+    if _EXCEPTHOOK_INSTALLED:
+        return
+    _EXCEPTHOOK_INSTALLED = True
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+            dump_flight_record(f"unhandled {exc_type.__name__}")
+        prev(exc_type, exc, tb)
+
+    sys.excepthook = hook

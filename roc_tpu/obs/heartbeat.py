@@ -153,6 +153,12 @@ class Heartbeat:
                 # The flag lets a SIGINT-owning preemption guard
                 # route this interrupt through instead of handling
                 # it as a graceful Ctrl-C.
+                # crash flight recorder: the stall may still wedge the
+                # process terminally (a C-blocked region that retries
+                # EINTR never sees the interrupt), so the telemetry
+                # window is persisted BEFORE the interrupt attempt
+                from .events import dump_flight_record
+                dump_flight_record(f"stall:{self.stage}")
                 global _INTERRUPTING
                 _INTERRUPTING = self
                 import signal as _signal

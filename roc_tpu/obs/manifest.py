@@ -73,6 +73,11 @@ def run_manifest(config=None, dataset=None, model=None,
         fields["jax_version"] = jax.__version__
         fields["process_index"] = jax.process_index()
         fields["process_count"] = jax.process_count()
+        # pin the clock tuple's proc for every later event: the env
+        # default (JAX_PROCESS_ID) is right under explicit launchers,
+        # but jax's own process_index is authoritative once known
+        from .events import set_clock_identity
+        set_clock_identity(proc=fields["process_index"])
         devs = jax.devices()
         fields["device_count"] = len(devs)
         fields["platform"] = devs[0].platform if devs else None

@@ -1,0 +1,338 @@
+"""Perf-regression sentinel: the automated eye on the BENCH trajectory.
+
+Five rounds of ``BENCH_r0*.json`` history sat on disk with nothing
+watching them — r01–r05 all burned chip deadline on the same
+compile-stall failure mode before a human noticed the pattern.  This
+module turns the trajectory into a gate: given the recorded rounds
+(and optionally a live run's metrics JSONL), it flags step-time /
+compile-time / overlap_frac excursions beyond noise and exits nonzero
+so CI, the bench probe preflight, and the measurement chains refuse
+to ship a silent regression.
+
+Detection is robust-statistics, with explicit small-sample rules:
+
+- ``n == 0`` history → ``no_history`` (pass: nothing to regress
+  against);
+- ``n < 3`` → a median exists but no spread estimate: flag only past
+  ``SMALL_SAMPLE_FACTOR``× the median (a 2× step-time regression
+  bites, round-over-round tunnel noise does not);
+- ``n >= 3`` → median + MAD: flag past
+  ``median + max(MAD_K * 1.4826 * MAD, REL_FLOOR * median)``
+  (the relative floor keeps a zero-spread history from flagging
+  measurement jitter).
+
+Step times only compare like with like: rounds whose recorded dtype
+differs from the current run's are excluded (a mixed-precision round
+is ~3× an fp32 one by design, not by regression).
+
+Stdlib-only *reader* (same contract as report.py/timeline.py): no
+backend, runs on artifacts from dead runs, works as a plain script on
+a box without jax.  ``python -m roc_tpu.sentinel`` is the packaged
+entry point; ``--json`` prints one machine-readable line for CI and
+the bench probe.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as _glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", ".."))
+
+BENCH_GLOB = "BENCH_r*.json"
+
+# n >= 3: flag past median + max(MAD_K * sigma, REL_FLOOR * median)
+MAD_K = 4.0
+REL_FLOOR = 0.25
+# n in {1, 2}: no spread estimate — flag only a gross excursion
+SMALL_SAMPLE_FACTOR = 1.5
+
+
+def _median(vals: List[float]) -> float:
+    vs = sorted(vals)
+    n = len(vs)
+    return vs[n // 2] if n % 2 else 0.5 * (vs[n // 2 - 1] + vs[n // 2])
+
+
+def detect(history: List[Optional[float]], current: Optional[float],
+           higher_is_better: bool = False,
+           mad_k: float = MAD_K, rel_floor: float = REL_FLOOR,
+           small_factor: float = SMALL_SAMPLE_FACTOR
+           ) -> Dict[str, Any]:
+    """One metric's verdict dict: ``verdict`` in {no_data, no_history,
+    ok, regression} plus the numbers behind it (median, bound, n)."""
+    out: Dict[str, Any] = {"current": current,
+                           "higher_is_better": higher_is_better}
+    if current is None:
+        out.update(verdict="no_data", n=0)
+        return out
+    hist = [float(v) for v in history
+            if isinstance(v, (int, float)) and v > 0]
+    out["n"] = len(hist)
+    if not hist:
+        out["verdict"] = "no_history"
+        return out
+    med = _median(hist)
+    out["median"] = round(med, 4)
+    if len(hist) < 3:
+        # small-sample rule: a median but no honest spread estimate
+        bound = (med / small_factor if higher_is_better
+                 else med * small_factor)
+        out["rule"] = f"small_sample_{small_factor}x"
+    else:
+        sigma = 1.4826 * _median([abs(v - med) for v in hist])
+        slack = max(mad_k * sigma, rel_floor * med)
+        bound = med - slack if higher_is_better else med + slack
+        out["rule"] = f"median_mad_k{mad_k:g}"
+        out["sigma"] = round(sigma, 4)
+    out["bound"] = round(bound, 4)
+    worse = (current < bound) if higher_is_better else (current > bound)
+    out["verdict"] = "regression" if worse else "ok"
+    return out
+
+
+# ------------------------------------------------- BENCH_*.json rounds
+
+def load_bench_round(path: str) -> Dict[str, Any]:
+    """One recorded round's comparable numbers.  Tolerates both the
+    driver wrapper shape (``{"parsed": {...}, "tail": ...}``) and a
+    bare headline line; missing metrics are None, never an error —
+    the r01–r04 all-null rounds are legitimate history."""
+    out: Dict[str, Any] = {"path": os.path.basename(path),
+                           "step_ms": None, "compile_s": None,
+                           "overlap_frac": None, "dtype": None,
+                           "stage": None}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return out
+    if not isinstance(doc, dict):
+        return out
+    parsed = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+        else doc
+    if not isinstance(parsed, dict):
+        return out
+    val = parsed.get("value")
+    if isinstance(val, (int, float)) and parsed.get("unit") == "ms":
+        out["step_ms"] = float(val)
+    out["dtype"] = parsed.get("dtype")
+    out["stage"] = parsed.get("stage")
+    stages = parsed.get("stages")
+    if isinstance(stages, dict):
+        for name in ("full", "small"):
+            st = stages.get(name)
+            if isinstance(st, dict) and \
+                    isinstance(st.get("compile_s"), (int, float)):
+                out["compile_s"] = float(st["compile_s"])
+                break
+        # streamed-tier overlap lives in the micro stage's
+        # stream:prefetch row (bench.py child_micro) — the prefetch
+        # row is the measured overlap; any other row with the field
+        # serves as fallback
+        micro = stages.get("micro")
+        impls = (micro.get("impls")
+                 if isinstance(micro, dict) else None)
+        if isinstance(impls, dict):
+            rows = [impls.get("stream:prefetch")] + list(impls.values())
+            for row in rows:
+                if isinstance(row, dict) and \
+                        isinstance(row.get("overlap_frac"),
+                                   (int, float)):
+                    out["overlap_frac"] = float(row["overlap_frac"])
+                    break
+    return out
+
+
+def bench_history(pattern: str) -> List[Dict[str, Any]]:
+    """Rounds matching ``pattern``, in filename (round) order."""
+    return [load_bench_round(p) for p in sorted(_glob.glob(pattern))]
+
+
+# ----------------------------------------------- metrics-JSONL current
+
+def _load_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Tolerant JSONL reader (duplicated from obs/timeline.py on
+    purpose: this module must run as a plain package-free script)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def metrics_summary(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """A live run's comparable numbers from its metrics JSONL: the
+    median steady ``epoch_ms`` (records that folded a compile lap in
+    are excluded), the worst ``compile_ms``, and the median
+    ``overlap_frac`` (streamed tiers only)."""
+    steady = [float(r["epoch_ms"]) for r in records
+              if isinstance(r.get("epoch_ms"), (int, float))
+              and r.get("compile_ms") is None]
+    compiles = [float(r["compile_ms"]) for r in records
+                if isinstance(r.get("compile_ms"), (int, float))]
+    overlap = [float(r["overlap_frac"]) for r in records
+               if isinstance(r.get("overlap_frac"), (int, float))]
+    return {
+        "step_ms": round(_median(steady), 3) if steady else None,
+        "compile_s": (round(max(compiles) / 1e3, 3)
+                      if compiles else None),
+        "overlap_frac": (round(_median(overlap), 4)
+                         if overlap else None),
+        "n_records": len(records),
+    }
+
+
+# ---------------------------------------------------------- the gate
+
+def check_run(rounds: List[Dict[str, Any]],
+              current: Dict[str, Any]) -> Dict[str, Any]:
+    """Hold ``current`` (step_ms / compile_s / overlap_frac, plus an
+    optional dtype for like-with-like filtering) against the recorded
+    rounds.  Returns ``{"checks": {...}, "regressed": [...],
+    "ok": bool}``."""
+    dtype = current.get("dtype")
+    step_hist = [r["step_ms"] for r in rounds
+                 if dtype is None or r.get("dtype") in (None, dtype)]
+    checks = {
+        "step_time_ms": detect(step_hist, current.get("step_ms")),
+        "compile_time_s": detect([r["compile_s"] for r in rounds],
+                                 current.get("compile_s")),
+        "overlap_frac": detect([r.get("overlap_frac") for r in rounds],
+                               current.get("overlap_frac"),
+                               higher_is_better=True),
+    }
+    regressed = [name for name, v in checks.items()
+                 if v["verdict"] == "regression"]
+    return {"checks": checks, "regressed": regressed,
+            "ok": not regressed,
+            "history_rounds": [r["path"] for r in rounds]}
+
+
+def bench_verdict(value_ms: Optional[float],
+                  dtype: Optional[str] = None,
+                  compile_s: Optional[float] = None,
+                  bench_dir: Optional[str] = None,
+                  stage: Optional[str] = None) -> Dict[str, Any]:
+    """Compact verdict for the bench headline line (bench.py records
+    it into BENCH_*.json): the live measurement vs the checked-in
+    round history.  ``stage`` filters the rounds like dtype does —
+    a small-stage epoch must never be scored against full-scale
+    history (or vice versa).  Import-light — the bench parent calls
+    this under its jax-free namespace stub."""
+    pattern = os.path.join(bench_dir or _REPO_ROOT, BENCH_GLOB)
+    rounds = [r for r in bench_history(pattern)
+              if stage is None or r.get("stage") in (None, stage)]
+    res = check_run(rounds,
+                    {"step_ms": value_ms, "compile_s": compile_s,
+                     "dtype": dtype})
+    step = res["checks"]["step_time_ms"]
+    out = {"verdict": step["verdict"], "n_history": step.get("n", 0)}
+    for k in ("median", "bound", "rule"):
+        if k in step:
+            out[k] = step[k]
+    if res["regressed"]:
+        out["regressed"] = res["regressed"]
+        out["verdict"] = "regression"
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="roc_tpu.sentinel", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--bench-glob", default=None,
+                    help="BENCH round files (default: "
+                         f"{BENCH_GLOB} in the repo root)")
+    ap.add_argument("--metrics", default=None,
+                    help="a live run's metrics JSONL: its steady "
+                         "epoch_ms / compile_ms / overlap_frac are "
+                         "the CURRENT numbers, checked against the "
+                         "whole BENCH history")
+    ap.add_argument("--dtype", default=None,
+                    help="dtype of the current numbers (step-time "
+                         "history is filtered to matching rounds; "
+                         "default: the newest round's recorded dtype "
+                         "in trajectory mode)")
+    ap.add_argument("--json", action="store_true",
+                    help="one machine-readable JSON line on stdout "
+                         "(CI / bench-probe preflight)")
+    args = ap.parse_args(argv)
+
+    pattern = args.bench_glob or os.path.join(_REPO_ROOT, BENCH_GLOB)
+    rounds = bench_history(pattern)
+    mode = "trajectory"
+    if args.metrics:
+        # live-run mode: the metrics file is current, every round is
+        # history
+        try:
+            recs = _load_jsonl(args.metrics)
+        except OSError as e:
+            print(f"error: cannot read {args.metrics}: {e}",
+                  file=sys.stderr)
+            return 2
+        current = metrics_summary(recs)
+        current["dtype"] = args.dtype
+        history = rounds
+        mode = "metrics"
+    else:
+        # trajectory mode: the NEWEST round with any data is current,
+        # prior rounds are history — the post-landing CI shape
+        cur_idx = None
+        for i in range(len(rounds) - 1, -1, -1):
+            if any(rounds[i][k] is not None
+                   for k in ("step_ms", "compile_s", "overlap_frac")):
+                cur_idx = i
+                break
+        if cur_idx is None:
+            payload = {"mode": mode, "ok": True,
+                       "verdict": "no_data",
+                       "rounds": [r["path"] for r in rounds]}
+            print(json.dumps(payload) if args.json else
+                  f"sentinel: no measurable rounds in {pattern} — "
+                  f"nothing to gate")
+            return 0
+        cur = rounds[cur_idx]
+        current = {"step_ms": cur["step_ms"],
+                   "compile_s": cur["compile_s"],
+                   "overlap_frac": cur.get("overlap_frac"),
+                   "dtype": args.dtype or cur.get("dtype"),
+                   "round": cur["path"]}
+        history = rounds[:cur_idx]
+
+    res = check_run(history, current)
+    payload = {"mode": mode, "current": current, **res}
+    if args.json:
+        print(json.dumps(payload))
+    else:
+        print(f"sentinel ({mode}): current="
+              + " ".join(f"{k}={current.get(k)}"
+                         for k in ("step_ms", "compile_s",
+                                   "overlap_frac", "round")
+                         if current.get(k) is not None))
+        for name, v in res["checks"].items():
+            extra = "".join(
+                f" {k}={v[k]}" for k in ("median", "bound", "n",
+                                         "rule") if k in v)
+            print(f"  {name}: {v['verdict']}{extra}")
+        print("sentinel: "
+              + ("OK — no regression beyond noise" if res["ok"] else
+                 f"REGRESSION in {', '.join(res['regressed'])}"))
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -712,7 +712,11 @@ class DistributedTrainer:
                             "partition": self._partition_stats},
                      console=config.verbose)
         from ..utils.profiling import EpochTimer, MetricsLog
-        self.timer = EpochTimer()
+        # annotate=True routes every phase span through
+        # jax.profiler.TraceAnnotation so --profile-dir device
+        # traces carry the same named phases as the timeline lanes
+        self.timer = EpochTimer(
+            annotate=bool(config.profile_dir))
         self.metrics_log = MetricsLog(config.metrics_path)
 
     def _build_data(self, pg) -> ShardedData:
@@ -823,6 +827,41 @@ class DistributedTrainer:
             self._phi_cache = phi_matrix(
                 self.pg, bd_occupancy=self.data.bd_occupancy)
         return self._phi_cache
+
+    def straggler_fields(self, m: Dict[str, float]) -> Dict[str, float]:
+        """Per-epoch straggler attribution (run_epoch_loop folds this
+        into every eval'd metrics record): which shard the partition
+        cost model predicts slowest for the measured lap, and by how
+        much over the mean — the SAME attribution
+        :meth:`maybe_rebalance`'s ridge observation consumes (under
+        lockstep SPMD only the straggler's time is observable, PR-5
+        cost model).  Emits a ``costmodel`` straggler event with the
+        full predicted per-shard cost vector so the merged timeline
+        (obs/timeline.py) can render per-epoch attribution markers."""
+        t = (m.get("epoch_ms")
+             if m.get("compile_ms") is None else None)
+        if not t:
+            # a record that folded the compile lap in would attribute
+            # compile seconds to a shard — same skip rule as the
+            # rebalance observation below
+            return {}
+        # _phi() is the init-cached matrix (_emit_partition_stats pays
+        # the O(E) feature pass once per split, rebalance on or off);
+        # predict is a P x n_features dot — per-eval cost is trivial
+        pred = self._costmodel.predict(self._phi())
+        p = int(np.argmax(pred))
+        mean = float(np.mean(pred))
+        ratio = round(float(pred[p]) / mean, 4) if mean > 0 else None
+        out: Dict[str, float] = {"straggler_part": p,
+                                 "straggler_ratio": ratio}
+        emit("costmodel",
+             f"straggler: epoch {m.get('epoch')} lap {t:.1f} ms -> "
+             f"part {p} (predicted {ratio}x the {self.pg.num_parts}-"
+             f"shard mean)", console=False, kind="straggler",
+             epoch=m.get("epoch"), measured_ms=float(t),
+             num_parts=self.pg.num_parts,
+             predicted_cost=[round(float(c), 3) for c in pred], **out)
+        return out
 
     def maybe_rebalance(self, m: Dict[str, float]) -> bool:
         """Epoch-boundary rebalancing hook (run_epoch_loop calls this

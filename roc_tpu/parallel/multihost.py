@@ -79,6 +79,15 @@ def init_distributed(coordinator_address: Optional[str] = None,
         os.environ.get("JAX_NUM_PROCESSES", "1"))
     process_id = process_id if process_id is not None else int(
         os.environ.get("JAX_PROCESS_ID", "0"))
+    # pin the event-clock identity the moment the process id is known:
+    # trainer-setup events (partition stats, plan echoes) fire BEFORE
+    # the run manifest's own set_clock_identity, and a launcher that
+    # passes process_id programmatically (this function's argv path)
+    # never exported JAX_PROCESS_ID — without this, every process's
+    # early events would stamp proc=0 and mis-lane in the merged
+    # timeline
+    from ..obs.events import set_clock_identity
+    set_clock_identity(proc=process_id)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

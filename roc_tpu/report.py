@@ -1,6 +1,14 @@
-"""Run-report CLI: summarize an event/metrics JSONL into tables.
+"""Run-report CLI: summarize event/metrics JSONL artifacts into tables.
 
-``python -m roc_tpu.report run_events.jsonl [--metrics m.jsonl]``
+``python -m roc_tpu.report ev.jsonl [ev_p1.jsonl ...|'ev_p*.jsonl']
+[--metrics m.jsonl [--metrics m2.jsonl ...]]``
+
+Accepts MULTIPLE event files (repeat the positional, or pass a glob) —
+a multi-process run writes one JSONL per process, and the report
+merges them instead of silently assuming one stream (each record's
+clock tuple ``host``/``proc`` identifies its stream; a "processes"
+header shows what was merged).  For a merged *timeline* view of the
+same artifacts use ``python -m roc_tpu.timeline``.
 
 Renders, from the artifacts a run with ``--events``/``--metrics``
 leaves behind:
@@ -80,10 +88,31 @@ def _rows(title: str, header: List[str],
               file=out)
 
 
+def _stream_key(rec: Dict[str, Any]):
+    try:
+        proc = int(rec.get("proc", 0) or 0)
+    except (TypeError, ValueError):
+        proc = 0
+    return (str(rec.get("host", "?")), proc)
+
+
 def summarize(events: List[Dict[str, Any]],
               metrics: Optional[List[Dict[str, Any]]] = None,
               out=None) -> int:
     out = out if out is not None else sys.stdout
+
+    # merged multi-process artifacts: one JSONL per process, each
+    # record stamped with its (host, proc) clock identity — say what
+    # was merged before aggregating across it
+    streams: Dict[Any, int] = {}
+    for e in events:
+        k = _stream_key(e)
+        streams[k] = streams.get(k, 0) + 1
+    if len(streams) > 1:
+        print("processes (merged event streams):", file=out)
+        for (host, proc), n in sorted(streams.items(),
+                                      key=lambda kv: kv[0][1]):
+            print(f"  proc{proc}@{host}: {n} events", file=out)
 
     manifests = [e for e in events if e.get("cat") == "manifest"]
     if manifests:
@@ -274,30 +303,59 @@ def summarize(events: List[Dict[str, Any]],
     return 0
 
 
+def _expand(patterns: List[str]) -> List[str]:
+    """Literal paths plus glob patterns, deduped, order-preserving;
+    a missing path / zero-match glob is KEPT so the open() below
+    fails loudly.  Duplicated from obs/timeline.py expand_paths on
+    purpose: this module deliberately has no package-relative imports
+    (plain-script mode on boxes without jax, see module docstring) —
+    keep the two behaviors in lockstep."""
+    import glob as _glob
+    import os
+    out: List[str] = []
+    for p in patterns:
+        hits = [p] if os.path.exists(p) else sorted(_glob.glob(p))
+        for h in (hits or [p]):
+            if h not in out:
+                out.append(h)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="roc_tpu.report", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("events", help="event-log JSONL (--events / "
-                                   "ROC_TPU_EVENTS artifact)")
-    ap.add_argument("--metrics", default=None,
+    ap.add_argument("events", nargs="+",
+                    help="event-log JSONL file(s) (--events / "
+                         "ROC_TPU_EVENTS artifacts; repeat or glob "
+                         "for multi-process runs — one file per "
+                         "process)")
+    ap.add_argument("--metrics", action="append", default=None,
                     help="training metrics JSONL (--metrics artifact) "
-                         "to fold into the span/throughput tables")
+                         "to fold into the span/throughput tables; "
+                         "repeatable for multi-process runs")
     args = ap.parse_args(argv)
-    try:
-        events = load_jsonl(args.events)
-    except OSError as e:
-        print(f"error: cannot read {args.events}: {e}",
-              file=sys.stderr)
-        return 2
+    events: List[Dict[str, Any]] = []
+    for path in _expand(args.events):
+        try:
+            events.extend(load_jsonl(path))
+        except OSError as e:
+            print(f"error: cannot read {path}: {e}", file=sys.stderr)
+            return 2
+    # merged streams interleave by wall clock so "last manifest" and
+    # span ordering stay meaningful (stable: unstamped records keep
+    # their file order)
+    events.sort(key=lambda e: float(e.get("t") or 0.0))
     metrics = None
     if args.metrics:
-        try:
-            metrics = load_jsonl(args.metrics)
-        except OSError as e:
-            print(f"error: cannot read {args.metrics}: {e}",
-                  file=sys.stderr)
-            return 2
+        metrics = []
+        for path in _expand(args.metrics):
+            try:
+                metrics.extend(load_jsonl(path))
+            except OSError as e:
+                print(f"error: cannot read {path}: {e}",
+                      file=sys.stderr)
+                return 2
     return summarize(events, metrics)
 
 

@@ -142,10 +142,14 @@ def _proc_ok(spec: FaultSpec) -> bool:
 def _fire(spec: FaultSpec, detail: str, **fields) -> None:
     """Mark the fault spent and leave a dated resilience event BEFORE
     acting — a SIGKILL site must still be attributable from the JSONL
-    artifact alone."""
+    artifact alone.  The crash flight recorder dumps here too: a
+    killed process's last telemetry window (this fault event last)
+    survives even when no JSONL sink was configured."""
     spec.fired = True
     emit("resilience", f"fault injected: {spec.spec_str()} — {detail}",
          kind="fault", site=spec.site, epoch=spec.epoch, **fields)
+    from ..obs.events import dump_flight_record
+    dump_flight_record(f"fault:{spec.site}")
 
 
 def _ready(site: str, epoch: Optional[int] = None, *,

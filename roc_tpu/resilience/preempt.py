@@ -127,6 +127,12 @@ def raise_if_preempted(epoch: Optional[int] = None) -> None:
     if requested():
         sig = _GUARD.signum
         name = signal.Signals(sig).name if sig is not None else "?"
+        # flight recorder: the grace window may not survive to a clean
+        # exit (the scheduler's SIGKILL follows), so the telemetry ring
+        # is persisted at the boundary, from normal control flow — the
+        # signal handler itself stays flag-only
+        from ..obs.events import dump_flight_record
+        dump_flight_record(f"preempted:{name}")
         raise Preempted(
             f"{name} received"
             + (f" (epoch {epoch} step completed)" if epoch is not None

@@ -1,0 +1,19 @@
+"""``python -m roc_tpu.sentinel`` — perf-regression gate over the
+BENCH_*.json trajectory (and a live run's metrics JSONL).
+
+Thin packaged entry point over :mod:`roc_tpu.obs.sentinel` (which is
+stdlib-only and also runs as a plain script on a box without jax:
+``python roc_tpu/obs/sentinel.py ...``).  Exits nonzero on a
+regression beyond noise; ``--json`` prints one machine-readable line
+for CI and the bench probe preflight.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .obs.sentinel import (bench_history, bench_verdict,  # noqa: F401
+                           check_run, detect, main, metrics_summary)
+
+if __name__ == "__main__":
+    sys.exit(main())

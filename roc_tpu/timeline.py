@@ -1,0 +1,18 @@
+"""``python -m roc_tpu.timeline`` — merge N per-process event/metrics
+JSONL streams into one Perfetto-loadable Chrome-trace JSON.
+
+Thin packaged entry point over :mod:`roc_tpu.obs.timeline` (which is
+stdlib-only and also runs as a plain script on a box without jax:
+``python roc_tpu/obs/timeline.py ...`` — importing the ``roc_tpu``
+package pulls jax in on the way, exactly like ``roc_tpu.report``).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from .obs.timeline import (clock_offsets, expand_paths,  # noqa: F401
+                           main, merge_timeline, straggler_records)
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -253,7 +253,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
 
 def main(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
-    from ..obs.events import emit
+    from ..obs.events import emit, install_excepthook
+    # crash flight recorder: an unhandled exception dumps the last
+    # telemetry window (obs/events.py ring buffer) before the
+    # traceback — dead runs stop taking their evidence with them
+    install_excepthook()
     if args.events:
         # env too, so worker/child processes join the same artifact
         import os
@@ -508,8 +512,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.profile_dir:
         trainer.train(epochs=1)  # compile outside the trace
-        with jax.profiler.trace(args.profile_dir):
-            trainer.train(epochs=1)
+        # phase spans route through jax.profiler.TraceAnnotation for
+        # the traced epoch (utils/profiling.py EpochTimer.annotate),
+        # so the XLA device trace carries the same named phases as
+        # the host timeline lanes.  The CLI owns the toggle here: it
+        # never sets TrainConfig.profile_dir (run_epoch_loop would
+        # start a SECOND nested profiler trace), so the constructor's
+        # annotate-arming path does not apply and the flag is scoped
+        # to exactly the traced epoch
+        trainer.timer.annotate = True
+        try:
+            with jax.profiler.trace(args.profile_dir):
+                trainer.train(epochs=1)
+        finally:
+            trainer.timer.annotate = False
         emit("run", f"profile written to {args.profile_dir}",
              path=args.profile_dir)
 

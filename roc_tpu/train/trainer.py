@@ -968,7 +968,11 @@ class Trainer:
                      extra={"modeled_step_bytes": self._modeled_bytes},
                      console=config.verbose)
         from ..utils.profiling import EpochTimer, MetricsLog
-        self.timer = EpochTimer()
+        # annotate=True routes every phase span through
+        # jax.profiler.TraceAnnotation so --profile-dir device
+        # traces carry the same named phases as the timeline lanes
+        self.timer = EpochTimer(
+            annotate=bool(config.profile_dir))
         self.metrics_log = MetricsLog(config.metrics_path)
 
     def _train_step_impl(self, params, opt_state, key, lr, feats,
@@ -1066,6 +1070,14 @@ class Trainer:
             stats["wait_ms"])
         self.timer.spans_ms.setdefault("h2d_stage", []).extend(
             stats["stage_ms"])
+        # per-block records for the timeline merger's h2d lane (the
+        # pool stamps monotonic starts alongside each series)
+        self.timer.timeline.extend(
+            ("h2d_wait", t0, ms) for t0, ms in
+            zip(stats["wait_t0"], stats["wait_ms"]))
+        self.timer.timeline.extend(
+            ("h2d_stage", t0, ms) for t0, ms in
+            zip(stats["stage_t0"], stats["stage_ms"]))
         out: Dict[str, float] = {
             "prefetch_depth": int(stats["depth"]),
             "h2d_wait_p50_ms": stats["wait_p50_ms"],
@@ -1192,13 +1204,25 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                     now = time.perf_counter()
                     compile_ms = (now - t_last) * 1e3
                     tr.timer.laps_ms.append(compile_ms)
-                    tr.timer.spans_ms.setdefault("compile", []).append(
-                        compile_ms)
+                    tr.timer.note_span("compile", compile_ms)
+                    # clock-sync handshake, piggybacked on the barrier
+                    # just crossed: every SPMD process passes the first
+                    # step's collective within one step of each other,
+                    # so the merger (obs/timeline.py) aligns the
+                    # per-process monotonic clocks on this event's
+                    # (wall, mono) pair — N per-process JSONL streams
+                    # become one time axis
+                    emit("timeline",
+                         f"clock_sync: first-step barrier crossed "
+                         f"(epoch {epoch})", console=False,
+                         kind="clock_sync", epoch=epoch,
+                         compile_ms=round(compile_ms, 1))
                     t_last, e_last = now, tr.epoch + 1
                     compiled = tr._loop_compiled = True
                 if epoch % cfg.eval_every == cfg.eval_every - 1:
                     tr.sync()
                     now = time.perf_counter()
+                    mono_now = time.monotonic()
                     m = do_eval()
                     t_eval_end = time.perf_counter()
                     m["epoch"] = epoch
@@ -1214,9 +1238,15 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                         tr.timer.laps_ms.append(m["epoch_ms"])
                         tr.timer.spans_ms.setdefault(
                             "train", []).append(m["epoch_ms"])
+                        # timeline lane: the whole steady burst as ONE
+                        # span (per-epoch steps dispatch async and
+                        # have no individual host-visible boundaries)
+                        burst_ms = (now - t_last) * 1e3
+                        tr.timer.timeline.append(
+                            ("train", mono_now - burst_ms / 1e3,
+                             burst_ms))
                     m["eval_ms"] = (t_eval_end - now) * 1e3
-                    tr.timer.spans_ms.setdefault("eval", []).append(
-                        m["eval_ms"])
+                    tr.timer.note_span("eval", m["eval_ms"])
                     if compile_ms is not None:
                         m["compile_ms"] = compile_ms
                         compile_ms = None
@@ -1228,9 +1258,27 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                     pipe = getattr(tr, "pipeline_fields", None)
                     if pipe is not None:
                         m.update(pipe() or {})
+                    # per-epoch straggler attribution (distributed
+                    # trainers): which shard the cost model predicts
+                    # slowest for the measured lap, by how much — the
+                    # SAME record maybe_rebalance's ridge observation
+                    # consumes, now on every eval'd record and in the
+                    # merged timeline
+                    sf = getattr(tr, "straggler_fields", None)
+                    if sf is not None:
+                        m.update(sf(m) or {})
                     t_last, e_last = t_eval_end, tr.epoch + 1
                     history.append(m)
                     tr.metrics_log.log(m)
+                    # flush span laps for the timeline merger: one
+                    # compact event per eval instead of one per span
+                    tl = tr.timer.take_timeline()
+                    if tl:
+                        emit("timeline",
+                             f"spans: {len(tl)} laps to epoch {epoch}",
+                             console=False, kind="spans", epoch=epoch,
+                             spans=[[n, round(t0, 6), round(ms, 3)]
+                                    for n, t0, ms in tl])
                     # epoch-boundary load rebalancing (distributed
                     # trainers with config.rebalance): feed the
                     # measured lap to the partition cost model and
@@ -1261,6 +1309,14 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
         # bound fds across many trainers — on exceptions too; the log
         # lazily reopens in append mode if train() is called again
         tr.metrics_log.close()
+        tl = tr.timer.take_timeline()
+        if tl:
+            # span laps accumulated since the last eval flush (a run
+            # dying between evals must not take them along)
+            emit("timeline", f"spans: {len(tl)} laps (final)",
+                 console=False, kind="spans",
+                 spans=[[n, round(t0, 6), round(ms, 3)]
+                        for n, t0, ms in tl])
         if tr.timer.spans_ms:
             emit("epoch", "phase spans "
                  + " ".join(f"{k}:n={v['n']},p50={v['p50_ms']:.1f}ms"

@@ -83,6 +83,15 @@ class EpochTimer:
     warmup: int = 1
     laps_ms: List[float] = field(default_factory=list)
     spans_ms: Dict[str, List[float]] = field(default_factory=dict)
+    # span-lap records for the cross-process timeline merger
+    # (obs/timeline.py): ``(name, mono_start_s, dur_ms)`` per lap,
+    # drained by :meth:`take_timeline` into periodic ``timeline``
+    # events (train/trainer.py run_epoch_loop)
+    timeline: List[tuple] = field(default_factory=list)
+    # route spans through jax.profiler.TraceAnnotation too, so device
+    # traces (--profile-dir) carry the same named phases as the host
+    # timeline lanes; off by default (annotate imports jax)
+    annotate: bool = False
     _t0: Optional[float] = None
 
     def start(self) -> None:
@@ -115,15 +124,45 @@ class EpochTimer:
         end-of-phase mark for the span's own work.  The fetch-based
         :func:`sync` is used either way (the only honest barrier under
         the relay).  Independent of the epoch lap state: spans may nest
-        inside or across :meth:`lap` regions."""
+        inside or across :meth:`lap` regions.
+
+        With :attr:`annotate` set, the span body also runs inside a
+        ``jax.profiler.TraceAnnotation`` of the same name, so a
+        ``--profile-dir`` device trace carries the phases the host
+        timeline shows (the merged-timeline lanes and the XLA trace
+        line up by name)."""
+        ann = annotate(name) if self.annotate else None
+        if ann is not None:
+            ann.__enter__()
+        mono0 = time.monotonic()
         t0 = time.perf_counter()
         try:
             yield
         finally:
             if sync_on is not None:
                 sync(sync_on() if callable(sync_on) else sync_on)
-            self.spans_ms.setdefault(name, []).append(
-                (time.perf_counter() - t0) * 1e3)
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            ms = (time.perf_counter() - t0) * 1e3
+            self.spans_ms.setdefault(name, []).append(ms)
+            self.timeline.append((name, mono0, ms))
+
+    def note_span(self, name: str, dur_ms: float,
+                  mono_end: Optional[float] = None) -> None:
+        """Record a span lap measured OUTSIDE :meth:`span` (the epoch
+        loop's compile/train/eval laps, the staging pool's per-block
+        waits): appends to both the p50/p90 series and the timeline
+        records, with the start back-derived from ``mono_end``."""
+        if mono_end is None:
+            mono_end = time.monotonic()
+        self.spans_ms.setdefault(name, []).append(dur_ms)
+        self.timeline.append((name, mono_end - dur_ms / 1e3, dur_ms))
+
+    def take_timeline(self) -> List[tuple]:
+        """Drain the accumulated timeline span records (the epoch loop
+        flushes them into one ``timeline`` event per eval)."""
+        out, self.timeline = self.timeline, []
+        return out
 
     def summary(self) -> Dict[str, float]:
         steady = self.laps_ms[self.warmup:] or self.laps_ms
@@ -171,6 +210,15 @@ class MetricsLog:
         rec = {k: (float(v) if isinstance(v, (int, float, np.floating,
                                               np.integer)) else v)
                for k, v in record.items()}
+        # clock tuple (obs/events.py): metrics records merge into the
+        # same cross-process timeline as the event streams, so they
+        # carry the same (wall, monotonic, host, proc) stamps — never
+        # overriding fields the caller measured itself
+        from ..obs.events import clock_identity
+        rec.setdefault("t", round(time.time(), 3))
+        rec.setdefault("mono", round(time.monotonic(), 6))
+        for k, v in clock_identity().items():
+            rec.setdefault(k, v)
         self.records.append(rec)
         if self.path:
             if self._fh is None:

@@ -27,6 +27,13 @@ python -m roc_tpu.analysis --json \
 #    exclusively, so parallel prewarm children would fail backend
 #    init (sequential children each claim and release it)
 python -m roc_tpu.prewarm --config all || exit 1
+#    perf-regression sentinel over the recorded BENCH trajectory
+#    (roc_tpu/obs/sentinel.py): refuse to burn chip deadline when the
+#    newest recorded round already regressed step/compile time beyond
+#    noise — the r01-r05 pattern a human had to notice is a gate now.
+#    The live run's own verdict is recorded by bench.py into this
+#    round's headline line ("sentinel" field).
+python -m roc_tpu.sentinel --json || exit 1
 # 1. staged headline refresh (regression guard before the new rows)
 python bench.py
 # 2. fused vs chain micro race, UNIFORM substrate, Reddit V/E

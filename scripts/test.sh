@@ -14,6 +14,11 @@ shift 6 || true
 # it grew) — a PR that adds a compiled-program shape shows it before
 # the test tier starts.
 python -m roc_tpu.analysis --strict
+# perf-regression sentinel preflight: median+MAD gate over the
+# checked-in BENCH_*.json trajectory (roc_tpu/obs/sentinel.py) — a
+# round that regressed step/compile time beyond noise fails HERE,
+# before chip time is spent (set -e makes the nonzero exit fatal)
+python -m roc_tpu.sentinel --json
 exec python -m roc_tpu.train.cli \
     -lr "$LR" -decay "$WD" -decay-rate "$DR" -dropout "$DROP" \
     -layers "$LAYERS" -e "$EPOCHS" -file dataset/reddit-dgl "$@"

@@ -2,8 +2,14 @@
 initializes, so sharding tests run anywhere (SURVEY.md §4 test plan)."""
 
 import os
+import tempfile
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+# crash-flight-recorder dumps (obs/events.py) from in-process tests
+# must never land in the repo root: pin the dump dir to a scratch
+# location unless a test overrides it
+os.environ.setdefault(
+    "ROC_TPU_FLIGHT_DIR", tempfile.mkdtemp(prefix="roc_flight_"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -156,6 +156,43 @@ def test_swallowed_exception_fires_and_allows(tmp_path):
         ("swallowed-exception", "roc_tpu/resilience/rec.py", 9)]
 
 
+def test_event_clock_fires_and_allows(tmp_path):
+    """event-clock: hand-passed reserved clock kwargs on emit() and
+    hand-rolled event dicts (cat+msg literals) both fire; normal emit
+    calls, non-event dicts, the bus module itself, and the pragma are
+    all clean."""
+    code = ("from roc_tpu.obs.events import emit\n"
+            "def f(bus):\n"
+            "    emit('epoch', 'ok', epoch=1)\n"            # clean
+            "    emit('epoch', 'bad', t=123.0)\n"           # line 4
+            "    bus.emit('run', 'bad2', proc=3, host='h')\n"  # line 5
+            "    rec = {'cat': 'epoch', 'msg': 'handrolled'}\n"  # 6
+            "    ok = {'cat': 'span'}\n"                    # clean
+            "    ok2 = {'msg': 'x', 'name': 'y'}\n"         # clean
+            "    emit('epoch', 'sup', t=1.0)  "
+            "# why: roc-lint: ok=event-clock\n"
+            "    return rec, ok, ok2\n")
+    _plant(tmp_path, "roc_tpu/train/mod.py", code)
+    # the bus module itself legitimately builds the stamped record
+    _plant(tmp_path, "roc_tpu/obs/events.py",
+           "def emit(cat, msg, **f):\n"
+           "    return {'t': 0.0, 'cat': cat, 'msg': msg, **f}\n")
+    got = run_ast_lint(str(tmp_path), select=["event-clock"])
+    assert [(f.rule, f.unit, f.line) for f in got] == [
+        ("event-clock", "roc_tpu/train/mod.py", 4),
+        ("event-clock", "roc_tpu/train/mod.py", 5),
+        ("event-clock", "roc_tpu/train/mod.py", 6)]
+
+
+def test_event_clock_registered_and_tree_clean():
+    from roc_tpu.analysis.driver import all_rule_names, is_trace_rule
+    assert "event-clock" in all_rule_names()
+    assert not is_trace_rule("event-clock")
+    # ratchet bites from zero on the real tree: no unbaselined finding
+    got = run_ast_lint(_REPO, select=["event-clock"])
+    assert got == [], [(f.unit, f.line, f.msg) for f in got]
+
+
 # ----------------------------------------------------- jaxpr fixtures
 
 def _unit(fn, *args, name="fix", **ctx):

@@ -116,6 +116,58 @@ def test_promotes_in_round_stage_record_when_all_stages_fail(tmp_path):
     assert line["live_errors"]  # the real failure is still on record
 
 
+def test_stderr_dedupe_filter(capsys):
+    """Satellite: repeated identical third-party stderr warning lines
+    (re-dated across probe attempts — the r05 tail was 5x the same
+    'Platform axon is experimental' warning) forward once plus a
+    dedup note; this repo's own '# ' diagnostic lines NEVER dedupe
+    (heartbeats and retry notes are the evidence the tail exists
+    for)."""
+    import io
+    sys.path.insert(0, _REPO)
+    import bench
+    bench._STDERR_SEEN.clear()
+    warn = ("WARNING:2026-07-31 19:%02d:54,854:jax._src.xla_bridge:905:"
+            " Platform 'axon' is experimental and not all JAX "
+            "functionality may be correctly supported!")
+    tb = ["Traceback (most recent call last):",
+          '  File "bench.py", line 123, in run_child',
+          "ValueError: shape (8, 3)"]
+    lines = [warn % 41, "# stage probe: timeout after 150s (150.1s)",
+             warn % 45, warn % 48, warn % 52,
+             "# stage probe: timeout after 150s (150.1s)"] + tb + tb
+    counts = {}
+    bench._forward_stderr(io.StringIO("\n".join(lines) + "\n"), counts)
+    err = capsys.readouterr().err
+    assert err.count("Platform 'axon' is experimental") == 2
+    assert "# [stderr dedup] repeat suppressed" in err
+    assert counts["suppressed"] == 3
+    assert err.count("# stage probe: timeout after 150s") == 2
+    # tracebacks/error text are NOT dedupe-eligible: two crashes that
+    # share normalized frame lines must both arrive whole
+    for line in tb:
+        assert err.count(line) == 2, line
+        assert bench._dedup_key(line) is None
+    # normalization: differing timestamps of one warning still dedupe
+    assert bench._dedup_key(warn % 41) == bench._dedup_key(warn % 45)
+    assert bench._dedup_key("# ours (12s)") is None
+    bench._STDERR_SEEN.clear()
+
+
+@pytest.mark.slow
+def test_small_stage_records_sentinel_verdict(tmp_path):
+    """The bench headline line carries the regression-sentinel verdict
+    (roc_tpu/obs/sentinel.py) so every BENCH_*.json round records its
+    own check against the trajectory."""
+    r = _run(["--cpu", "--stages", "small", "--epochs", "2"],
+             art_dir=str(tmp_path))
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = _last_json(r.stdout)
+    assert "sentinel" in line, line
+    assert line["sentinel"]["verdict"] in ("ok", "no_history",
+                                           "regression")
+
+
 def test_cpu_run_never_promotes(tmp_path):
     """--cpu failures are local bugs, not tunnel weather: the null
     contract line must survive even with promotable records on disk."""

@@ -57,6 +57,49 @@ def test_epoch_timer_span_syncs_on_device_array():
     assert len(t.spans_ms["dispatch"]) == 1
 
 
+def test_epoch_timer_timeline_records_and_drain():
+    """Span laps accumulate (name, mono_start, dur_ms) records for the
+    timeline merger; take_timeline drains them."""
+    t = EpochTimer()
+    with t.span("train"):
+        pass
+    t.note_span("compile", 120.0)
+    tl = t.take_timeline()
+    assert [x[0] for x in tl] == ["train", "compile"]
+    assert all(len(x) == 3 and x[1] > 0 and x[2] >= 0 for x in tl)
+    # compile's start is back-derived from its duration
+    assert tl[1][2] == 120.0
+    assert t.take_timeline() == []   # drained
+    # spans_ms got both laps too (the p50/p90 series is unchanged)
+    assert set(t.spans_ms) == {"train", "compile"}
+
+
+def test_epoch_timer_annotate_routes_through_trace_annotation():
+    """annotate=True wraps each span in jax.profiler.TraceAnnotation
+    (a no-op outside an active profiler session — but it must not
+    break the span bookkeeping), so --profile-dir device traces carry
+    the same phase names as the host timeline."""
+    t = EpochTimer(annotate=True)
+    with t.span("head_forward"):
+        pass
+    assert t.spans_ms["head_forward"][0] >= 0.0
+    assert t.take_timeline()[0][0] == "head_forward"
+
+
+def test_trainer_profile_dir_arms_span_annotation(tmp_path):
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.train.trainer import TrainConfig, Trainer
+    ds = synthetic_dataset(64, 6, in_dim=8, num_classes=3, seed=0)
+    tr = Trainer(build_gcn([8, 8, 3]), ds,
+                 TrainConfig(verbose=False, symmetric=True,
+                             profile_dir=str(tmp_path / "prof")))
+    assert tr.timer.annotate is True
+    tr2 = Trainer(build_gcn([8, 8, 3]), ds,
+                  TrainConfig(verbose=False, symmetric=True))
+    assert tr2.timer.annotate is False
+
+
 def test_sync_fetches():
     import jax.numpy as jnp
     sync({"a": jnp.ones((3, 3))})  # must not raise

@@ -1,0 +1,216 @@
+"""Perf-regression sentinel (roc_tpu/obs/sentinel.py): median+MAD
+gate over the BENCH_*.json trajectory, small-sample rules, metrics-
+JSONL mode, and the bench.py headline verdict."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+from roc_tpu.obs.sentinel import (bench_history, bench_verdict,
+                                  check_run, detect, metrics_summary)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- detect()
+
+def test_detect_no_data_and_no_history():
+    assert detect([], None)["verdict"] == "no_data"
+    assert detect([], 100.0)["verdict"] == "no_history"
+    assert detect([None, None], 100.0)["verdict"] == "no_history"
+
+
+def test_detect_median_mad_lower_better():
+    hist = [100.0, 102.0, 98.0, 101.0]
+    # rel floor dominates the tiny MAD: bound = 100.5 * 1.25
+    assert detect(hist, 110.0)["verdict"] == "ok"
+    v = detect(hist, 140.0)
+    assert v["verdict"] == "regression"
+    assert v["rule"].startswith("median_mad")
+    assert v["n"] == 4 and v["median"] == 100.5
+
+
+def test_detect_mad_scales_with_noise():
+    """A noisy history widens the bound: the same excursion that bites
+    on a tight history passes on a loose one."""
+    tight = [100.0, 101.0, 99.0, 100.0, 100.5]
+    loose = [100.0, 160.0, 60.0, 140.0, 80.0]
+    assert detect(tight, 140.0)["verdict"] == "regression"
+    assert detect(loose, 140.0)["verdict"] == "ok"
+
+
+def test_detect_small_sample_rule():
+    """n < 3: only a gross excursion (> 1.5x the median) flags — a
+    synthetic 2x step-time regression bites, round noise does not."""
+    v = detect([2362.64], 2362.64 * 2)
+    assert v["verdict"] == "regression"
+    assert v["rule"].startswith("small_sample")
+    assert detect([2362.64], 2362.64 * 1.3)["verdict"] == "ok"
+    assert detect([100.0, 104.0], 300.0)["verdict"] == "regression"
+
+
+def test_detect_higher_is_better():
+    hist = [0.60, 0.59, 0.61]
+    assert detect(hist, 0.55, higher_is_better=True)["verdict"] == "ok"
+    assert detect(hist, 0.20,
+                  higher_is_better=True)["verdict"] == "regression"
+    v = detect([0.6], 0.2, higher_is_better=True)
+    assert v["verdict"] == "regression"   # small-sample, higher-better
+
+
+# -------------------------------------------------- BENCH round loading
+
+def test_bench_history_loads_checked_in_rounds():
+    rounds = bench_history(os.path.join(_REPO, "BENCH_r*.json"))
+    assert len(rounds) >= 5
+    by_name = {r["path"]: r for r in rounds}
+    # r01-r04 are legitimate all-null history; r05 carries the headline
+    assert by_name["BENCH_r01.json"]["step_ms"] is None
+    assert by_name["BENCH_r05.json"]["step_ms"] == 2362.64
+    assert by_name["BENCH_r05.json"]["dtype"] == "mixed"
+
+
+def test_bench_round_extracts_overlap_frac(tmp_path):
+    """The overlap_frac gate has real history to work with: the micro
+    stage's stream:prefetch row is extracted from each round, and a
+    collapsed overlap regresses."""
+    from roc_tpu.obs.sentinel import load_bench_round
+    doc = {"parsed": {"value": 100.0, "unit": "ms", "stages": {
+        "micro": {"impls": {
+            "ell": {"ms": 5.0},
+            "stream:prefetch": {"ms": 7.0, "overlap_frac": 0.59},
+        }}}}}
+    p = tmp_path / "BENCH_r10.json"
+    p.write_text(json.dumps(doc))
+    r = load_bench_round(str(p))
+    assert r["overlap_frac"] == 0.59
+    rounds = [dict(r, path=f"r{i}") for i in range(3)]
+    res = check_run(rounds, {"overlap_frac": 0.1})
+    assert "overlap_frac" in res["regressed"]
+    assert check_run(rounds, {"overlap_frac": 0.6})["ok"]
+
+
+def test_check_run_filters_step_history_by_dtype():
+    rounds = [{"path": "a", "step_ms": 7920.0, "compile_s": None,
+               "overlap_frac": None, "dtype": "float32"},
+              {"path": "b", "step_ms": 2400.0, "compile_s": None,
+               "overlap_frac": None, "dtype": "mixed"}]
+    # a mixed 2500 ms run is fine next to the mixed 2400 round; the
+    # fp32 7920 round must NOT widen the comparison
+    res = check_run(rounds, {"step_ms": 2500.0, "dtype": "mixed"})
+    assert res["ok"], res
+    assert res["checks"]["step_time_ms"]["n"] == 1
+    res2 = check_run(rounds, {"step_ms": 2400.0 * 2, "dtype": "mixed"})
+    assert res2["regressed"] == ["step_time_ms"]
+
+
+# -------------------------------------------------------- CLI contract
+
+def _sentinel(args, cwd=_REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "roc_tpu.sentinel"] + args,
+        capture_output=True, text=True, cwd=cwd, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+
+
+def test_cli_green_on_real_trajectory():
+    """Acceptance: exit 0 on the checked-in r01-r05 history."""
+    r = _sentinel(["--json"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["ok"] is True
+    assert payload["current"]["round"] == "BENCH_r05.json"
+
+
+def test_cli_bites_on_synthetic_2x_regression(tmp_path):
+    """Acceptance: a 2x step-time regression injected into a COPY of
+    the BENCH history exits nonzero."""
+    for p in sorted(os.listdir(_REPO)):
+        if p.startswith("BENCH_r") and p.endswith(".json"):
+            shutil.copy(os.path.join(_REPO, p), tmp_path / p)
+    with open(tmp_path / "BENCH_r06.json", "w") as f:
+        json.dump({"parsed": {"value": 2362.64 * 2, "unit": "ms",
+                              "stage": "full", "dtype": "mixed"}}, f)
+    r = _sentinel(["--json", "--bench-glob",
+                   str(tmp_path / "BENCH_r*.json")])
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["regressed"] == ["step_time_ms"]
+    v = payload["checks"]["step_time_ms"]
+    assert v["verdict"] == "regression" and v["n"] == 1
+
+
+def test_cli_metrics_mode(tmp_path):
+    """--metrics: a live run's steady epoch_ms checked against the
+    whole round history."""
+    hist_dir = tmp_path / "h"
+    hist_dir.mkdir()
+    for i, ms in enumerate((100.0, 104.0, 98.0)):
+        with open(hist_dir / f"BENCH_r{i:02d}.json", "w") as f:
+            json.dump({"parsed": {"value": ms, "unit": "ms",
+                                  "stage": "full"}}, f)
+    m = tmp_path / "m.jsonl"
+    with open(m, "w") as f:
+        f.write(json.dumps({"epoch": 1, "epoch_ms": 300.0,
+                            "compile_ms": 900.0}) + "\n")
+        for e in (3, 5):
+            f.write(json.dumps({"epoch": e, "epoch_ms": 310.0}) + "\n")
+    r = _sentinel(["--json", "--metrics", str(m), "--bench-glob",
+                   str(hist_dir / "BENCH_r*.json")])
+    assert r.returncode == 1, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["mode"] == "metrics"
+    # the compile-lap record was excluded from the steady median
+    assert payload["current"]["step_ms"] == 310.0
+    assert payload["regressed"] == ["step_time_ms"]
+
+    ok = tmp_path / "ok.jsonl"
+    with open(ok, "w") as f:
+        f.write(json.dumps({"epoch": 3, "epoch_ms": 101.0}) + "\n")
+    r2 = _sentinel(["--json", "--metrics", str(ok), "--bench-glob",
+                    str(hist_dir / "BENCH_r*.json")])
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_metrics_summary_fields():
+    recs = [{"epoch": 1, "epoch_ms": 50.0, "compile_ms": 2000.0,
+             "overlap_frac": 0.5},
+            {"epoch": 3, "epoch_ms": 52.0, "overlap_frac": 0.7},
+            {"epoch": 5, "epoch_ms": 48.0}]
+    s = metrics_summary(recs)
+    assert s["step_ms"] == 50.0       # median of the steady laps only
+    assert s["compile_s"] == 2.0
+    assert s["overlap_frac"] == 0.6
+
+
+def test_bench_verdict_shape(tmp_path):
+    """bench.py records this into the headline line: compact, never
+    raises, honest about missing history."""
+    v = bench_verdict(2400.0, dtype="mixed", bench_dir=str(tmp_path))
+    assert v == {"verdict": "no_history", "n_history": 0}
+    for i, ms in enumerate((2400.0, 2500.0, 2350.0)):
+        with open(tmp_path / f"BENCH_r{i:02d}.json", "w") as f:
+            json.dump({"parsed": {"value": ms, "unit": "ms",
+                                  "dtype": "mixed"}}, f)
+    good = bench_verdict(2450.0, dtype="mixed",
+                         bench_dir=str(tmp_path))
+    assert good["verdict"] == "ok" and good["n_history"] == 3
+    bad = bench_verdict(2400.0 * 2, dtype="mixed",
+                        bench_dir=str(tmp_path))
+    assert bad["verdict"] == "regression"
+
+
+def test_bench_verdict_filters_by_stage(tmp_path):
+    """A small-stage headline is never scored against full-scale
+    history (and vice versa)."""
+    with open(tmp_path / "BENCH_r00.json", "w") as f:
+        json.dump({"parsed": {"value": 2400.0, "unit": "ms",
+                              "stage": "full", "dtype": "mixed"}}, f)
+    v = bench_verdict(240.0, dtype="mixed", bench_dir=str(tmp_path),
+                      stage="small")
+    assert v == {"verdict": "no_history", "n_history": 0}
+    v_full = bench_verdict(2400.0 * 2, dtype="mixed",
+                           bench_dir=str(tmp_path), stage="full")
+    assert v_full["verdict"] == "regression"

@@ -1,0 +1,346 @@
+"""Unified distributed timeline (roc_tpu/obs/timeline.py) + crash
+flight recorder (roc_tpu/obs/events.py): cross-process trace merge,
+clock-sync alignment, Perfetto export, and the dumps fatal paths
+leave behind."""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from roc_tpu.obs.timeline import (clock_offsets, merge_timeline,
+                                  straggler_records)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ev(cat, t, mono, proc, host="hostA", **fields):
+    return {"t": t, "mono": mono, "host": host, "proc": proc,
+            "cat": cat, "msg": f"{cat} event", **fields}
+
+
+def _stream(proc, mono_base, sync_wall=1000.0, host="hostA"):
+    """One synthetic per-process stream: manifest, clock_sync at
+    ``sync_wall`` (all procs' walls agree; monotonic bases do NOT),
+    and a spans batch with a lap starting 0.5 s after the sync."""
+    return [
+        _ev("manifest", sync_wall - 2.0, mono_base - 2.0, proc,
+            host=host),
+        _ev("timeline", sync_wall, mono_base, proc, host=host,
+            kind="clock_sync", epoch=0),
+        _ev("timeline", sync_wall + 1.0, mono_base + 1.0, proc,
+            host=host, kind="spans",
+            spans=[["train", mono_base + 0.5, 400.0]]),
+    ]
+
+
+# ------------------------------------------------- merge (synthetic)
+
+def test_clock_offsets_align_on_sync():
+    """Four processes whose monotonic bases differ by hundreds of
+    seconds must land their sync points on one instant, so the lap
+    each started 0.5 s after its own sync renders simultaneous."""
+    events = []
+    for p in range(4):
+        events += _stream(p, mono_base=100.0 + 500.0 * p)
+    offs = clock_offsets(events)
+    assert len(offs) == 4
+    aligned = {(h, p): off + (100.0 + 500.0 * p)
+               for (h, p), off in offs.items()}
+    vals = list(aligned.values())
+    assert max(vals) - min(vals) < 1e-6   # sync points coincide
+
+    doc = merge_timeline(events)
+    meta = doc["roc_tpu"]
+    assert len(meta["processes"]) == 4            # lane per process
+    assert all(pr["aligned"] for pr in meta["processes"])
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(spans) == 4
+    assert len({e["pid"] for e in spans}) == 4
+    # the four train laps start within float noise of each other
+    ts = [e["ts"] for e in spans]
+    assert max(ts) - min(ts) < 1.0                # us
+
+
+def test_merge_unsynced_stream_falls_back_to_wall():
+    """A stream without a clock_sync handshake (legacy artifact) wall-
+    aligns on its first stamped record instead of being dropped."""
+    events = _stream(0, mono_base=100.0)
+    events += [
+        _ev("manifest", 1000.5, 7.0, 1, host="hostB"),
+        _ev("timeline", 1001.0, 7.5, 1, host="hostB", kind="spans",
+            spans=[["train", 7.2, 100.0]]),
+    ]
+    doc = merge_timeline(events)
+    assert len(doc["roc_tpu"]["processes"]) == 2
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {1, 2}
+
+
+def test_merge_legacy_records_without_clock_tuple():
+    """Pre-clock-tuple records (no mono/host/proc) collapse into one
+    lane placed by wall time — never an error."""
+    events = [{"t": 10.0, "cat": "stall", "msg": "x", "stage": "s",
+               "elapsed_s": 5.0},
+              {"t": 11.0, "cat": "compile", "msg": "c",
+               "name": "train_step", "lower_s": 0.5, "compile_s": 1.0}]
+    doc = merge_timeline(events)
+    assert len(doc["roc_tpu"]["processes"]) == 1
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "stall:s" in names and "compile:train_step" in names
+
+
+def test_span_nesting_h2d_lane():
+    """h2d block waits render on their own thread lane, nested inside
+    the phase span that staged them."""
+    events = [
+        _ev("timeline", 1000.0, 50.0, 0, kind="clock_sync"),
+        _ev("timeline", 1002.0, 52.0, 0, kind="spans",
+            spans=[["head_forward", 50.5, 1000.0],
+                   ["h2d_wait", 50.6, 20.0],
+                   ["h2d_wait", 50.9, 15.0]]),
+    ]
+    doc = merge_timeline(events)
+    phase = next(e for e in doc["traceEvents"]
+                 if e["ph"] == "X" and e["name"] == "head_forward")
+    h2d = [e for e in doc["traceEvents"]
+           if e["ph"] == "X" and e["name"] == "h2d_wait"]
+    assert len(h2d) == 2
+    assert all(e["tid"] != phase["tid"] for e in h2d)
+    for e in h2d:   # nesting: wait intervals inside the phase span
+        assert phase["ts"] <= e["ts"]
+        assert e["ts"] + e["dur"] <= phase["ts"] + phase["dur"]
+
+
+def test_straggler_records_and_markers():
+    events = [
+        _ev("timeline", 1000.0, 50.0, 0, kind="clock_sync"),
+        _ev("costmodel", 1001.0, 51.0, 0, kind="straggler", epoch=3,
+            straggler_part=2, straggler_ratio=1.4, measured_ms=120.0,
+            num_parts=4),
+        _ev("resilience", 1002.0, 52.0, 0, kind="fault",
+            site="sigkill", epoch=4),
+    ]
+    recs = straggler_records(events)
+    assert recs == [{"epoch": 3, "part": 2, "ratio": 1.4,
+                     "measured_ms": 120.0, "proc": 0, "num_parts": 4}]
+    doc = merge_timeline(events)
+    assert doc["roc_tpu"]["straggler"] == recs
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "i"}
+    assert "straggler:part2" in names
+    assert "fault:sigkill" in names
+
+
+# --------------------------------------------- live P=4 rig (2 procs)
+
+@pytest.fixture(scope="module")
+def p4_run(tmp_path_factory):
+    """One REAL 2-process x 2-device (P=4) distributed run, each
+    process writing its own event/metrics JSONL streams."""
+    import socket
+    tmp = tmp_path_factory.mktemp("p4_timeline")
+    worker = os.path.join(os.path.dirname(__file__),
+                          "timeline_worker.py")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("JAX_COORDINATOR_ADDRESS", None)
+    env.pop("ROC_TPU_EVENTS", None)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, f"localhost:{port}", "2", str(i),
+         str(tmp)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True) for i in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+        assert "WORKER_OK" in out
+    return tmp
+
+
+def test_p4_merged_trace_golden(p4_run):
+    """The acceptance artifact: a P=4 distributed CPU-rig run yields
+    ONE Perfetto-loadable merged trace with a lane per process,
+    aligned phase spans, and per-epoch straggler attribution."""
+    ev_paths = sorted(glob.glob(str(p4_run / "ev_p*.jsonl")))
+    assert len(ev_paths) == 2
+    events = []
+    for p in ev_paths:
+        events.extend(json.loads(l) for l in open(p) if l.strip())
+    # both processes performed the clock-sync handshake
+    syncs = [e for e in events if e.get("kind") == "clock_sync"]
+    assert {e["proc"] for e in syncs} == {0, 1}
+
+    doc = merge_timeline(events)
+    meta = doc["roc_tpu"]
+    assert len(meta["processes"]) == 2          # lane per process
+    assert all(pr["aligned"] for pr in meta["processes"])
+    by_pid = {}
+    for e in doc["traceEvents"]:
+        if e["ph"] == "X":
+            by_pid.setdefault(e["pid"], set()).add(e["name"])
+    assert len(by_pid) == 2
+    for names in by_pid.values():                # aligned phase spans
+        assert {"compile", "train", "eval"} <= names, names
+    # phase spans of the two processes overlap on the merged axis
+    # (lockstep SPMD: both trained simultaneously)
+    trains = {}
+    for e in doc["traceEvents"]:
+        if e["ph"] == "X" and e["name"] == "train":
+            trains.setdefault(e["pid"], []).append(
+                (e["ts"], e["ts"] + e["dur"]))
+    (a0, a1), (b0, b1) = trains[1][0], trains[2][0]
+    assert a0 < b1 and b0 < a1, (trains[1][0], trains[2][0])
+
+    # per-epoch straggler attribution (P=4), the PR-5 cost-model record
+    recs = [r for r in meta["straggler"] if r["num_parts"] == 4]
+    assert recs and all(0 <= r["part"] < 4 for r in recs)
+    assert all(r["ratio"] is None or r["ratio"] >= 1.0 for r in recs)
+
+    # the whole document is valid Chrome-trace JSON
+    s = json.dumps(doc)
+    assert json.loads(s)["traceEvents"]
+
+
+def test_p4_timeline_cli_glob(p4_run, tmp_path):
+    """`python -m roc_tpu.timeline 'ev_p*.jsonl' --metrics ...` merges
+    the per-process streams and reports the lanes."""
+    out = str(tmp_path / "trace.json")
+    r = subprocess.run(
+        [sys.executable, "-m", "roc_tpu.timeline",
+         str(p4_run / "ev_p*.jsonl"),
+         "--metrics", str(p4_run / "m_p*.jsonl"), "-o", out],
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["streams"] == 2
+    assert summary["processes"] == 2
+    assert summary["straggler"]
+    doc = json.load(open(out))
+    assert doc["traceEvents"]
+    # metrics records joined as per-eval epoch markers
+    assert any(e["ph"] == "i" and e["name"].startswith("epoch ")
+               for e in doc["traceEvents"])
+
+
+def test_report_accepts_multiple_event_files(p4_run, tmp_path):
+    """Satellite: roc_tpu.report renders merged multi-process runs
+    instead of silently assuming one stream."""
+    ev_paths = sorted(glob.glob(str(p4_run / "ev_p*.jsonl")))
+    r = subprocess.run(
+        [sys.executable, "-m", "roc_tpu.report"] + ev_paths,
+        capture_output=True, text=True, cwd=_REPO, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr
+    assert "processes (merged event streams)" in r.stdout
+    assert "proc0@" in r.stdout and "proc1@" in r.stdout
+    assert "run manifest" in r.stdout
+
+
+# ------------------------------------------------ crash flight recorder
+
+def _cli(tmp_path, args, fdir, timeout=240):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("ROC_TPU_FAULT",)}
+    env["ROC_TPU_FLIGHT_DIR"] = str(fdir)
+    env["ROC_TPU_EVENTS"] = str(tmp_path / "events.jsonl")
+    return subprocess.run(
+        [sys.executable, "-m", "roc_tpu.train.cli"] + args,
+        cwd=_REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+BASE = ["-e", "4", "-layers", "8-8-3", "-dropout", "0.0",
+        "--eval-every", "2", "--impl", "ell", "--no-compile-cache",
+        "--cpu"]
+
+
+def _load_dumps(fdir, needle):
+    paths = sorted(glob.glob(os.path.join(str(fdir),
+                                          "flightrecord_*.json")))
+    hits = [p for p in paths if needle in os.path.basename(p)]
+    return [json.load(open(p)) for p in hits]
+
+
+def test_flight_record_on_sigkill(tmp_path):
+    """A SIGKILLed process leaves a dump whose LAST event is the
+    injected fault site — the acceptance criterion's drill."""
+    fdir = tmp_path / "fr"
+    r = _cli(tmp_path, BASE + ["--fault", "sigkill:2"], fdir)
+    assert r.returncode == -signal.SIGKILL, r.stderr[-2000:]
+    dumps = _load_dumps(fdir, "fault-sigkill")
+    assert dumps, os.listdir(str(fdir))
+    d = dumps[-1]
+    assert d["reason"] == "fault:sigkill"
+    last = d["events"][-1]
+    assert last["cat"] == "resilience" and last["site"] == "sigkill"
+    # the ring carried the run's recent telemetry, clock-stamped
+    assert len(d["events"]) > 1
+    assert all("t" in e and "mono" in e and "proc" in e
+               for e in d["events"])
+
+
+def test_flight_record_on_sigterm_preemption(tmp_path):
+    """The preemption path (SIGTERM -> grace -> epoch boundary) dumps
+    before exiting restartable; the dump contains the injected
+    sigterm fault event."""
+    fdir = tmp_path / "fr"
+    r = _cli(tmp_path,
+             BASE + ["--fault", "sigterm:2", "--preempt-grace", "30"],
+             fdir)
+    assert r.returncode == 75, (r.returncode, r.stderr[-2000:])
+    dumps = _load_dumps(fdir, "preempted")
+    assert dumps, os.listdir(str(fdir))
+    events = dumps[-1]["events"]
+    assert any(e.get("site") == "sigterm" for e in events)
+
+
+def test_flight_record_on_stall_deadline(tmp_path, monkeypatch):
+    """The stall watchdog dumps the telemetry window BEFORE trying to
+    interrupt the hung region (a terminally wedged C call would never
+    let anything later run)."""
+    from roc_tpu.obs.heartbeat import Heartbeat, StallFailure
+    fdir = tmp_path / "fr"
+    monkeypatch.setenv("ROC_TPU_FLIGHT_DIR", str(fdir))
+    from roc_tpu.obs.events import emit
+    emit("run", "pre-stall breadcrumb", console=False, crumb=1)
+    with pytest.raises(StallFailure):
+        with Heartbeat("wedge_test", interval_s=0.05, deadline_s=0.3):
+            time.sleep(30.0)
+    dumps = _load_dumps(fdir, "stall-wedge-test")
+    assert dumps, os.listdir(str(fdir)) if fdir.exists() else "no dir"
+    events = dumps[-1]["events"]
+    assert any(e.get("crumb") == 1 for e in events)
+    assert any(e.get("cat") == "stall" for e in events)
+
+
+def test_clock_tuple_on_every_event(tmp_path):
+    """Tentpole invariant: the bus stamps (t, mono, host, proc) on
+    every record; JSONL artifacts carry the full tuple."""
+    from roc_tpu.obs.events import EventLog, JsonlSink
+    p = str(tmp_path / "e.jsonl")
+    bus = EventLog([JsonlSink(p)])
+    bus.emit("run", "x")
+    bus.emit("epoch", "y", console=False, epoch_ms=1.5)
+    bus.close()
+    recs = [json.loads(l) for l in open(p)]
+    for r in recs:
+        assert set(("t", "mono", "host", "proc")) <= set(r)
+        assert isinstance(r["proc"], int)
+    assert recs[1]["mono"] >= recs[0]["mono"]

@@ -1,0 +1,63 @@
+"""Worker for the merged-timeline rig test (tests/test_timeline.py):
+a REAL multi-process P=4 distributed run whose per-process event and
+metrics JSONL streams the timeline merger must fuse into one
+Perfetto trace.
+
+Each of ``nproc`` processes owns ``4 // nproc`` virtual CPU devices
+(2 x 2 in the test — P=4 on the rig), meets the others through
+``jax.distributed.initialize`` (Gloo loopback), writes its OWN
+``ev_p<pid>.jsonl`` / ``m_p<pid>.jsonl`` (the per-process streams the
+ISSUE's merge exists for), trains through enough evals that phase
+spans, the clock-sync handshake, and per-epoch straggler attribution
+all land in the artifacts.
+
+Usage: python timeline_worker.py <coordinator> <nproc> <pid> <outdir>
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nproc, pid, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    n_parts = 4
+    local_dev = n_parts // nproc
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={local_dev}")
+    # per-process event stream BEFORE any roc_tpu import emits
+    ev_path = os.path.join(outdir, f"ev_p{pid}.jsonl")
+    os.environ["ROC_TPU_EVENTS"] = ev_path
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from roc_tpu.parallel import multihost as mh
+    mh.init_distributed(coordinator, nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.core.partition import partition_graph
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+
+    ds = synthetic_dataset(32 * n_parts, 6, in_dim=12, num_classes=3,
+                           seed=0)
+    mesh = mh.make_parts_mesh(n_parts)
+    cfg = TrainConfig(
+        epochs=6, verbose=False, aggr_impl="ell", symmetric=True,
+        dropout_rate=0.0, eval_every=2,
+        metrics_path=os.path.join(outdir, f"m_p{pid}.jsonl"))
+    pg = partition_graph(ds.graph, n_parts, node_multiple=8,
+                         edge_multiple=cfg.chunk)
+    data = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="ell")
+    tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
+                            ds, n_parts, cfg, mesh=mesh, data=data,
+                            pg=pg)
+    tr.train()
+    print(f"WORKER_OK pid={pid}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
