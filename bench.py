@@ -61,6 +61,7 @@ REDDIT_EDGES = 114_848_857  # 114,615,892 + 232,965 self edges
 METRIC_FULL = "full_graph_gcn_reddit_scale_epoch_time"
 METRIC_SMALL = "full_graph_gcn_small_epoch_time"
 METRIC_MICRO = "neighbor_aggregation_reduced"
+METRIC_SERVE = "serve_microbatch_latency"
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 # tests (and any sandboxed run) point this at a temp dir so stage
@@ -74,7 +75,8 @@ _STAGES_PATH = os.path.join(_ART_DIR, "bench_stages.jsonl")
 STAGES = (("probe", 150.0, 40.0),
           ("micro", 420.0, 150.0),
           ("small", 300.0, 150.0),
-          ("full", 900.0, 420.0))
+          ("full", 900.0, 420.0),
+          ("serve", 420.0, 120.0))
 
 # seconds between probe attempt STARTS while the tunnel is down — a
 # wedged relay recovers on the ~30 min scale, so probes are spread
@@ -130,8 +132,10 @@ def build_parser():
     ap.add_argument("--dtype", type=str, default="mixed")
     # small before full: the cheapest stage that yields a non-null
     # headline value runs first, so a late tunnel recovery still lands
-    # a number; micro (diagnostic race) runs last
-    ap.add_argument("--stages", type=str, default="probe,small,full,micro",
+    # a number; the diagnostic stages (micro race, serve load gen)
+    # run after the headline GCN stages
+    ap.add_argument("--stages", type=str,
+                    default="probe,small,full,micro,serve",
                     help="comma list of stages to run, in order")
     ap.add_argument("--small", action="store_true",
                     help="shorthand for --stages probe,small (CI)")
@@ -229,6 +233,7 @@ _STALE_CMD_PATTERNS = tuple(os.path.join(_HERE, rel) for rel in (
     "bench.py",
     "scripts/tpu_watch",
     "benchmarks/micro_agg.py",
+    "benchmarks/micro_serve.py",
     "benchmarks/model_zoo.py",
     "benchmarks/calibrate.py",
     "benchmarks/compile_probe.py",
@@ -873,6 +878,39 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
             "random_label_test_acc": round(float(m["test_acc"]), 4)}
 
 
+def child_serve(args) -> dict:
+    """Serving-tier load generation (benchmarks/micro_serve.py): both
+    backends exported through the real artifact path, a cold-loaded
+    server driven closed-loop and open-loop Poisson; the headline line
+    picks up the precomputed backend's p50/p99/QPS
+    (``serve_p50_ms``/``serve_p99_ms``/``serve_qps``), gated by the
+    sentinel like epoch time."""
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    dev = jax.devices()[0]
+    sys.path.insert(0, os.path.join(_HERE, "benchmarks"))
+    import micro_serve as ms
+    import tempfile
+    ds, model, cfg = ms.build_rig(20_000, 8, 128, 16, 2)
+    rows = {}
+    with tempfile.TemporaryDirectory(prefix="roc_serve_") as art:
+        for backend in ("precomputed", "full"):
+            from roc_tpu.models.builder import Model
+            rows[backend] = ms.run_backend(
+                backend, ds, Model.from_spec(model.to_spec()), cfg,
+                queries=200, batch=4, rate="auto", art_root=art)
+    out = {"platform": dev.platform, "device_kind": dev.device_kind,
+           "V": int(ds.graph.num_nodes), "E": int(ds.graph.num_edges),
+           "queries": 200, "batch": 4, "backends": rows}
+    pre, full = rows.get("precomputed"), rows.get("full")
+    if pre and full:
+        out["speedup_p50"] = round(
+            full["closed"]["p50_ms"]
+            / max(pre["closed"]["p50_ms"], 1e-9), 1)
+    return out
+
+
 def run_child(args) -> None:
     # persistent XLA cache: repeat runs (driver retries, staged
     # protocol, round-over-round) skip the 1-2 min full-scale compile
@@ -898,6 +936,8 @@ def run_child(args) -> None:
         out = child_gcn(args, 2048, 32768)
     elif args.stage == "full":
         out = child_gcn(args, args.nodes, args.edges)
+    elif args.stage == "serve":
+        out = child_serve(args)
     else:
         raise SystemExit(f"unknown stage {args.stage!r}")
     print(json.dumps(out))
@@ -1115,6 +1155,7 @@ def parent(args, argv) -> int:
     metric_full = METRIC_FULL + suffix
     metric_small = METRIC_SMALL + suffix
     metric_micro = METRIC_MICRO + suffix
+    metric_serve = METRIC_SERVE + suffix
     wanted = [s.strip() for s in args.stages.split(",") if s.strip()]
     if args.small:
         wanted = ["probe", "small"]
@@ -1305,6 +1346,14 @@ def parent(args, argv) -> int:
                 _record_baseline(metric_micro, entry)
                 if metric_micro != METRIC_MICRO:
                     _record_baseline(METRIC_MICRO, entry)
+            elif name == "serve":
+                entry = _baseline_entry(
+                    r, extra_keys=("V", "E", "queries", "batch"))
+                entry["backends"] = r["backends"]
+                entry["speedup_p50"] = r.get("speedup_p50")
+                _record_baseline(metric_serve, entry)
+                if metric_serve != METRIC_SERVE:
+                    _record_baseline(METRIC_SERVE, entry)
             elif name in ("small", "full"):
                 metric = metric_small if name == "small" else metric_full
                 entry = _baseline_entry(r)
@@ -1326,6 +1375,21 @@ def parent(args, argv) -> int:
                          if results[n].get("ok")
                          else {"error": results[n].get("error")})
                      for n in results}
+    # serving-tier headline fields: the precomputed backend's
+    # closed-loop p50/p99 + QPS ride every headline line (and the
+    # sentinel's trajectory gate reads them from the BENCH history
+    # exactly like epoch time — obs/sentinel.py load_bench_round)
+    serve_fields = {}
+    sv = results.get("serve")
+    if sv and sv.get("ok"):
+        pre = (sv["result"].get("backends") or {}).get("precomputed")
+        closed = (pre or {}).get("closed") or {}
+        if closed.get("p50_ms") is not None:
+            serve_fields = {"serve_p50_ms": closed.get("p50_ms"),
+                            "serve_p99_ms": closed.get("p99_ms"),
+                            "serve_qps": closed.get("qps"),
+                            "serve_speedup_p50":
+                                sv["result"].get("speedup_p50")}
     for name, metric in (("full", METRIC_FULL), ("small", METRIC_SMALL)):
         rec = results.get(name)
         if rec and rec.get("ok"):
@@ -1334,6 +1398,7 @@ def parent(args, argv) -> int:
             line = {"metric": metric, "value": epoch_ms, "unit": "ms",
                     "vs_baseline": 1.0, "stage": name,
                     "dtype": r.get("dtype"), "impl": r.get("impl"),
+                    **serve_fields,
                     "stages": stage_summary}
             line.update(_baseline_compare_fields(
                 _load_baselines().get(metric), r.get("platform"),

@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Micro-benchmark: serving-tier load generator — QPS + latency
+percentiles for the ``roc_tpu/serve`` inference backends.
+
+Builds the SGC serving rig (synthetic graph, frozen Glorot weights —
+serving latency is weight-independent), exports BOTH backends through
+the real artifact path (``serve/export.py``: resolve → propagation
+precompute → AOT prewarm → manifest), then drives a ``Server`` with
+two canonical traffic shapes:
+
+1. **closed-loop** — one outstanding query at a time (per client):
+   the p50 here is pure request latency, the number the ISSUE's
+   "precomputed ≥10× lower p50 than full-graph predict" acceptance is
+   measured on;
+2. **open-loop Poisson** — arrivals at a fixed rate λ drawn from an
+   exponential inter-arrival clock, submitted without waiting for
+   completions (the shape real traffic has; p99 under this load shows
+   the coalescing queue absorbing bursts instead of head-of-line
+   blocking on them).
+
+Reported per backend: p50/p99 request latency (submit→result), QPS
+(completed/wall), and the server's microbatch stats.  The headline
+speedup row divides full-graph p50 by precomputed p50 — the measured
+form of "the fixed-propagation family collapses at serving time".
+
+Usage: python benchmarks/micro_serve.py [--cpu] [--queries N]
+       [--rate QPS|auto] [--out out.json]
+The CPU rehearsal artifact lives at benchmarks/micro_serve_cpu.json;
+``bench.py``'s ``serve`` stage runs the same harness on the chip and
+feeds ``serve_p50_ms``/``serve_p99_ms``/``serve_qps`` into the
+BENCH_* headline (gated by ``python -m roc_tpu.sentinel``).
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def build_rig(nodes, degree, feat, classes, hops, seed=0):
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.models.sgc import build_sgc
+    from roc_tpu.train.trainer import TrainConfig
+    ds = synthetic_dataset(num_nodes=nodes, avg_degree=degree,
+                           in_dim=feat, num_classes=classes, seed=seed)
+    model = build_sgc([feat, classes], k=hops, dropout_rate=0.5)
+    cfg = TrainConfig(verbose=False, symmetric=True)
+    return ds, model, cfg
+
+
+def _pcts(lat_ms):
+    lat = sorted(lat_ms)
+
+    def pct(p):
+        return round(lat[min(len(lat) - 1, int(p * len(lat)))], 4)
+
+    return {"p50_ms": pct(0.50), "p99_ms": pct(0.99),
+            "mean_ms": round(float(np.mean(lat)), 4)}
+
+
+def closed_loop(server, ids_seq):
+    """One outstanding query at a time; returns latency list + wall."""
+    lat = []
+    t_start = time.perf_counter()
+    for ids in ids_seq:
+        t0 = time.perf_counter()
+        server.query(ids)
+        lat.append((time.perf_counter() - t0) * 1e3)
+    return lat, time.perf_counter() - t_start
+
+
+def open_loop(server, ids_seq, rate_qps, seed=0):
+    """Poisson arrivals at ``rate_qps``; submissions never wait for
+    completions, so queueing delay is part of the measured latency
+    (the honest open-loop convention)."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / max(rate_qps, 1e-6),
+                           size=len(ids_seq))
+    done_at = {}
+
+    def _stamp(i):
+        # done-callbacks run in the dispatcher thread the moment the
+        # future resolves — per-request completion stamps stay honest
+        # even when the submitting loop is behind
+        def cb(_fut):
+            done_at[i] = time.perf_counter()
+        return cb
+
+    pending = []
+    t_start = time.perf_counter()
+    t_next = t_start
+    for i, (ids, gap) in enumerate(zip(ids_seq, gaps)):
+        t_next += gap
+        now = time.perf_counter()
+        if t_next > now:
+            time.sleep(t_next - now)
+        t0 = time.perf_counter()
+        fut = server.submit(ids)
+        fut.add_done_callback(_stamp(i))
+        pending.append((i, t0, fut))
+    for _, _, fut in pending:
+        fut.result()
+    wall = time.perf_counter() - t_start
+    # result() can return BEFORE the done-callback ran (set_result
+    # wakes waiters first, then invokes callbacks) — give the
+    # dispatcher thread a beat to finish stamping
+    deadline = time.perf_counter() + 5.0
+    while len(done_at) < len(pending) and time.perf_counter() < deadline:
+        time.sleep(0.0005)
+    t_fallback = time.perf_counter()
+    lat = [(done_at.get(i, t_fallback) - t0) * 1e3
+           for i, t0, _ in pending]
+    return lat, wall
+
+
+def run_backend(backend, ds, model, cfg, queries, batch, rate,
+                art_root, seed=0, max_wait_ms=0.2):
+    """Export one backend through the real artifact path, then drive
+    closed- and open-loop traffic against a cold-loaded server."""
+    from roc_tpu.serve.export import (build_predictor, export_predictor,
+                                      load_predictor)
+    from roc_tpu.serve.server import Server
+    out_dir = os.path.join(art_root, backend)
+    t0 = time.perf_counter()
+    pred = build_predictor(model, ds, cfg, backend=backend)
+    manifest = export_predictor(
+        pred, out_dir,
+        dataset_meta={"V": ds.graph.num_nodes,
+                      "E": ds.graph.num_edges})
+    export_s = time.perf_counter() - t0
+    # the measured server is a COLD load of the artifact — the path a
+    # real deployment takes (the export process's jits are not reused)
+    t0 = time.perf_counter()
+    pred = load_predictor(
+        out_dir, dataset=ds if backend == "full" else None)
+    warm = pred.warm(name=f"serve_bench_{backend}")
+    load_s = time.perf_counter() - t0
+    rng = np.random.RandomState(seed)
+    ids_seq = [rng.randint(0, ds.graph.num_nodes,
+                           size=batch).astype(np.int32)
+               for _ in range(queries)]
+    row = {"backend": backend, "flavor": manifest["flavor"],
+           "export_s": round(export_s, 2),
+           "cold_load_s": round(load_s, 3),
+           "warm_hits": warm.get("compile_warm_hits"),
+           "cold_compiles": warm.get("compile_cold")}
+    with Server(pred, max_wait_ms=max_wait_ms) as srv:
+        # closed loop first — its throughput calibrates 'auto' rate
+        lat, wall = closed_loop(srv, ids_seq)
+        closed = _pcts(lat)
+        closed["qps"] = round(len(lat) / max(wall, 1e-9), 1)
+        row["closed"] = closed
+        eff_rate = (0.5 * closed["qps"] if rate == "auto"
+                    else float(rate))
+        lat, wall = open_loop(srv, ids_seq, eff_rate, seed=seed)
+        opened = _pcts(lat)
+        opened["qps"] = round(len(lat) / max(wall, 1e-9), 1)
+        opened["offered_qps"] = round(eff_rate, 1)
+        row["open"] = opened
+        row["server"] = srv.stats()
+    return row
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=20_000)
+    ap.add_argument("--degree", type=int, default=8)
+    ap.add_argument("--feat", type=int, default=128)
+    ap.add_argument("--classes", type=int, default=16)
+    ap.add_argument("--hops", type=int, default=2)
+    ap.add_argument("--queries", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=4,
+                    help="node ids per query (the per-user request "
+                         "size; microbatching coalesces across them)")
+    ap.add_argument("--rate", default="auto",
+                    help="open-loop Poisson arrival rate in QPS "
+                         "('auto' = half the measured closed-loop "
+                         "throughput)")
+    ap.add_argument("--backends", default="precomputed,full")
+    ap.add_argument("--max-wait-ms", type=float, default=0.2)
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--out", default=None,
+                    help="write the result JSON here (e.g. "
+                         "benchmarks/micro_serve_cpu.json)")
+    args = ap.parse_args(argv)
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    from roc_tpu.utils.compile_cache import enable_compile_cache
+    enable_compile_cache(min_compile_secs=0.0)
+    dev = jax.devices()[0]
+    ds, model, cfg = build_rig(args.nodes, args.degree, args.feat,
+                               args.classes, args.hops)
+    out = {"device": f"{dev.platform} {dev.device_kind}",
+           "config": {"V": ds.graph.num_nodes,
+                      "E": ds.graph.num_edges, "F": args.feat,
+                      "C": args.classes, "k": args.hops,
+                      "queries": args.queries, "batch": args.batch,
+                      "max_wait_ms": args.max_wait_ms},
+           "backends": {}}
+    with tempfile.TemporaryDirectory(prefix="roc_serve_") as art:
+        for backend in [b.strip()
+                        for b in args.backends.split(",") if b.strip()]:
+            from roc_tpu.models.builder import Model
+            row = run_backend(
+                backend, ds, Model.from_spec(model.to_spec()), cfg,
+                args.queries, args.batch, args.rate, art)
+            out["backends"][backend] = row
+            print(f"# {backend}: closed p50 "
+                  f"{row['closed']['p50_ms']} ms p99 "
+                  f"{row['closed']['p99_ms']} ms "
+                  f"{row['closed']['qps']} qps | open p50 "
+                  f"{row['open']['p50_ms']} ms p99 "
+                  f"{row['open']['p99_ms']} ms", file=sys.stderr)
+    pre = out["backends"].get("precomputed")
+    full = out["backends"].get("full")
+    if pre and full:
+        out["speedup_p50"] = round(
+            full["closed"]["p50_ms"] / max(pre["closed"]["p50_ms"],
+                                           1e-9), 1)
+        print(f"# precomputed vs full-graph p50 speedup: "
+              f"{out['speedup_p50']}x", file=sys.stderr)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"# wrote {args.out}", file=sys.stderr)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

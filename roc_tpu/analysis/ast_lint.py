@@ -90,7 +90,10 @@ class StdoutPrintRule(AstRule):
                    # same for the timeline merger and the regression
                    # sentinel: their stdout is the report/verdict
                    "roc_tpu/obs/timeline.py", "roc_tpu/timeline.py",
-                   "roc_tpu/obs/sentinel.py", "roc_tpu/sentinel.py"}
+                   "roc_tpu/obs/sentinel.py", "roc_tpu/sentinel.py",
+                   # the serve export CLI prints one JSON report line
+                   # (error paths go to stderr like every CLI here)
+                   "roc_tpu/serve/export.py", "roc_tpu/export.py"}
 
     def select(self, relpath: str) -> bool:
         return relpath not in self.ALLOW_FILES
@@ -122,7 +125,13 @@ class HostSyncHotPathRule(AstRule):
     name = "host-sync-hot-path"
     why = ("hot-path modules must stay fetch-free: host syncs "
            "serialize the async dispatch pipeline")
-    HOT_PREFIXES = ("roc_tpu/ops/", "roc_tpu/kernels/")
+    # serve/ is scoped in as a whole: a device_get/.item() inside the
+    # request loop serializes every queued microbatch behind one
+    # query's fetch — exactly the latency bug class this tier will
+    # grow.  The ONE sanctioned fetch (the result itself) carries a
+    # pragma at the call site (serve/predictor.py).
+    HOT_PREFIXES = ("roc_tpu/ops/", "roc_tpu/kernels/",
+                    "roc_tpu/serve/")
     HOT_FILES = {"roc_tpu/core/streaming.py"}
 
     def select(self, relpath: str) -> bool:

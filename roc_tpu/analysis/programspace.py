@@ -134,12 +134,20 @@ class RigSpec:
     """One audited rig configuration: a model builder + TrainConfig
     factory + mesh width.  Factories (not instances) because a spec is
     enumerated, parity-tested, and idempotency-checked independently —
-    each build must start from a pristine config."""
+    each build must start from a pristine config.
+
+    ``serve`` names a serving backend instead of a trainer: the rig
+    then builds a ``roc_tpu/serve`` Predictor (same resolve pass, so
+    the idempotency assert still applies) and the enumerated set is
+    its bucketed serve-program space — which is how the serve tier's
+    programs fall under the SAME ``program_budget`` ratchet and
+    prewarm driver as the training steps."""
 
     name: str
     model: Callable[[], Any]
     config: Callable[[], Any]
     parts: int = 1
+    serve: Optional[str] = None
 
 
 def _rig_specs() -> Dict[str, RigSpec]:
@@ -173,6 +181,20 @@ def _rig_specs() -> Dict[str, RigSpec]:
                 verbose=False, symmetric=True, features="host",
                 dtype=jnp.float32, compute_dtype=jnp.bfloat16),
             parts=1),
+        # the serving tier (roc_tpu/serve): the SGC precomputed-
+        # propagation predictor's bucketed program set — one program
+        # per microbatch bucket, nothing else.  Enumerated here so a
+        # PR that grows the serve program space (a new bucket, an
+        # unquantized request shape) trips the compile-explosion
+        # ratchet before any chip time, and so `python -m
+        # roc_tpu.prewarm --config all` AOT-warms the serve
+        # executables alongside the training steps.
+        "sgc_serve": RigSpec(
+            name="sgc_serve",
+            model=lambda: build_sgc([_F, _C], k=2, dropout_rate=0.5),
+            config=lambda: TrainConfig(
+                verbose=False, symmetric=True, dtype=jnp.float32),
+            parts=1, serve="precomputed"),
     }
 
 
@@ -193,9 +215,14 @@ def build_rig_dataset():
 
 
 def build_rig_trainer(spec: RigSpec, dataset=None):
-    """The trainer a live run of this spec would construct — table
-    builds only; every jit slot stays uncompiled until called."""
+    """The trainer (or, for serve rigs, the Predictor) a live run of
+    this spec would construct — table builds only; every jit slot
+    stays uncompiled until called."""
     ds = dataset if dataset is not None else build_rig_dataset()
+    if spec.serve:
+        from ..serve.export import build_predictor
+        return build_predictor(spec.model(), ds, spec.config(),
+                               backend=spec.serve)
     if spec.parts > 1:
         from ..parallel.distributed import DistributedTrainer
         return DistributedTrainer(spec.model(), ds, spec.parts,
@@ -283,6 +310,9 @@ def candidate_programs(tr) -> List["Candidate"]:
 
     lr = jnp.asarray(0.01, jnp.float32)
     cands: List[Candidate] = []
+
+    if hasattr(tr, "serve_candidates"):          # serve Predictor
+        return list(tr.serve_candidates())
 
     def add(slot, jitfn, args, donate=(), observed=True):
         cands.append(Candidate(

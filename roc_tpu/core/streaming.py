@@ -481,18 +481,36 @@ def aggregate_to_host(graph: Graph, feats_host: np.ndarray,
     return out
 
 
+def _prefix_op_view(op) -> tuple:
+    """``(kind, attrs)`` of a prefix op — accepts both the builder's
+    ``_Op`` objects (the trainer's streamable_agg_head path) and the
+    plain-dict descriptors the serve manifest persists
+    (``roc_tpu/serve/propagation.py``), so BOTH consumers walk the
+    identical numeric path below."""
+    if isinstance(op, dict):
+        return op["kind"], op
+    return op.kind, op.attrs
+
+
 def stream_prefix_to_host(graph: Graph, prefix_ops,
                           feats_host: np.ndarray,
                           block_rows: int = 65536,
-                          prefetch: int = 1) -> np.ndarray:
+                          prefetch: int = 1,
+                          capture: Optional[list] = None) -> np.ndarray:
     """Evaluate a parameter-free norm/aggregation prefix (the op list
-    returned by ``Model.streamable_agg_head``) with every [V, F]
-    intermediate host-resident: ``indegree_norm`` is a host row
-    scaling, ``scatter_gather`` (SUM/AVG) runs through
-    :func:`aggregate_to_host` (one staging pool reused across the
-    whole chain).  Returns fp32; runs ONCE per training session — this
-    is the SGC-style precompute (A_hat^k X), after which epochs touch
-    only the streamed head."""
+    returned by ``Model.streamable_agg_head``, or its serialized dict
+    form) with every [V, F] intermediate host-resident:
+    ``indegree_norm`` is a host row scaling, ``scatter_gather``
+    (SUM/AVG) runs through :func:`aggregate_to_host` (one staging pool
+    reused across the whole chain).  Returns fp32; runs ONCE per
+    training session — this is the SGC-style precompute (A_hat^k X),
+    after which epochs touch only the streamed head.
+
+    ``capture`` (a list) receives a COPY of the value after each op —
+    the per-stage tables the serve tier's incremental invalidation
+    needs (``serve/propagation.PropagationCache``).  ONE walk for the
+    trainer's precompute and the serving table, so the two can never
+    diverge numerically."""
     from ..models.builder import AGGR_AVG, AGGR_SUM
     from ..ops.norm import inv_sqrt_degree_np
     x = np.asarray(feats_host, dtype=np.float32)
@@ -501,16 +519,17 @@ def stream_prefix_to_host(graph: Graph, prefix_ops,
     tiles = None
     pool = StagingPool(depth=prefetch)
     for op in prefix_ops:
-        if op.kind == "indegree_norm":
+        kind, attrs = _prefix_op_view(op)
+        if kind == "indegree_norm":
             x = x * inv_sqrt
-        elif op.kind == "scatter_gather":
+        elif kind == "scatter_gather":
             if tiles is None:
                 tiles = build_tile_plans(graph, block_rows)
             x = aggregate_to_host(graph, x, block_rows, tiles=tiles,
                                   pool=pool)
-            if op.attrs.get("aggr", AGGR_SUM) == AGGR_AVG:
+            if attrs.get("aggr", AGGR_SUM) == AGGR_AVG:
                 x = x / np.maximum(deg, 1.0)[:, None]
-        elif op.kind == "fused_aggregate":
+        elif kind == "fused_aggregate":
             # the fused norm -> sum -> norm [-> relu] op
             # (models/builder.py fuse_norm_aggregate), unrolled
             # host-side — this precompute runs once, so fusion buys
@@ -519,10 +538,17 @@ def stream_prefix_to_host(graph: Graph, prefix_ops,
                 tiles = build_tile_plans(graph, block_rows)
             x = aggregate_to_host(graph, x * inv_sqrt, block_rows,
                                   tiles=tiles, pool=pool) * inv_sqrt
-            if op.attrs.get("activation", "none") != "none":
+            if attrs.get("activation", "none") != "none":
                 np.maximum(x, 0.0, out=x)
         else:  # pragma: no cover - guarded by streamable_agg_head
-            raise NotImplementedError(op.kind)
+            raise NotImplementedError(kind)
+        if capture is not None:
+            # no defensive copy: every branch above REBINDS x to a
+            # fresh array (the fused relu's in-place np.maximum runs
+            # before this append), so each captured stage is
+            # exclusively owned — a copy would double the host peak
+            # of the >HBM export this path exists for
+            capture.append(x)
     return x
 
 

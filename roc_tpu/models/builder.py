@@ -825,6 +825,80 @@ class Model:
         return (list(ops[1:i]), ops[i].attrs["rate"],
                 ops[i + 1].param, self._split_tail(head_out))
 
+    # ---- serving support ----
+
+    GRAPH_OP_KINDS = ("scatter_gather", "fused_aggregate", "gat",
+                      "indegree_norm")
+
+    def precompute_split(self):
+        """``(prefix_ops, head_model)`` when the op list is a
+        PARAMETER-FREE propagation prefix followed by a purely dense
+        (row-wise) remainder — the SGC-family shape whose serving path
+        collapses to "cache ``S^k X`` once, answer with a dense MLP"
+        (``roc_tpu/serve``).  ``prefix_ops`` is the op sublist the
+        export step evaluates host-side ONCE (the same vocabulary
+        ``stream_prefix_to_host`` runs: ``indegree_norm`` /
+        ``scatter_gather`` SUM/AVG / ``fused_aggregate``);
+        ``head_model`` interprets the remaining ops against gathered
+        prefix rows and SHARES the original param names.  Unlike
+        :meth:`streamable_agg_head` the head keeps its dropout (eval
+        mode drops nothing) and may be arbitrarily deep — the only
+        requirement is that no graph op (and no reach-back past the
+        prefix) remains below the split.  Returns None when the model
+        has no parameter-free propagation prefix or the remainder
+        still touches the graph."""
+        ops = self._ops
+        i = 1
+        while i < len(ops) and ops[i].inputs == (i - 1,) and (
+                ops[i].kind in ("indegree_norm", "fused_aggregate")
+                or (ops[i].kind == "scatter_gather"
+                    and ops[i].attrs.get("aggr", AGGR_SUM)
+                    in (AGGR_SUM, AGGR_AVG))):
+            i += 1
+        if i == 1 or not any(
+                op.kind in ("scatter_gather", "fused_aggregate")
+                for op in ops[1:i]):
+            return None
+        if i >= len(ops):
+            return None
+        for op in ops[i:]:
+            if op.kind in self.GRAPH_OP_KINDS:
+                return None
+            if any(j < i - 1 for j in op.inputs):
+                return None
+        if self._loss_op is not None and self._loss_op < i - 1:
+            return None
+        return list(ops[1:i]), self._split_tail(i - 1)
+
+    def to_spec(self) -> Dict[str, Any]:
+        """JSON-serializable description of the built model — the
+        serving manifest persists this so a cold server process
+        rebuilds the EXACT op list without the builder call that made
+        it (``roc_tpu/serve/export.py``)."""
+        return {
+            "in_dim": self._ops[0].dim,
+            "ops": [{"kind": op.kind, "inputs": list(op.inputs),
+                     "dim": op.dim, "param": op.param,
+                     "attrs": dict(op.attrs)}
+                    for op in self._ops[1:]],
+            "loss_op": self._loss_op,
+            "counters": [self._n_linear, self._n_gat, self._n_eps],
+        }
+
+    @classmethod
+    def from_spec(cls, spec: Dict[str, Any]) -> "Model":
+        """Inverse of :meth:`to_spec`."""
+        model = cls(in_dim=int(spec["in_dim"]))
+        for op in spec["ops"]:
+            model._ops.append(_Op(op["kind"], tuple(op["inputs"]),
+                                  int(op["dim"]), op.get("param"),
+                                  dict(op.get("attrs") or {})))
+        model._loss_op = spec.get("loss_op")
+        c = spec.get("counters") or [0, 0, 0]
+        model._n_linear, model._n_gat, model._n_eps = (
+            int(c[0]), int(c[1]), int(c[2]))
+        return model
+
     # ---- params ----
 
     def init_params(self, key: jax.Array,

@@ -57,9 +57,14 @@ from typing import Any, Dict, List, Optional
 #   timeline  clock-sync handshakes and per-phase span batches the
 #             cross-process trace merger consumes
 #             (obs/timeline.py; python -m roc_tpu.timeline)
+#   serve     inference-tier lifecycle (roc_tpu/serve): artifact
+#             export/prewarm reports, server open/close summaries
+#             (query/batch counts, latency percentiles), propagation-
+#             table invalidations
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
-              "costmodel", "programspace", "resilience", "timeline")
+              "costmodel", "programspace", "resilience", "timeline",
+              "serve")
 
 
 # ---------------------------------------------------------- clock tuple

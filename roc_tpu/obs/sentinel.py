@@ -105,7 +105,8 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     the r01–r04 all-null rounds are legitimate history."""
     out: Dict[str, Any] = {"path": os.path.basename(path),
                            "step_ms": None, "compile_s": None,
-                           "overlap_frac": None, "dtype": None,
+                           "overlap_frac": None, "serve_p50_ms": None,
+                           "serve_qps": None, "dtype": None,
                            "stage": None}
     try:
         with open(path) as f:
@@ -121,6 +122,12 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     val = parsed.get("value")
     if isinstance(val, (int, float)) and parsed.get("unit") == "ms":
         out["step_ms"] = float(val)
+    # serve rows (bench.py serve stage, PR 11): p50 request latency
+    # and sustained QPS of the precomputed-propagation backend — the
+    # serving tier's trajectory is gated exactly like epoch time
+    for k in ("serve_p50_ms", "serve_qps"):
+        if isinstance(parsed.get(k), (int, float)):
+            out[k] = float(parsed[k])
     out["dtype"] = parsed.get("dtype")
     out["stage"] = parsed.get("stage")
     stages = parsed.get("stages")
@@ -214,6 +221,11 @@ def check_run(rounds: List[Dict[str, Any]],
         "overlap_frac": detect([r.get("overlap_frac") for r in rounds],
                                current.get("overlap_frac"),
                                higher_is_better=True),
+        "serve_p50_ms": detect([r.get("serve_p50_ms") for r in rounds],
+                               current.get("serve_p50_ms")),
+        "serve_qps": detect([r.get("serve_qps") for r in rounds],
+                            current.get("serve_qps"),
+                            higher_is_better=True),
     }
     regressed = [name for name, v in checks.items()
                  if v["verdict"] == "regression"]
@@ -294,7 +306,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         cur_idx = None
         for i in range(len(rounds) - 1, -1, -1):
             if any(rounds[i][k] is not None
-                   for k in ("step_ms", "compile_s", "overlap_frac")):
+                   for k in ("step_ms", "compile_s", "overlap_frac",
+                             "serve_p50_ms", "serve_qps")):
                 cur_idx = i
                 break
         if cur_idx is None:
@@ -309,6 +322,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         current = {"step_ms": cur["step_ms"],
                    "compile_s": cur["compile_s"],
                    "overlap_frac": cur.get("overlap_frac"),
+                   "serve_p50_ms": cur.get("serve_p50_ms"),
+                   "serve_qps": cur.get("serve_qps"),
                    "dtype": args.dtype or cur.get("dtype"),
                    "round": cur["path"]}
         history = rounds[:cur_idx]

@@ -189,6 +189,13 @@ def _marker(rec: Dict[str, Any]) -> Optional[Tuple[str, Dict[str, Any]]]:
         return None
     if cat == "timeline" and rec.get("kind") == "clock_sync":
         return ("clock_sync", {"epoch": rec.get("epoch")})
+    if cat == "serve":
+        # server lifecycle markers on the serving process's lane (the
+        # per-microbatch spans ride the ordinary span batches)
+        return (f"serve:{rec.get('kind', 'serve')}",
+                {"msg": rec.get("msg"),
+                 "n_queries": rec.get("n_queries"),
+                 "rows": rec.get("rows")})
     if cat in ("bench", "programspace", "run"):
         return (f"{cat}", {"msg": rec.get("msg")})
     return None

@@ -1153,7 +1153,21 @@ class DistributedTrainer:
     def evaluate(self) -> Dict[str, float]:
         return self._eval(-1)
 
-    def predict(self) -> np.ndarray:
+    def _padded_rows_of(self, node_ids) -> np.ndarray:
+        """Original vertex ids → rows of the concatenated padded
+        logits ([P * part_nodes, C] order): part ``p`` holds global
+        range ``bounds[p]`` at local offset ``g - node_offset[p]``."""
+        pg = self.pg
+        ids = np.asarray(node_ids, dtype=np.int64).ravel()
+        if ids.size and (ids.min() < 0 or ids.max() >= pg.num_nodes):
+            raise ValueError(
+                f"node ids out of range [0, {pg.num_nodes})")
+        offs = np.asarray(pg.node_offset, dtype=np.int64)
+        part = np.searchsorted(offs, ids, side="right") - 1
+        return (part * pg.part_nodes + ids - offs[part]).astype(
+            np.int32)
+
+    def predict(self, node_ids=None) -> np.ndarray:
         """[V, C] inference-mode logits in ORIGINAL vertex order —
         the EVAL program's sharded logits output (one compiled program
         serves evaluate and predict; the old standalone predict step
@@ -1162,7 +1176,13 @@ class DistributedTrainer:
         replicate it first through a tiny lazily-built all_gather
         program (a P('parts')-sharded device_get would touch
         non-addressable shards there) — rigs and tests never compile
-        it."""
+        it.
+
+        ``node_ids`` fetches only a ``[len(ids), C]`` row subset: the
+        ids map to padded shard coordinates host-side and gather on
+        device, so the full sharded logits never cross device→host
+        (the serve tier's gather path; under multi-process SPMD the
+        gather runs on the replicated copy for addressability)."""
         _, logits = self._run_eval_step()
         if jax.process_count() > 1:
             if self._predict_gather is None:
@@ -1172,6 +1192,11 @@ class DistributedTrainer:
                     name="dist_predict_gather",
                     verbose=self.config.verbose)
             logits = self._predict_gather(logits)
+        if node_ids is not None:
+            rows = jnp.asarray(self._padded_rows_of(node_ids))
+            flat = logits.reshape(self.pg.padded_num_nodes, -1)
+            return np.asarray(jax.device_get(
+                jnp.take(flat, rows, axis=0)))
         arr = np.asarray(jax.device_get(logits))
         arr = arr.reshape(self.pg.num_parts, self.pg.part_nodes, -1)
         return unpad_nodes(arr, self.pg)

@@ -1,0 +1,13 @@
+"""Low-latency inference tier (the ROADMAP's "serve the graph" item).
+
+- :mod:`.export` — ``python -m roc_tpu.export``: checkpoint/config →
+  serving artifact (AOT-warmed predict executables + manifest).
+- :mod:`.predictor` — the bucketed query engine (full-graph and
+  precomputed-propagation backends).
+- :mod:`.propagation` — ``S^k X`` tables + incremental edge-append
+  invalidation.
+- :mod:`.server` — the coalescing microbatch request queue.
+"""
+
+from .predictor import SERVE_BUCKETS, Predictor, bucket_for  # noqa: F401
+from .server import Server  # noqa: F401

@@ -1,0 +1,492 @@
+"""Exported predictor artifacts: ``python -m roc_tpu.export``.
+
+The export step is where serving's cold-start cost is paid, once,
+off the request path:
+
+1. resolve the model + config through the SAME
+   ``train/trainer.resolve_config`` pass training uses (fuse rewrite,
+   impl auto-resolution, attention policy) — the artifact records the
+   RESOLVED state, so a server can never re-resolve differently;
+2. for the fixed-propagation family, materialize the propagation
+   table (``serve/propagation.py`` — streamed through the
+   ``StagingPool`` machinery, so >HBM graphs export the way they
+   train);
+3. AOT-compile every bucketed serve program into the persistent
+   compile cache (``utils/prewarm.warm_candidates`` — the same
+   warm-vs-cold accounting the bench children record) and assert
+   warm-hit parity with a second pass;
+4. write ``serve_manifest.json`` — program keys, quantized buckets,
+   the resolved model op list (``Model.to_spec``), and the model
+   fingerprint reusing checkpoint v2's strict half
+   (``utils/checkpoint.params_signature``) — next to ``params.npz``
+   and ``propagation.npz``.
+
+A cold server process (``load_predictor`` + ``serve/server.py``) then
+reaches first-query readiness with ZERO new compiles: its programs
+are keyed identically to the export-time warm set (asserted in
+tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.events import emit
+from .predictor import SERVE_BUCKETS, Predictor
+from .propagation import (PropagationCache, logits_table_cache,
+                          prefix_descriptors)
+
+MANIFEST_NAME = "serve_manifest.json"
+MANIFEST_VERSION = 1
+
+
+def _host_params(params) -> Dict[str, np.ndarray]:
+    import jax
+    # export-time persistence fetch, not a request-path sync
+    return {k: np.asarray(jax.device_get(v))  # roc-lint: ok=host-sync-hot-path
+            for k, v in params.items()}
+
+
+def resolve_backend(model, backend: str) -> Tuple[str, Optional[str]]:
+    """``(backend, flavor)``: 'auto' picks 'precomputed' (flavor
+    'akx') when the model has a parameter-free propagation prefix
+    (``Model.precompute_split`` — the SGC family), else 'full'.  An
+    explicit 'precomputed' on a model without the split serves the
+    frozen full-forward logits instead (flavor 'table' — the
+    decoupled APPNP shape)."""
+    has_split = model.precompute_split() is not None
+    if backend == "auto":
+        return (("precomputed", "akx") if has_split else ("full", None))
+    if backend == "precomputed":
+        return ("precomputed", "akx" if has_split else "table")
+    if backend == "full":
+        return ("full", None)
+    raise ValueError(f"unknown serve backend {backend!r}; expected "
+                     "'auto', 'precomputed', or 'full'")
+
+
+def _full_gctx(model, dataset, config):
+    from ..train.trainer import make_graph_context
+    return make_graph_context(
+        dataset, config.aggr_impl, config.chunk,
+        symmetric=config.symmetric,
+        sect_sub_w=config.sect_sub_w, sect_u16=config.sect_u16,
+        bdense_min_fill=config.bdense_min_fill,
+        bdense_a_budget=config.bdense_a_budget,
+        bdense_group=config.bdense_group,
+        verbose=config.verbose,
+        fuse=model.num_fused_aggregates() > 0,
+        head_chunk=0)
+
+
+def _num_classes(model) -> Optional[int]:
+    dims = [op.dim for op in model._ops if op.kind == "linear"]
+    return dims[-1] if dims else None
+
+
+def _full_logits_host(model, dataset, config, params) -> np.ndarray:
+    """The frozen full-forward logits — the 'table' flavor's
+    precompute.  Runs the eval forward ONCE at export (this program is
+    export-time-only; it is deliberately not part of the audited serve
+    set)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..train.trainer import cast_floats, compute_dtype_of
+    gctx = _full_gctx(model, dataset, config)
+    compute = compute_dtype_of(config)
+    feats = jnp.asarray(dataset.features, dtype=compute)
+
+    logits = jax.jit(
+        lambda p, f, g: model.apply(cast_floats(p, compute), f, g,
+                                    key=None, train=False)
+    )(params, feats, gctx)
+    # export-time precompute fetch, not a request-path sync
+    return np.asarray(jax.device_get(logits),  # roc-lint: ok=host-sync-hot-path
+                      dtype=np.float32)
+
+
+def build_predictor(model, dataset, config, params=None,
+                    backend: str = "auto",
+                    buckets: Sequence[int] = SERVE_BUCKETS,
+                    cache: Optional[PropagationCache] = None,
+                    verbose: bool = False) -> Predictor:
+    """Resolve + build a live Predictor.  ``params=None`` initializes
+    fresh weights (rig/benchmark use); ``cache`` short-circuits the
+    propagation precompute (the artifact loader passes the persisted
+    one — live builds compute it here)."""
+    import jax
+
+    from ..train.trainer import (resolve_config, resolve_symmetric)
+    import dataclasses
+    model, config, _ = resolve_config(model, dataset, config)
+    config = dataclasses.replace(
+        config, symmetric=resolve_symmetric(dataset, config.symmetric))
+    if params is None:
+        params = model.init_params(jax.random.PRNGKey(config.seed),
+                                   dtype=config.dtype)
+    backend, flavor = resolve_backend(model, backend)
+    head_model = None
+    gctx = None
+    if backend == "precomputed":
+        if flavor == "akx":
+            prefix_ops, head_model = model.precompute_split()
+            if cache is None:
+                cache = PropagationCache.build(
+                    dataset.graph, prefix_descriptors(prefix_ops),
+                    np.asarray(dataset.features))
+        elif cache is None:
+            cache = logits_table_cache(
+                _full_logits_host(model, dataset, config, params))
+    else:
+        gctx = _full_gctx(model, dataset, config)
+    emit("serve", f"predictor: backend={backend}"
+         + (f"/{flavor}" if flavor else "")
+         + f" buckets={tuple(sorted(buckets))} V={dataset.graph.num_nodes}",
+         console=verbose, kind="build", backend=backend, flavor=flavor)
+    return Predictor(model, config, params, backend, buckets,
+                     cache=cache, head_model=head_model, flavor=flavor,
+                     dataset=dataset if backend == "full" else None,
+                     gctx=gctx, num_classes=_num_classes(model),
+                     verbose=verbose)
+
+
+# ------------------------------------------------------------ artifact
+
+def export_predictor(pred: Predictor, out_dir: str,
+                     dataset_meta: Optional[Dict[str, Any]] = None,
+                     cache_dir: Optional[str] = None,
+                     verify_warm: bool = True) -> Dict[str, Any]:
+    """Persist ``pred`` as a serving artifact and pre-pay its compile
+    wall: params + propagation tables + manifest on disk, every bucket
+    program AOT-compiled into the persistent cache.  With
+    ``verify_warm`` a second AOT pass asserts every program is now a
+    warm hit — the prewarm-parity guarantee the manifest's
+    ``program_keys`` advertise.  Returns the manifest dict."""
+    from ..utils.checkpoint import params_signature
+    os.makedirs(out_dir, exist_ok=True)
+    host_params = _host_params(pred.params)
+    np.savez(os.path.join(out_dir, "params.npz"), **host_params)
+    if pred.cache is not None:
+        pred.cache.save(os.path.join(out_dir, "propagation.npz"))
+    import jax.numpy as jnp
+    cfg = pred.config
+    manifest: Dict[str, Any] = {
+        "version": MANIFEST_VERSION,
+        "backend": pred.backend,
+        "flavor": pred.flavor,
+        "buckets": list(pred.buckets),
+        "model": pred.model.to_spec(),
+        "num_classes": pred.num_classes,
+        "config": {
+            "dtype": str(jnp.dtype(cfg.dtype)),
+            "compute_dtype": (None if cfg.compute_dtype is None
+                              else str(jnp.dtype(cfg.compute_dtype))),
+            "aggr_impl": cfg.aggr_impl, "chunk": cfg.chunk,
+            "symmetric": bool(cfg.symmetric),
+            "sect_sub_w": cfg.sect_sub_w, "sect_u16": cfg.sect_u16,
+            "bdense_min_fill": cfg.bdense_min_fill,
+            "bdense_a_budget": cfg.bdense_a_budget,
+            "bdense_group": cfg.bdense_group,
+        },
+        # checkpoint v2's strict half, reused verbatim: a server can
+        # hold an artifact against the checkpoint lineage it claims
+        "fingerprint": {
+            "params_sig": params_signature(host_params),
+            "dtype": str(jnp.dtype(cfg.dtype)),
+            "compute_dtype": (None if cfg.compute_dtype is None
+                              else str(jnp.dtype(cfg.compute_dtype))),
+            "dataset": dict(dataset_meta or {}),
+        },
+        "dataset": dict(dataset_meta or {}),
+        "num_nodes": pred.num_nodes,
+        "program_keys": pred.program_keys(),
+        "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
+    warm = pred.warm(cache_dir=cache_dir, name="serve_export")
+    manifest["prewarm"] = {k: warm.get(k) for k in
+                          ("programs", "compile_warm_hits",
+                           "compile_cold", "failed", "prewarm_s",
+                           "cache_unavailable")}
+    if warm.get("failed"):
+        raise RuntimeError(
+            f"serve export: {warm['failed']} program(s) failed to "
+            f"AOT-compile — the artifact would cold-compile at first "
+            f"query; see the compile events")
+    if verify_warm and not warm.get("cache_unavailable"):
+        check = pred.warm(cache_dir=cache_dir, name="serve_verify")
+        manifest["prewarm"]["verified_warm_hits"] = \
+            check.get("compile_warm_hits")
+        if check.get("compile_warm_hits") != check.get("programs"):
+            raise RuntimeError(
+                f"serve export warm-hit parity FAILED: "
+                f"{check.get('compile_warm_hits')} of "
+                f"{check.get('programs')} programs warm on the second "
+                f"pass — the persistent cache is not serving the "
+                f"programs just compiled (unstable cache key?)")
+    path = os.path.join(out_dir, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    emit("serve", f"artifact exported to {out_dir}: {pred.backend}"
+         + (f"/{pred.flavor}" if pred.flavor else "")
+         + f", {len(manifest['program_keys'])} programs "
+         f"({manifest['prewarm']['compile_warm_hits']} warm/"
+         f"{manifest['prewarm']['compile_cold']} cold)",
+         kind="export", path=out_dir, backend=pred.backend)
+    return manifest
+
+
+def export_trainer(trainer, dataset, out_dir: str,
+                   backend: str = "auto",
+                   buckets: Sequence[int] = SERVE_BUCKETS,
+                   cache_dir: Optional[str] = None,
+                   verify_warm: bool = True) -> Dict[str, Any]:
+    """Export a LIVE trainer's weights as a serving artifact — works
+    for both ``Trainer`` and ``DistributedTrainer`` (replicated params
+    fetch identically); the trainer's model/config are already
+    resolved, and ``resolve_config`` is idempotent, so the artifact
+    records exactly what trained."""
+    pred = build_predictor(
+        trainer.model, dataset, trainer.config,
+        params=trainer.params, backend=backend, buckets=buckets)
+    meta = {"V": int(dataset.graph.num_nodes),
+            "E": int(dataset.graph.num_edges),
+            "name": getattr(dataset, "name", None)}
+    return export_predictor(pred, out_dir, dataset_meta=meta,
+                            cache_dir=cache_dir,
+                            verify_warm=verify_warm)
+
+
+def load_predictor(artifact_dir: str, dataset=None,
+                   verbose: bool = False) -> Predictor:
+    """Rebuild a Predictor from an exported artifact — the cold-server
+    path.  No resolve pass runs here: the manifest carries the
+    RESOLVED model op list and config fields, so the programs built
+    are keyed identically to the export-time warm set.  ``dataset`` is
+    required for the full-graph backend only (precomputed artifacts
+    are self-contained)."""
+    import jax.numpy as jnp
+
+    from ..models.builder import Model
+    from ..train.trainer import TrainConfig
+    from ..utils.checkpoint import params_signature
+    with open(os.path.join(artifact_dir, MANIFEST_NAME)) as f:
+        manifest = json.load(f)
+    if manifest.get("version") != MANIFEST_VERSION:
+        raise ValueError(
+            f"{artifact_dir}: manifest version "
+            f"{manifest.get('version')} != {MANIFEST_VERSION}")
+    model = Model.from_spec(manifest["model"])
+    mc = manifest["config"]
+    config = TrainConfig(
+        verbose=verbose, memory="manual", aggr_fuse="off",
+        dtype=jnp.dtype(mc["dtype"]),
+        compute_dtype=(None if mc["compute_dtype"] is None
+                       else jnp.dtype(mc["compute_dtype"])),
+        aggr_impl=mc["aggr_impl"], chunk=mc["chunk"],
+        symmetric=mc["symmetric"], sect_sub_w=mc["sect_sub_w"],
+        sect_u16=mc["sect_u16"],
+        bdense_min_fill=mc["bdense_min_fill"],
+        bdense_a_budget=mc["bdense_a_budget"],
+        bdense_group=mc["bdense_group"])
+    with np.load(os.path.join(artifact_dir, "params.npz")) as z:
+        params = {k: jnp.asarray(z[k], dtype=config.dtype)
+                  for k in z.files}
+    sig = params_signature(params)
+    want = (manifest.get("fingerprint") or {}).get("params_sig")
+    if want and sig != want:
+        raise ValueError(
+            f"{artifact_dir}: params fingerprint mismatch ({sig} != "
+            f"manifest {want}) — params.npz does not belong to this "
+            f"manifest")
+    backend, flavor = manifest["backend"], manifest.get("flavor")
+    cache = None
+    head_model = None
+    gctx = None
+    if backend == "precomputed":
+        cache = PropagationCache.load(
+            os.path.join(artifact_dir, "propagation.npz"))
+        if flavor == "akx":
+            head_model = model.precompute_split()[1]
+    else:
+        if dataset is None:
+            raise ValueError(
+                "full-graph serving needs the dataset (the graph is "
+                "not part of the artifact); pass dataset=")
+        want_v = int(manifest["num_nodes"])
+        want_e = (manifest.get("dataset") or {}).get("E")
+        if int(dataset.graph.num_nodes) != want_v or (
+                want_e is not None
+                and int(dataset.graph.num_edges) != int(want_e)):
+            raise ValueError(
+                f"dataset V={dataset.graph.num_nodes}/"
+                f"E={dataset.graph.num_edges} != artifact "
+                f"V={want_v}/E={want_e} — full-graph serving on a "
+                f"different graph than the export would be silently "
+                f"wrong")
+        gctx = _full_gctx(model, dataset, config)
+    pred = Predictor(model, config, params, backend,
+                     manifest["buckets"], cache=cache,
+                     head_model=head_model, flavor=flavor,
+                     dataset=dataset if backend == "full" else None,
+                     gctx=gctx,
+                     num_classes=manifest.get("num_classes"),
+                     verbose=verbose)
+    live = pred.program_keys()
+    if sorted(manifest.get("program_keys") or []) != live:
+        raise ValueError(
+            f"{artifact_dir}: rebuilt program keys differ from the "
+            f"manifest — this server would cold-compile; re-export "
+            f"(manifest {len(manifest.get('program_keys') or [])} vs "
+            f"live {len(live)})")
+    return pred
+
+
+# ----------------------------------------------------------------- CLI
+
+def parse_args(argv: Optional[List[str]] = None):
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m roc_tpu.export", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", required=True,
+                    help="artifact directory (created)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="training checkpoint (.npz) to export; "
+                         "omitted = fresh Glorot weights (latency "
+                         "rehearsal only — the export says so loudly)")
+    ap.add_argument("--backend", default="auto",
+                    choices=["auto", "precomputed", "full"],
+                    help="'auto' = precomputed propagation for the "
+                         "fixed-propagation family (SGC shape), full-"
+                         "graph recompute otherwise")
+    ap.add_argument("--buckets", default=None,
+                    help="comma list of microbatch buckets (default "
+                         f"{','.join(str(b) for b in SERVE_BUCKETS)})")
+    ap.add_argument("--model", default="gcn",
+                    choices=["gcn", "sage", "gin", "gat", "sgc",
+                             "appnp", "gcn2"])
+    ap.add_argument("-layers", default="16-16-4",
+                    help="dash-separated dims (train/cli.py "
+                         "convention)")
+    ap.add_argument("--hops", type=int, default=None)
+    ap.add_argument("--alpha", type=float, default=None)
+    ap.add_argument("--lam", type=float, default=None)
+    ap.add_argument("--heads", type=int, default=1)
+    ap.add_argument("-dropout", type=float, default=0.5)
+    ap.add_argument("-seed", type=int, default=1)
+    ap.add_argument("-file", default=None, dest="file",
+                    help="dataset prefix (default: the synthetic "
+                         "smoke dataset, matching the training CLI)")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "bfloat16", "mixed"])
+    ap.add_argument("--impl", default="auto")
+    ap.add_argument("--fuse", default="auto",
+                    choices=["auto", "on", "off"])
+    ap.add_argument("--cache-dir", default=None,
+                    help="persistent compile cache dir (default: "
+                         "$ROC_TPU_CACHE_DIR or ~/.cache/roc_tpu/xla)")
+    ap.add_argument("--no-verify-warm", action="store_true",
+                    help="skip the second AOT pass that asserts "
+                         "warm-hit parity")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--events", default=None)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    return ap.parse_args(argv)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import sys
+    args = parse_args(argv)
+    if args.events:
+        os.environ["ROC_TPU_EVENTS"] = args.events
+        from ..obs.events import configure
+        configure(jsonl_path=args.events)
+    import jax
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    layers = [int(x) for x in args.layers.split("-")]
+    if len(layers) < 2:
+        print("error: -layers needs at least in-dim and classes",
+              file=sys.stderr)
+        return 2
+    from ..core.graph import load_dataset, synthetic_dataset
+    from ..models import model_builders
+    from ..train.trainer import TrainConfig, resolve_dtypes
+    if args.file:
+        ds = load_dataset(args.file, in_dim=layers[0],
+                          num_classes=layers[-1])
+    else:
+        ds = synthetic_dataset(512, 8, in_dim=layers[0],
+                               num_classes=layers[-1], seed=args.seed)
+    kwargs: Dict[str, Any] = {}
+    if args.model == "gat":
+        kwargs["heads"] = args.heads
+    if args.model in ("sgc", "appnp"):
+        kwargs["k"] = (args.hops if args.hops is not None
+                       else (2 if args.model == "sgc" else 10))
+    if args.model in ("appnp", "gcn2"):
+        kwargs["alpha"] = args.alpha if args.alpha is not None else 0.1
+    if args.model == "gcn2":
+        kwargs["lam"] = args.lam if args.lam is not None else 0.5
+    model = model_builders()[args.model](
+        layers, dropout_rate=args.dropout, **kwargs)
+    dt, cdt = resolve_dtypes(args.dtype)
+    config = TrainConfig(verbose=args.verbose, seed=args.seed,
+                         aggr_impl=args.impl, aggr_fuse=args.fuse,
+                         dtype=dt, compute_dtype=cdt)
+    params = None
+    if args.checkpoint:
+        from ..utils.checkpoint import restore_params_only
+        params, fp, epoch = restore_params_only(args.checkpoint)
+        strict = (fp or {}).get("strict") or {}
+        import jax.numpy as jnp
+        if strict.get("dtype") and \
+                strict["dtype"] != str(jnp.dtype(dt)):
+            print(f"error: checkpoint dtype {strict['dtype']} != "
+                  f"--dtype {jnp.dtype(dt)} — export with the "
+                  f"training dtype", file=sys.stderr)
+            return 2
+        emit("serve", f"weights from {args.checkpoint} (epoch "
+             f"{epoch})", kind="restore", epoch=epoch)
+        params = {k: jnp_cast(v, dt) for k, v in params.items()}
+    else:
+        emit("serve", "no --checkpoint: exporting FRESH Glorot "
+             "weights (latency rehearsal, not a trained model)",
+             kind="fresh_params")
+    buckets = (SERVE_BUCKETS if not args.buckets
+               else tuple(int(b) for b in args.buckets.split(",")))
+    pred = build_predictor(model, ds, config, params=params,
+                           backend=args.backend, buckets=buckets,
+                           verbose=args.verbose)
+    meta = {"V": int(ds.graph.num_nodes),
+            "E": int(ds.graph.num_edges),
+            "name": getattr(ds, "name", None),
+            "prefix": args.file}
+    manifest = export_predictor(pred, args.out, dataset_meta=meta,
+                                cache_dir=args.cache_dir,
+                                verify_warm=not args.no_verify_warm)
+    print(json.dumps({
+        "artifact": args.out, "backend": manifest["backend"],
+        "flavor": manifest["flavor"],
+        "programs": len(manifest["program_keys"]),
+        "buckets": manifest["buckets"],
+        "prewarm": manifest["prewarm"]}))
+    return 0
+
+
+def jnp_cast(v, dtype):
+    import jax.numpy as jnp
+    return jnp.asarray(v, dtype=dtype)
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -1,0 +1,284 @@
+"""The serving runtime: bucketed, AOT-warmable predict programs.
+
+A :class:`Predictor` owns frozen params plus ONE compiled program per
+batch bucket — the finite, auditable program set the ISSUE's serving
+tier is built around:
+
+- ``backend='precomputed'`` (the SGC/APPNP fixed-propagation family):
+  a device-resident propagation table (``serve/propagation.py``) and a
+  per-bucket ``gather rows → dense head`` program — microsecond-scale
+  per dispatch, no graph op anywhere on the request path.  Flavor
+  ``akx`` carries ``S^k X`` + the dense head; flavor ``table`` carries
+  the frozen full-forward logits (the decoupled APPNP shape, where
+  propagation runs after the MLP) and the head degenerates to the
+  gather itself.
+- ``backend='full'``: the honest always-fresh path — every dispatch
+  runs the full-graph forward (the same resolved aggregation layout
+  the trainer used) and gathers the queried rows on device.  This is
+  the baseline the ``benchmarks/micro_serve.py`` speedup is measured
+  against, and the fallback for models whose propagation is not fixed.
+
+Request batch sizes quantize to :data:`SERVE_BUCKETS` so the program
+set stays finite — the program-space auditor enumerates exactly these
+programs (``analysis/programspace.py`` rig ``sgc_serve``) and
+``python -m roc_tpu.prewarm`` / the export step AOT-compile them into
+the persistent cache, so a cold server process answers its first query
+with ZERO new compiles (program-key parity asserted in
+tests/test_serve.py).
+
+Every program compiles through ``ObservedJit`` — serve compiles emit
+the same ``compile`` events (program key, lower/compile seconds) the
+training slots do, so the warm-start assertion is checkable from the
+event stream alone.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.events import emit
+from .propagation import PropagationCache
+
+# Quantized microbatch sizes — the ONLY ids shapes a server ever
+# dispatches.  Quantization is what keeps the serve program set finite
+# and auditable (same philosophy as core/partition.quantize_plan_
+# shapes, but bucket sizes are request shapes, not partition shapes —
+# the auditor's drift rule exempts them exactly like the streamed
+# head's block variants).
+SERVE_BUCKETS: Tuple[int, ...] = (1, 8, 64, 512)
+
+
+def bucket_for(n: int, buckets: Sequence[int]) -> int:
+    """Smallest bucket >= n (the padded dispatch size); requests past
+    the largest bucket split into largest-bucket chunks upstream."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return max(buckets)
+
+
+class Predictor:
+    """Frozen-params query engine; see module docstring.
+
+    Construct via :func:`roc_tpu.serve.export.build_predictor` (live
+    objects) or :func:`roc_tpu.serve.export.load_predictor` (an
+    exported artifact) — the two run the IDENTICAL build path, which
+    is what makes export-time program keys and a cold server's
+    programs provably the same set.
+    """
+
+    def __init__(self, model, config, params,
+                 backend: str, buckets: Sequence[int],
+                 cache: Optional[PropagationCache] = None,
+                 head_model=None, flavor: Optional[str] = None,
+                 dataset=None, gctx=None,
+                 num_classes: Optional[int] = None,
+                 verbose: bool = False):
+        import jax.numpy as jnp
+
+        from ..train.trainer import compute_dtype_of
+        self.model = model
+        self.config = config
+        self.params = params
+        self.backend = backend
+        self.flavor = flavor
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets or any(b < 1 for b in self.buckets):
+            raise ValueError(f"bad serve buckets {buckets!r}")
+        self.compute = compute_dtype_of(config)
+        self.cache = cache
+        self.head_model = head_model
+        self.verbose = verbose
+        self._jits: Dict[int, Any] = {}
+        if backend == "precomputed":
+            if cache is None:
+                raise ValueError("precomputed backend needs a "
+                                 "PropagationCache")
+            self.num_nodes = cache.num_nodes
+            # dummy zero row at index V — padded ids gather zeros
+            # (their logits are sliced off host-side); the table is
+            # device-resident in the COMPUTE dtype, uploaded once
+            t = np.concatenate(
+                [cache.table,
+                 np.zeros((1, cache.table.shape[1]), np.float32)])
+            self.table = jnp.asarray(t, dtype=self.compute)
+            self.pad_id = self.num_nodes
+            self._gctx = self._trivial_gctx()
+        elif backend == "full":
+            if dataset is None or gctx is None:
+                raise ValueError("full backend needs dataset + gctx "
+                                 "(full-graph serving needs the graph "
+                                 "by definition)")
+            self.num_nodes = dataset.graph.num_nodes
+            self.feats = jnp.asarray(dataset.features,
+                                     dtype=self.compute)
+            self._gctx = gctx
+            self.pad_id = 0   # any valid row; padded outputs discarded
+        else:
+            raise ValueError(f"unknown serve backend {backend!r}; "
+                             f"expected 'precomputed' or 'full'")
+        self.num_classes = num_classes
+        self._build_jits()
+
+    # ------------------------------------------------------- programs
+
+    def _trivial_gctx(self):
+        """A graph-free context for the dense head: precompute_split
+        guarantees no head op touches the graph, so every graph field
+        is a stub (the one-element arrays keep the pytree shape
+        stable across processes — part of the program key)."""
+        import jax.numpy as jnp
+
+        from ..models.builder import GraphContext
+        return GraphContext(
+            edge_src=jnp.zeros(1, jnp.int32),
+            edge_dst=jnp.zeros(1, jnp.int32),
+            in_degree=jnp.zeros(1, jnp.int32),
+            num_rows=1, gathered_rows=1, aggr_impl="segment",
+            symmetric=True)
+
+    def _build_jits(self) -> None:
+        from ..obs.compile_watch import ObservedJit
+        for b in self.buckets:
+            self._jits[b] = ObservedJit(
+                self._serve_step, name=self._slot(b),
+                verbose=self.verbose)
+
+    def _slot(self, bucket: int) -> str:
+        tag = (f"precomputed_{self.flavor}"
+               if self.backend == "precomputed" else "full")
+        return f"serve_{tag}:{bucket}"
+
+    def _serve_step(self, *args):
+        import jax.numpy as jnp
+
+        from ..train.trainer import cast_floats
+        if self.backend == "precomputed":
+            params, table, ids, gctx = args
+            x = jnp.take(table, ids, axis=0)
+            if self.flavor == "table":
+                return x
+            return self.head_model.apply(
+                cast_floats(params, self.compute), x, gctx,
+                key=None, train=False)
+        params, feats, ids, gctx = args
+        logits = self.model.apply(cast_floats(params, self.compute),
+                                  feats, gctx, key=None, train=False)
+        return jnp.take(logits, ids, axis=0)
+
+    def _args_for(self, ids):
+        """The per-dispatch argument tuple — ONE construction shared
+        by the live call path and the candidate enumeration, so the
+        auditor/prewarm keys and the runtime programs cannot drift."""
+        if self.backend == "precomputed":
+            return (self.params, self.table, ids, self._gctx)
+        return (self.params, self.feats, ids, self._gctx)
+
+    def serve_candidates(self) -> List[Any]:
+        """The exact serve program set, as prewarmable auditor
+        candidates (``analysis/programspace.Candidate``) — one program
+        per bucket.  ``observed=False``: bucket sizes are request
+        shapes, not partition shapes (the cache-key-drift rule's
+        head-block exemption applies verbatim), but the programs still
+        count against the ``program_budget`` ratchet and the prewarm
+        driver AOT-compiles every one."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..analysis.programspace import Candidate
+        cands: List[Any] = []
+        for b in self.buckets:
+            ids = jax.ShapeDtypeStruct((b,), jnp.dtype(jnp.int32))
+            args = self._args_for(ids)
+            jit = self._jits[b]._jit
+            cands.append(Candidate(
+                slot=self._slot(b), fn=jit, args=args, donate=(),
+                observed=False,
+                aot=lambda j=jit, a=args: j.lower(*a).compile()))
+        return cands
+
+    def warm(self, cache_dir: Optional[str] = None,
+             name: str = "serve") -> Dict[str, Any]:
+        """AOT-compile every bucket program against the persistent
+        cache (the export step calls this, and a cold server may too —
+        first-query readiness becomes a warm-hit report instead of a
+        latency spike)."""
+        from ..utils.compile_cache import enable_compile_cache
+        from ..utils.prewarm import warm_candidates
+        d = enable_compile_cache(cache_dir, min_compile_secs=0.0)
+        return warm_candidates(self.serve_candidates(), d, config=name,
+                               verbose=self.verbose)
+
+    def program_keys(self) -> List[str]:
+        from ..obs.compile_watch import program_key_of
+        return sorted(program_key_of(c.slot, c.args, c.donate)
+                      for c in self.serve_candidates())
+
+    # --------------------------------------------------------- queries
+
+    def query_device(self, ids_padded):
+        """One padded-bucket dispatch; returns the device logits
+        ``[bucket, C]``.  ``ids_padded`` length must be a bucket."""
+        b = int(ids_padded.shape[0])
+        if b not in self._jits:
+            raise ValueError(f"ids length {b} is not a bucket "
+                             f"{self.buckets}")
+        return self._jits[b](*self._args_for(ids_padded))
+
+    def query(self, node_ids) -> np.ndarray:
+        """Synchronous convenience path: pad to the smallest fitting
+        bucket, dispatch, fetch, slice.  The microbatch server
+        (``serve/server.py``) is the production entry — it coalesces
+        concurrent requests into one dispatch; this method is the
+        single-caller form the parity tests pin."""
+        import jax
+        import jax.numpy as jnp
+        ids = np.asarray(node_ids, dtype=np.int32).ravel()
+        if ids.size and (ids.min() < 0 or ids.max() >= self.num_nodes):
+            raise ValueError(
+                f"node ids out of range [0, {self.num_nodes})")
+        out: List[np.ndarray] = []
+        cap = max(self.buckets)
+        for lo in range(0, ids.size, cap):
+            chunk = ids[lo:lo + cap]
+            b = bucket_for(chunk.size, self.buckets)
+            padded = np.full(b, self.pad_id, dtype=np.int32)
+            padded[:chunk.size] = chunk
+            logits = self.query_device(jnp.asarray(padded))
+            # the result fetch IS this tier's product — the one
+            # sanctioned host sync on the serve path
+            got = jax.device_get(logits)  # roc-lint: ok=host-sync-hot-path
+            out.append(np.asarray(got[:chunk.size], dtype=np.float32))
+        return (np.concatenate(out) if out
+                else np.zeros((0, self.num_classes or 0), np.float32))
+
+    # ---------------------------------------------------- invalidation
+
+    def invalidate(self, src, dst) -> int:
+        """Edge-append invalidation hook: incrementally recompute the
+        k-hop neighborhood rows of the propagation table
+        (``PropagationCache.add_edges``) and refresh exactly those
+        rows in the device copy.  Returns the number of rows
+        refreshed.  Control-plane op — the scatter below compiles a
+        tiny program per affected-set shape, deliberately OUTSIDE the
+        audited serve set (mutations are rare; quantizing them would
+        complicate the hot path for nothing)."""
+        if self.backend != "precomputed" or self.cache is None:
+            raise NotImplementedError(
+                "invalidation needs the precomputed backend (full-"
+                "graph serving recomputes every dispatch anyway)")
+        rows = self.cache.add_edges(src, dst)
+        self.refresh_rows(rows)
+        return int(rows.size)
+
+    def refresh_rows(self, rows: np.ndarray) -> None:
+        import jax.numpy as jnp
+        if rows.size == 0:
+            return
+        vals = jnp.asarray(
+            self.cache.table[rows].astype(np.float32),
+            dtype=self.compute)
+        self.table = self.table.at[jnp.asarray(
+            rows.astype(np.int32))].set(vals)

@@ -1,0 +1,260 @@
+"""Precomputed-propagation tables: ``S^k X`` materialized once, served
+forever (until an edge changes).
+
+The SGC family's propagation is parameter-free and fixed
+(``models/sgc.py``: ``logits = softmax(S^k X W)``), so at serving time
+the whole graph part of the model collapses to a lookup table: evaluate
+the norm/aggregate prefix ONCE at export (through the existing
+streamed machinery — ``core/streaming.aggregate_to_host`` stages
+feature blocks via the ``StagingPool``, so a >HBM graph exports the
+same way it trains), keep the per-op intermediates host-side, and
+answer node queries with a row gather + the dense head.
+
+:class:`PropagationCache` owns the tables AND the invalidation hook:
+when a vertex's edges change, only the rows inside the changed
+vertices' k-hop out-neighborhood can change — the cache walks the op
+chain once, growing the affected row set at each aggregation hop and
+recomputing exactly those rows from the stored previous-stage values
+(norm ops are row-local; aggregations spread one hop).  An edge append
+on a Reddit-scale k=2 SGC touches O(deg^2) rows, not O(V).
+
+Symmetric graphs only (out-neighbors == in-neighbors, so the CSR
+serves both directions) — the same invariant the training aggregation
+backward already requires (``scattergather_kernel.cu:160-170``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs.events import emit
+
+# the op-descriptor vocabulary (kind + the attrs that matter) — the
+# serializable mirror of the builder _Op kinds stream_prefix_to_host
+# accepts, persisted in the serving manifest
+PREFIX_KINDS = ("indegree_norm", "scatter_gather", "fused_aggregate")
+
+
+def prefix_descriptors(prefix_ops) -> List[Dict[str, Any]]:
+    """Builder ``_Op`` list → JSON-serializable descriptors."""
+    out = []
+    for op in prefix_ops:
+        if op.kind not in PREFIX_KINDS:
+            raise NotImplementedError(
+                f"non-propagation op {op.kind!r} in a precompute "
+                f"prefix")
+        d: Dict[str, Any] = {"kind": op.kind}
+        if op.kind == "scatter_gather":
+            d["aggr"] = op.attrs.get("aggr", "sum")
+        if op.kind == "fused_aggregate":
+            d["activation"] = op.attrs.get("activation", "none")
+        out.append(d)
+    return out
+
+
+def _inv_sqrt_degree(in_degree: np.ndarray) -> np.ndarray:
+    from ..ops.norm import inv_sqrt_degree_np
+    return inv_sqrt_degree_np(in_degree)
+
+
+class PropagationCache:
+    """Host-resident propagation tables with incremental recompute.
+
+    ``stages[i]`` holds the fp32 ``[V, F]`` value AFTER prefix op
+    ``i`` (``stages[-1]`` is the serving table); ``x0`` is the raw
+    feature matrix the chain starts from.  O(n_ops · V · F) host
+    bytes — the price of exact incremental invalidation; a deployment
+    that never mutates edges can drop everything but ``stages[-1]``
+    (``table_only=True`` restores that footprint and turns
+    :meth:`add_edges` into a loud error instead of silent staleness).
+    """
+
+    def __init__(self, row_ptr: np.ndarray, col_idx: np.ndarray,
+                 ops: Sequence[Dict[str, Any]], x0: np.ndarray,
+                 stages: List[np.ndarray]):
+        self.row_ptr = np.asarray(row_ptr, dtype=np.int64)
+        self.col_idx = np.asarray(col_idx, dtype=np.int32)
+        self.ops = [dict(op) for op in ops]
+        self.x0 = x0
+        self.stages = stages
+        self.inv_sqrt = _inv_sqrt_degree(np.diff(self.row_ptr))
+
+    # ------------------------------------------------------------ build
+
+    @classmethod
+    def build(cls, graph, ops: Sequence[Dict[str, Any]],
+              feats: np.ndarray, block_rows: int = 65536,
+              prefetch: int = 1,
+              table_only: bool = False) -> "PropagationCache":
+        """Evaluate the prefix over the whole graph through THE
+        trainer's own precompute walk
+        (``core/streaming.stream_prefix_to_host`` — feature blocks
+        staged via the ``StagingPool``, so >HBM graphs export the way
+        they train, and serve tables can never diverge numerically
+        from the streamed tier's), capturing the per-op intermediates
+        for incremental invalidation."""
+        from ..core.streaming import stream_prefix_to_host
+        x0 = np.asarray(feats, dtype=np.float32).copy()
+        stages: List[np.ndarray] = []
+        stream_prefix_to_host(graph, list(ops), x0,
+                              block_rows=block_rows,
+                              prefetch=prefetch, capture=stages)
+        if not stages:
+            raise ValueError("empty propagation prefix")
+        if table_only:
+            stages = [stages[-1]]
+            x0 = np.zeros((0, 0), dtype=np.float32)
+            ops = [{"kind": "opaque"}]
+        return cls(graph.row_ptr, graph.col_idx, ops, x0, stages)
+
+    @property
+    def table(self) -> np.ndarray:
+        """The serving table: the final prefix stage, fp32 [V, F]."""
+        return self.stages[-1]
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self.row_ptr.shape[0] - 1)
+
+    # ----------------------------------------------------- invalidation
+
+    def _in_rows(self, r: int) -> np.ndarray:
+        return self.col_idx[self.row_ptr[r]:self.row_ptr[r + 1]]
+
+    def _neighbors_of(self, rows: np.ndarray) -> np.ndarray:
+        """Union of the rows' neighborhoods (symmetric CSR, so in- and
+        out-neighbors coincide)."""
+        if rows.size == 0:
+            return rows
+        chunks = [self.col_idx[self.row_ptr[r]:self.row_ptr[r + 1]]
+                  for r in rows]
+        return np.unique(np.concatenate(chunks)) if chunks else rows
+
+    def add_edges(self, src, dst) -> np.ndarray:
+        """Append edges and incrementally recompute every stage row the
+        change can reach; returns the final-stage rows that changed (the
+        caller refreshes the device copy of exactly those rows —
+        ``Predictor.refresh_rows``).  ``src``/``dst`` are parallel id
+        arrays; symmetric graphs need BOTH directions listed (the same
+        contract as the training loader's edge lists).  Exact: the
+        recomputed rows equal a full rebuild on the mutated graph to
+        fp32 roundoff (tests/test_serve.py parity)."""
+        if len(self.ops) == 1 and self.ops[0].get("kind") == "opaque":
+            raise NotImplementedError(
+                "this cache was built table_only=True (or holds a "
+                "full-logits table) — incremental invalidation needs "
+                "the per-op stages; re-export the artifact instead")
+        src = np.asarray(src, dtype=np.int32).ravel()
+        dst = np.asarray(dst, dtype=np.int32).ravel()
+        if src.shape != dst.shape:
+            raise ValueError("src/dst length mismatch")
+        V = self.num_nodes
+        if src.size and (src.min() < 0 or src.max() >= V
+                         or dst.min() < 0 or dst.max() >= V):
+            raise ValueError(f"edge ids out of range [0, {V})")
+        # CSR insert: new edge (s, d) lands in row d's slice.  One
+        # O(E) rebuild per invalidation batch — control-plane cost,
+        # amortized over every query until the next mutation.
+        order = np.argsort(dst, kind="stable")
+        s_sorted, d_sorted = src[order], dst[order]
+        insert_at = self.row_ptr[d_sorted + 1]
+        new_col = np.insert(self.col_idx, insert_at, s_sorted)
+        counts = np.bincount(d_sorted, minlength=V).astype(np.int64)
+        new_ptr = self.row_ptr + np.concatenate(
+            ([0], np.cumsum(counts)))
+        self.row_ptr, self.col_idx = new_ptr, new_col
+        # degrees changed on the destination rows: their norm scaling
+        # changes at EVERY norm stage, so they seed the affected set
+        changed = np.unique(d_sorted)
+        self.inv_sqrt = _inv_sqrt_degree(np.diff(self.row_ptr))
+        deg = np.maximum(np.diff(self.row_ptr).astype(np.float32), 1.0)
+        affected = changed
+        prev_of = [self.x0] + self.stages[:-1]
+        for i, op in enumerate(self.ops):
+            prev, cur = prev_of[i], self.stages[i]
+            kind = op["kind"]
+            if kind == "indegree_norm":
+                cur[affected] = (prev[affected]
+                                 * self.inv_sqrt[affected, None])
+            elif kind in ("scatter_gather", "fused_aggregate"):
+                # one hop of spread: rows whose in-neighborhood
+                # includes an affected row, plus the rows whose edge
+                # set itself changed (already seeded in `affected`)
+                affected = np.union1d(affected,
+                                      self._neighbors_of(affected))
+                if kind == "fused_aggregate":
+                    # pre-scale only the source rows actually gathered
+                    # (O(affected·deg), never O(V))
+                    for r in affected:
+                        nbr = self._in_rows(r)
+                        cur[r] = (prev[nbr]
+                                  * self.inv_sqrt[nbr, None]).sum(axis=0)
+                else:
+                    for r in affected:
+                        cur[r] = prev[self._in_rows(r)].sum(axis=0)
+                if kind == "fused_aggregate":
+                    cur[affected] *= self.inv_sqrt[affected, None]
+                    if op.get("activation", "none") != "none":
+                        # plain assignment, NOT out= on a fancy index
+                        # (that writes into a temporary copy and the
+                        # stage would keep pre-relu values)
+                        cur[affected] = np.maximum(cur[affected], 0.0)
+                elif op.get("aggr", "sum") == "avg":
+                    cur[affected] /= deg[affected, None]
+            else:  # pragma: no cover - build() rejects unknown kinds
+                raise NotImplementedError(kind)
+        emit("serve", f"invalidate: {src.size} edge(s) appended, "
+             f"{affected.size} table row(s) recomputed "
+             f"({affected.size / max(V, 1):.2%} of V)", console=False,
+             kind="invalidate", edges=int(src.size),
+             rows=int(affected.size))
+        return affected
+
+    # ------------------------------------------------------ persistence
+
+    def save(self, path: str) -> None:
+        import json
+        import os
+        import tempfile
+        data: Dict[str, np.ndarray] = {
+            "row_ptr": self.row_ptr, "col_idx": self.col_idx,
+            "x0": self.x0,
+            "ops": np.frombuffer(json.dumps(self.ops).encode(),
+                                 dtype=np.uint8).copy()}
+        for i, s in enumerate(self.stages):
+            data[f"stage_{i}"] = s
+        d = os.path.dirname(os.path.abspath(path)) or "."
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **data)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    @classmethod
+    def load(cls, path: str) -> "PropagationCache":
+        import json
+        with np.load(path) as z:
+            ops = json.loads(bytes(np.asarray(z["ops"])).decode())
+            stages = [z[f"stage_{i}"]
+                      for i in range(sum(1 for k in z.files
+                                         if k.startswith("stage_")))]
+            return cls(z["row_ptr"], z["col_idx"], ops, z["x0"],
+                       stages)
+
+
+def logits_table_cache(table: np.ndarray) -> PropagationCache:
+    """Wrap a precomputed full-logits table (the gather-only flavor
+    serving the APPNP/decoupled family, where propagation runs AFTER
+    the MLP and the frozen forward itself is the cacheable object) in
+    the same container.  No stages, no graph — :meth:`add_edges`
+    refuses with the re-export message."""
+    t = np.asarray(table, dtype=np.float32)
+    V = t.shape[0]
+    return PropagationCache(
+        np.zeros(V + 1, dtype=np.int64), np.zeros(0, dtype=np.int32),
+        [{"kind": "opaque"}], np.zeros((0, 0), dtype=np.float32), [t])

@@ -271,10 +271,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         from ..utils.compile_cache import enable_compile_cache
         enable_compile_cache(min_compile_secs=args.cache_min_secs)
     from ..core.graph import load_dataset, synthetic_dataset
-    from ..models.gcn import build_gcn
-    from ..models.sage import build_sage
-    from ..models.gin import build_gin
-    from ..models.gat import build_gat
     from .trainer import TrainConfig, Trainer, resolve_dtypes
     from ..parallel.distributed import DistributedTrainer
     from ..utils.checkpoint import checkpoint_trainer, restore_trainer
@@ -421,12 +417,8 @@ def main(argv: Optional[List[str]] = None) -> int:
          f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
          f"impl={args.impl}")
 
-    from ..models.appnp import build_appnp
-    from ..models.gcn2 import build_gcn2
-    from ..models.sgc import build_sgc
-    build = {"gcn": build_gcn, "sage": build_sage, "gin": build_gin,
-             "gat": build_gat, "sgc": build_sgc, "appnp": build_appnp,
-             "gcn2": build_gcn2}
+    from ..models import model_builders
+    build = model_builders()
     kwargs = {"heads": args.heads} if args.model == "gat" else {}
     if args.model == "gin" and args.learn_eps:
         kwargs["learn_eps"] = True

@@ -1112,23 +1112,40 @@ class Trainer:
         from ..utils.profiling import sync
         sync(self.params)
 
-    def predict(self) -> jax.Array:
+    def predict(self, node_ids=None) -> jax.Array:
         """[V, C] inference-mode logits (the tensor the reference only
         ever reduces to metrics, softmax_kernel.cu:41-79 — exposed so
         a user can export predictions).  Runs the EVAL program and
         takes its logits output — predict compiles nothing of its own
         (program-space consolidation: one compiled program serves
         evaluate and predict; still jitted, so the eager interpreter
-        never holds every intermediate activation alive)."""
+        never holds every intermediate activation alive).
+
+        ``node_ids`` gathers a ``[len(ids), C]`` row subset ON DEVICE
+        — the full ``[V, C]`` tensor never crosses device→host, which
+        is the transfer the serve tier's gather path exists to avoid
+        (the eager ``take`` is a tiny per-shape program outside the
+        audited step set, same class as the epoch loop's scalar
+        ops)."""
         if self._head is not None:
             w0 = self.params[self._head_param].astype(self.compute)
             y = self._head.forward(w0, self.feats_host, None, False)
             _, logits = self._tail_eval(self.params, y, self.labels,
                                         self.mask, self.gctx)
+        else:
+            _, logits = self._eval_step(self.params, self.feats,
+                                        self.labels, self.mask,
+                                        self.gctx)
+        if node_ids is None:
             return logits
-        _, logits = self._eval_step(self.params, self.feats,
-                                    self.labels, self.mask, self.gctx)
-        return logits
+        ids = np.asarray(node_ids, dtype=np.int32).ravel()
+        V = int(logits.shape[0])
+        if ids.size and (ids.min() < 0 or ids.max() >= V):
+            # jnp.take's out-of-bounds mode is 'fill' (silent NaN
+            # rows) — raise like DistributedTrainer/Predictor do, one
+            # contract across the serve gather paths
+            raise ValueError(f"node ids out of range [0, {V})")
+        return jnp.take(logits, jnp.asarray(ids), axis=0)
 
     def evaluate(self) -> Dict[str, float]:
         # fetch ONLY the metrics leaf: the shared eval/predict program

@@ -92,6 +92,21 @@ def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
+def params_signature(params: Any) -> str:
+    """The param-tree identity hash (paths + shapes + dtypes) — the
+    ``params_sig`` member of the strict fingerprint half.  ONE
+    derivation shared by :func:`trainer_fingerprint` and the serve
+    export (``roc_tpu/serve/export.py`` embeds it in the serving
+    manifest), so a checkpoint and the artifact exported from it can
+    never disagree about what the weights are."""
+    import hashlib
+    sigs = [f"{jax.tree_util.keystr(p)}:"
+            f"{tuple(int(d) for d in leaf.shape)}:{leaf.dtype}"
+            for p, leaf in
+            jax.tree_util.tree_leaves_with_path(params)]
+    return hashlib.sha1("|".join(sigs).encode()).hexdigest()[:16]
+
+
 def trainer_fingerprint(trainer) -> Dict[str, Any]:
     """The saving/restoring trainer's identity, in two halves:
 
@@ -106,14 +121,8 @@ def trainer_fingerprint(trainer) -> Dict[str, Any]:
       mismatch restores anyway (replicated params are partition-
       independent) and leaves a dated resilience event.
     """
-    import hashlib
-    sigs = [f"{jax.tree_util.keystr(p)}:"
-            f"{tuple(int(d) for d in leaf.shape)}:{leaf.dtype}"
-            for p, leaf in
-            jax.tree_util.tree_leaves_with_path(trainer.params)]
     strict: Dict[str, Any] = {
-        "params_sig":
-            hashlib.sha1("|".join(sigs).encode()).hexdigest()[:16]}
+        "params_sig": params_signature(trainer.params)}
     cfg = getattr(trainer, "config", None)
     if cfg is not None:
         strict["dtype"] = str(jnp.dtype(cfg.dtype))
@@ -273,6 +282,53 @@ def load_checkpoint(path: str, params_template: Any,
     epoch = int(data["__epoch__"])
     key = jnp.asarray(data["__key__"]) if "__key__" in data else None
     return params, opt_state, epoch, key
+
+
+def restore_params_only(path: str
+                        ) -> Tuple[Dict[str, Any], Dict[str, Any], int]:
+    """``(params, fingerprint, epoch)`` from a checkpoint WITHOUT
+    constructing a trainer: params come back as the flat name → array
+    dict every model's ``init_params`` produces, integrity-validated
+    against the v2 CRC table (optimizer state is read past, never
+    materialized on device).  The serve export CLI and a cold server
+    process read weights through this — paying trainer/dataset setup
+    just to load an .npz would put minutes of graph-table builds on a
+    path that needs none of them.  ``fingerprint`` is the saved v2
+    fingerprint dict (empty for v1 checkpoints) — callers hold its
+    strict half against the model they are about to serve."""
+    import re
+    data = _read_checkpoint(path)
+    header = _parse_header(data, path)
+    if header is None:
+        emit("resilience",
+             f"{os.path.basename(path)}: v1 checkpoint (no integrity "
+             f"header) — loading WITHOUT CRC/fingerprint validation",
+             kind="v1_checkpoint", path=path)
+    else:
+        _validate_integrity(data, header, path)
+    params: Dict[str, Any] = {}
+    # one single-quoted bracket segment ONLY: a nested tree flattens
+    # to params['a']['b'], which a greedy (.+) would silently mangle
+    # into one corrupt name — such keys must hit the loud error below
+    key_re = re.compile(r"^params\['([^']+)'\]$")
+    bad = []
+    for k, v in data.items():
+        if not k.startswith("params"):
+            continue
+        m = key_re.match(k)
+        if m:
+            params[m.group(1)] = jnp.asarray(v)
+        else:
+            bad.append(k)
+    if bad or not params:
+        raise CheckpointCorrupt(
+            f"{path}: expected flat params['<name>'] arrays — not a "
+            f"trainer checkpoint, or a non-flat param tree this "
+            f"loader does not speak"
+            + (f" (unparsed keys: {bad[:3]})" if bad else ""))
+    epoch = int(data["__epoch__"]) if "__epoch__" in data else 0
+    fingerprint = (header or {}).get("fingerprint") or {}
+    return params, fingerprint, epoch
 
 
 def restore_trainer(trainer, path: str) -> None:

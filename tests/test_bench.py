@@ -204,7 +204,11 @@ def test_probe_timeout_leaves_partial_and_aborts_same_phase(tmp_path):
     identical phase five times."""
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                ROC_TPU_BENCH_ARTIFACTS=str(tmp_path),
-               ROC_TPU_BENCH_PROBE_TIMEOUT="1",      # dies in import
+               # dies in interpreter startup, BEFORE any progress
+               # marker: 0.05 s is under bare `python -c pass` wall on
+               # any host, where the old 1 s let a warm-page-cache jax
+               # import finish and the probe SUCCEED (observed flake)
+               ROC_TPU_BENCH_PROBE_TIMEOUT="0.05",
                ROC_TPU_BENCH_PROBE_INTERVAL="0")     # no retry sleep
     r = subprocess.run(
         [sys.executable, _BENCH, "--cpu", "--stages", "probe",

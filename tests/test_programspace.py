@@ -619,7 +619,7 @@ def test_cli_strict_fails_on_budget_slack(tmp_path):
                         timeout=180, env=env)
     assert r3.returncode == 0, r3.stdout + r3.stderr
     assert json.loads(bp.read_text())["program_budget"] == \
-        {"gin_flat8": 2, "sgc_stream": 6}
+        {"gin_flat8": 2, "sgc_stream": 6, "sgc_serve": 4}
 
 
 def test_cli_json_reports_program_space():
@@ -638,7 +638,7 @@ def test_cli_json_reports_program_space():
     payload = json.loads(r.stdout)
     assert payload["summary"]["new"] == 0
     reports = {p["config"]: p for p in payload["program_space"]}
-    assert set(reports) == {"gin_flat8", "sgc_stream"}
+    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve"}
     for rep in reports.values():
         assert rep["programs"] == len(rep["keys"])
         assert rep["budget"] is not None
