@@ -1093,7 +1093,14 @@ def _run_stage(name: str, timeout: float, argv,
             proc.communicate()
         rec.update(ok=False, error=f"timeout after {timeout:.0f}s")
     reader.join(timeout=5.0)
-    if dd_counts.get("suppressed"):
+    # a reader wedged past the join deadline (stuck pipe read) is
+    # still mutating dd_counts — don't race it for the summary, and
+    # record the leak instead of silently dropping it (roc-lint
+    # level six's thread-no-shutdown-path contract: the join above IS
+    # the reader's bounded stop path, so a miss is reportable)
+    if reader.is_alive():
+        rec["stderr_reader_leaked"] = True
+    elif dd_counts.get("suppressed"):
         rec["stderr_suppressed"] = dd_counts["suppressed"]
     rec["elapsed_s"] = round(time.time() - t0, 1)
     if hb.fired:

@@ -3,11 +3,15 @@ plus a rule-driven source lint — regressions against the invariants the
 ROC performance story rests on are caught BEFORE merge, not after a
 chip run.
 
-Three layers, mirroring XLA's own cost_analysis / HLO-verifier split:
+Six levels, mirroring XLA's own cost_analysis / HLO-verifier split:
 
 - :mod:`ast_lint` — source-level rules over the tree (stdout
   discipline, host syncs in hot paths, jits bypassing the compile
   observer, pallas interpret plumbing);
+- :mod:`concurrency_lint` — the host-side threading/signal surface
+  (lock-order cycles, signal-handler safety, condvar predicates,
+  unguarded shared state, blocking under locks, thread shutdown
+  paths), jax-free like the AST level;
 - :mod:`jaxpr_lint` — rules over the ClosedJaxprs of both trainers'
   step functions and the recorded-op model graph (bf16 upcasts,
   host callbacks under jit, large non-donated buffers, cross-shard

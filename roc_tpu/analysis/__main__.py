@@ -8,6 +8,7 @@ line per finding, then a summary.
 Usage:
     python -m roc_tpu.analysis [--strict]          # full run
     python -m roc_tpu.analysis --select stdout-print   # one rule
+    python -m roc_tpu.analysis --select concurrency    # level six
     python -m roc_tpu.analysis --update-baseline   # shrink ratchet
     python -m roc_tpu.analysis --json              # machine-readable
 
@@ -54,7 +55,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--select", default=None,
                    help="comma-separated rule names (default: all); "
                         "an AST-only selection skips the jax trace "
-                        "stage entirely")
+                        "stage entirely.  'concurrency' expands to "
+                        "every level-six concurrency/signal-safety "
+                        "rule (jax-free — the scripts/test.sh and "
+                        "round6_chain.sh preflight selection)")
     p.add_argument("--no-trace", action="store_true",
                    help="skip the jaxpr/HLO trace stage (AST only)")
     p.add_argument("--baseline", default=None,
@@ -75,6 +79,13 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
+    if select:
+        # group alias: 'concurrency' names the whole level-six rule
+        # set, expanded BEFORE the trace gating below so a
+        # concurrency-only preflight never touches (or forces) jax
+        from .concurrency_lint import CONCURRENCY_RULES
+        select = [r for s in select for r in
+                  (CONCURRENCY_RULES if s == "concurrency" else (s,))]
     trace = not args.no_trace
     from .driver import is_trace_rule
     if trace and (select is None
@@ -192,6 +203,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "stale": sorted(stale),
             "budget_stale": orphans,
             "program_space": reports,
+            "concurrency_surface": extras.get("concurrency"),
             "summary": {"new": len(new), "baselined": len(old),
                         "stale": len(stale),
                         "budget_slack": len(slack),

@@ -22,6 +22,7 @@ from ..obs.events import emit
 from .ast_lint import RULES as AST_RULES, run_ast_lint
 from .collective_lint import (COLLECTIVE_RULES, CollectiveUnit,
                               check_ring_halo, run_collective_lint)
+from .concurrency_lint import CONCURRENCY_RULES, audit_concurrency
 from .findings import Finding, dedupe
 from .hlo_lint import check_bytes_model, check_large_copy
 from .jaxpr_lint import JAXPR_RULES, JaxprUnit, run_jaxpr_lint
@@ -94,7 +95,8 @@ def check_partition_imbalance(unit: str, real_edges,
 
 
 def all_rule_names() -> List[str]:
-    return ([r.name for r in AST_RULES] + list(JAXPR_RULES)
+    return ([r.name for r in AST_RULES] + list(CONCURRENCY_RULES)
+            + list(JAXPR_RULES)
             + list(HLO_RULES) + list(EXTRA_TRACE_RULES)
             + list(COLLECTIVE_RULES) + list(PROGRAMSPACE_RULES))
 
@@ -282,6 +284,12 @@ def analyze(root: str, select: Optional[List[str]] = None,
     reports under ``'programspace'``."""
     t0 = time.perf_counter()
     findings = run_ast_lint(root, select=select)
+    # level six: the concurrency/signal-safety auditor — pure AST
+    # (no jax, no trace stage), so it runs under every selection that
+    # names one of its rules, including `--select concurrency`
+    if select is None or any(s in CONCURRENCY_RULES for s in select):
+        findings.extend(audit_concurrency(root, select=select,
+                                          extras=extras))
     if trace and _needs_trace(select):
         findings.extend(build_trace_findings(select=select))
     if trace and _needs_programspace(select):

@@ -118,10 +118,13 @@ class StagingPool:
             wait_t0, stage_t0 = self.h2d_wait_t0, self.stage_t0
             self.h2d_wait_ms, self.stage_ms = [], []
             self.h2d_wait_t0, self.stage_t0 = [], []
+            # under the lock: the worker bumps it via _note_live
+            # concurrently (roc-lint unguarded-shared-state)
+            max_live = self.max_live
         out: Dict[str, object] = {
             "n": len(wait), "wait_ms": wait, "stage_ms": stage,
             "wait_t0": wait_t0, "stage_t0": stage_t0,
-            "max_live": self.max_live, "depth": self.depth,
+            "max_live": max_live, "depth": self.depth,
             "wait_p50_ms": None, "stage_p50_ms": None,
             "overlap_frac": None}
         # these float()s reduce host-side python lists of wall-clock

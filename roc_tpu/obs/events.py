@@ -201,6 +201,10 @@ class EventLog:
             self.ring.append(record)
             for sink in self.sinks:
                 try:
+                    # the bus lock IS the sink serializer: concurrent
+                    # emitters writing the same JSONL handle unlocked
+                    # would tear lines; the hold is bounded (one
+                    # flushed line): roc-lint: ok=blocking-under-lock
                     sink.write(record)
                 except Exception as e:  # noqa: BLE001 - never raise
                     if not self._sink_warned:

@@ -19,6 +19,12 @@ loop (``resilience/recovery.py``) can checkpoint-restart instead of
 letting the run die as a blank bench timeout.  Only armed when the
 guarded region runs on the main thread (interrupting the main thread
 on behalf of a worker-thread region would hit the wrong victim).
+
+The watchdog's concurrency contract — a joined shutdown path, flag
+publishes (never read-modify-writes) shared with the preemption
+guard's signal handler, no lock held across the interrupt — is
+enforced by roc-lint level six (``analysis/concurrency_lint.py``),
+not just by this prose.
 """
 
 from __future__ import annotations

@@ -98,7 +98,8 @@ def _stream_key(rec: Dict[str, Any]):
 
 def summarize(events: List[Dict[str, Any]],
               metrics: Optional[List[Dict[str, Any]]] = None,
-              out=None) -> int:
+              out=None,
+              concurrency: Optional[Dict[str, Any]] = None) -> int:
     out = out if out is not None else sys.stdout
 
     # merged multi-process artifacts: one JSONL per process, each
@@ -291,6 +292,38 @@ def summarize(events: List[Dict[str, Any]],
     _rows("resilience (faults injected / recoveries)",
           ["kind", "n", "last"], rows, out)
 
+    # concurrency surface: the level-six auditor's discovered thread
+    # model — every thread, sync object, and signal handler per
+    # module, so the table documents what runs concurrently with the
+    # step loop.  Source: the ``--concurrency`` payload (the
+    # ``python -m roc_tpu.analysis --select concurrency --json``
+    # report test.sh / round6_chain step 0 write), or the
+    # ``concurrency_surface`` analysis event any audited run leaves
+    # in its event stream.
+    conc = concurrency
+    if conc is None:
+        evs = [e for e in events if e.get("cat") == "analysis"
+               and e.get("kind") == "concurrency_surface"]
+        if evs:
+            conc = {"modules": evs[-1].get("modules") or [],
+                    "totals": evs[-1].get("totals") or {}}
+    rows = []
+    for mod in (conc or {}).get("modules", []):
+        threads = ", ".join(
+            (str(t.get("target") or "?")
+             + ("(daemon)" if t.get("daemon") else ""))
+            for t in mod.get("threads", [])) or "-"
+        locks = ", ".join(
+            f"{lk.get('name')}[{lk.get('kind')}]"
+            for lk in mod.get("locks", [])) or "-"
+        handlers = ", ".join(str(h.get("handler") or "?")
+                             for h in mod.get("handlers", [])) or "-"
+        rows.append([str(mod.get("module", "?")), threads, locks,
+                     handlers])
+    _rows("concurrency surface (threads / sync objects / handlers)",
+          ["module", "threads", "sync objects", "signal handlers"],
+          rows, out)
+
     stalls = [e for e in events if e.get("cat") == "stall"]
     by_stage: Dict[str, List[float]] = {}
     for e in stalls:
@@ -334,6 +367,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="training metrics JSONL (--metrics artifact) "
                          "to fold into the span/throughput tables; "
                          "repeatable for multi-process runs")
+    ap.add_argument("--concurrency", default=None,
+                    help="`python -m roc_tpu.analysis --select "
+                         "concurrency --json` payload: renders the "
+                         "concurrency-surface table (threads / locks "
+                         "/ signal handlers per module) from it "
+                         "instead of the event stream")
     args = ap.parse_args(argv)
     events: List[Dict[str, Any]] = []
     for path in _expand(args.events):
@@ -356,7 +395,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"error: cannot read {path}: {e}",
                       file=sys.stderr)
                 return 2
-    return summarize(events, metrics)
+    concurrency = None
+    if args.concurrency:
+        try:
+            with open(args.concurrency) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {args.concurrency}: {e}",
+                  file=sys.stderr)
+            return 2
+        # accept the full --json object or a bare surface dict
+        concurrency = payload.get("concurrency_surface", payload) \
+            if isinstance(payload, dict) else None
+    return summarize(events, metrics, concurrency=concurrency)
 
 
 if __name__ == "__main__":

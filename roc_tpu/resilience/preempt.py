@@ -15,6 +15,10 @@ reentrant and a signal can land inside ``emit``); the dated
 ``resilience`` event is emitted from the normal control flow that
 handles the raise.  A second signal restores the default disposition
 and re-delivers itself — a stuck teardown can always be killed.
+This flag-only contract is no longer just prose: roc-lint level six's
+``signal-unsafe-handler`` rule (``analysis/concurrency_lint.py``)
+fails the gate on any lock/emit/import/buffered-I/O in a registered
+handler's body.
 """
 
 from __future__ import annotations
@@ -23,6 +27,12 @@ import os
 import signal
 import time
 from typing import Dict, Optional
+
+# module-level on purpose: _handle runs in signal context, where an
+# import could deadlock on the interpreter import lock if the signal
+# lands while the main thread is mid-import (roc-lint
+# signal-unsafe-handler found the old lazy import)
+from ..obs.heartbeat import stall_interrupt_pending
 
 # os.EX_TEMPFAIL: "temporary failure, retry later" — the one exit code
 # a supervisor may treat as "re-invoke the same command"
@@ -67,7 +77,6 @@ class PreemptionGuard:
             # simulating SIGINT (obs/heartbeat.py); owning the handler
             # must not swallow it — re-raise so the guarded region's
             # __exit__ converts it into StallFailure
-            from ..obs.heartbeat import stall_interrupt_pending
             if stall_interrupt_pending():
                 raise KeyboardInterrupt
         if self.requested_at is not None:
@@ -82,6 +91,9 @@ class PreemptionGuard:
         # the event-bus lock; the structured resilience event is
         # emitted by whoever handles the Preempted raise
         try:
+            # os.write on a raw fd is the POSIX async-signal-safe
+            # primitive (no buffering, no locks — unlike print/emit):
+            # roc-lint: ok=signal-unsafe-handler
             os.write(2, b"# preemption signal received; finishing the "
                         b"in-flight epoch step\n")
         # stderr gone mid-teardown: nowhere left to tell anyone

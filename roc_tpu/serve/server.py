@@ -100,8 +100,14 @@ class Server:
         return self.submit(node_ids).result()
 
     def stats(self) -> Dict[str, Any]:
-        """Microbatch accounting since startup."""
-        ms = sorted(self._batch_ms)
+        """Microbatch accounting since startup.  Snapshots under the
+        server lock: the dispatcher thread appends to these series
+        concurrently (roc-lint unguarded-shared-state — a sorted()
+        over a list mid-append is exactly the race class)."""
+        with self._lock:
+            ms = sorted(self._batch_ms)
+            batch_n = list(self._batch_n)
+            n_queries = self._n_queries
 
         def pct(p: float) -> Optional[float]:
             if not ms:
@@ -109,9 +115,9 @@ class Server:
             q = ms[min(len(ms) - 1, int(p * len(ms)))]
             return round(q, 4)
 
-        mean_rows = np.mean(self._batch_n) if self._batch_n else None
-        return {"n_queries": self._n_queries,
-                "n_batches": len(self._batch_ms),
+        mean_rows = np.mean(batch_n) if batch_n else None
+        return {"n_queries": n_queries,
+                "n_batches": len(ms),
                 "rows_per_batch": (round(float(mean_rows), 2)
                                    if mean_rows is not None else None),
                 "batch_p50_ms": pct(0.50),
@@ -179,10 +185,17 @@ class Server:
         t0 = time.monotonic()
         rows = self.pred.query(ids)
         ms = (time.monotonic() - t0) * 1e3
-        self._batch_ms.append(ms)
-        self._batch_n.append(int(ids.size))
-        self._spans.append(("serve_batch", t0, ms))
-        if len(self._spans) >= _SPAN_FLUSH_EVERY:
+        # the device dispatch above runs UNLOCKED; only the bounded
+        # bookkeeping appends hold the lock (stats() reads them from
+        # caller threads), and the span flush emits after release —
+        # an emit under the lock would put JSONL I/O on submit()'s
+        # wait path (roc-lint blocking-under-lock)
+        with self._lock:
+            self._batch_ms.append(ms)
+            self._batch_n.append(int(ids.size))
+            self._spans.append(("serve_batch", t0, ms))
+            flush = len(self._spans) >= _SPAN_FLUSH_EVERY
+        if flush:
             self._flush_spans()
         lo = 0
         for req_ids, fut in batch:
@@ -190,7 +203,8 @@ class Server:
             lo += req_ids.size
 
     def _flush_spans(self, final: bool = False) -> None:
-        spans, self._spans = self._spans, []
+        with self._lock:
+            spans, self._spans = self._spans, []
         if not spans:
             return
         emit("timeline",

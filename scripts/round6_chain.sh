@@ -23,6 +23,12 @@ set -x
 python -m roc_tpu.analysis --json \
   --select compile-explosion,cache-key-drift \
   > benchmarks/programspace_report.json || exit 1
+#    concurrency/signal-safety audit (roc-lint level six, jax-free):
+#    a runtime whose dispatcher can deadlock or whose stats race must
+#    not burn chip deadline; the report doubles as the thread-model
+#    artifact (`python -m roc_tpu.report --concurrency <file>`)
+python -m roc_tpu.analysis --json --select concurrency \
+  > benchmarks/concurrency_report.json || exit 1
 #    --jobs stays 1 on the chip host: libtpu owns the accelerator
 #    exclusively, so parallel prewarm children would fail backend
 #    init (sequential children each claim and release it)

@@ -7,6 +7,15 @@
 set -e
 LR=$1; WD=$2; DR=$3; DROP=$4; LAYERS=$5; EPOCHS=$6
 shift 6 || true
+# concurrency/signal-safety preflight (roc-lint level six): pure-AST
+# and jax-free, so it fails in milliseconds on a lock-order cycle, a
+# predicate-less Condition.wait, or an unsafe signal handler before
+# the (slower) trace stage below even starts; the --json report
+# carries the discovered thread/lock/handler surface for
+# `python -m roc_tpu.report --concurrency <file>`
+CONC_REPORT="${TMPDIR:-/tmp}/roc_concurrency_report.json"
+python -m roc_tpu.analysis --select concurrency --json \
+    > "$CONC_REPORT" || { cat "$CONC_REPORT"; exit 1; }
 # pre-flight static analysis (roc-lint): regressions against the
 # perf invariants fail HERE, before any chip time is spent.  The run
 # also prints the program-space compile-budget delta vs

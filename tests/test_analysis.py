@@ -472,8 +472,9 @@ def test_tree_has_zero_unbaselined_findings():
 
 def test_cli_strict_gate():
     """The tier gate: `python -m roc_tpu.analysis --strict` exits 0
-    on the tree inside the <90 s CPU budget with all five levels
-    (AST/jaxpr/HLO/programspace/collective) enabled (lint_prints.sh's
+    on the tree inside the <90 s CPU budget with all six levels
+    (AST/concurrency/jaxpr/HLO/programspace/collective) enabled
+    (lint_prints.sh's
     successor — tests/test_obs.py keeps the wrapper covered), and the
     pre-flight budget lines scripts/test.sh surfaces are printed."""
     env = dict(os.environ)
