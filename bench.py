@@ -438,6 +438,28 @@ def _probe_phase(progress: list) -> str:
     return re.sub(r"[0-9.]+", "N", txt)
 
 
+def _progress_resumed_epoch(progress: list):
+    """The epoch a GCN stage child reported resuming from
+    (``resumed_from_epoch=N`` progress marker), or None."""
+    for line in reversed(progress):
+        m = re.search(r"resumed_from_epoch=(\d+)", line)
+        if m:
+            return int(m.group(1))
+    return None
+
+
+def _clear_gcn_checkpoints(stage: str) -> None:
+    """Drop a previous ROUND's rotation before the first attempt —
+    resume must only ever cross attempts of ONE parent invocation
+    (a days-old checkpoint would silently skew the epoch count)."""
+    import glob as _glob
+    for p in _glob.glob(_gcn_ck_prefix(stage) + ".*.npz"):
+        try:
+            os.unlink(p)
+        except OSError:
+            pass
+
+
 # ------------------------------------------- program-space preflight
 
 def _programspace_preflight(timeout: float = 240.0):
@@ -763,8 +785,25 @@ def child_micro(args) -> dict:
             "V": V, "E": E, "F": F, "iters": iters, "impls": rows}
 
 
+def _gcn_ck_prefix(stage: str) -> str:
+    """Rotation prefix for the checkpoint-aware GCN stages: one per
+    stage name, under the artifacts dir (cleared by the parent at the
+    START of each round so attempts within one round share it and
+    rounds never contaminate each other)."""
+    return os.path.join(_ART_DIR, f"bench_{stage}_ck")
+
+
 def child_gcn(args, nodes: int, edges: int) -> dict:
-    """The headline workload at the given scale."""
+    """The headline workload at the given scale.
+
+    Checkpoint-aware (ROADMAP resilience follow-on): the child
+    installs the PR-8 preemption guard and keeps a checkpoint rotation
+    at ``_gcn_ck_prefix(stage)`` — the parent's SIGTERM on timeout
+    lands an EMERGENCY checkpoint (exit 75), and the retry attempt
+    resumes from it instead of re-training cold (the persistent
+    compile cache already covers the recompile half).  The resumed
+    epoch is recorded as ``resumed_from_epoch`` in the result and, via
+    the progress file, in a failed attempt's partial."""
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -821,6 +860,20 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
                       symmetric=True)
     t0 = time.time()
     trainer = Trainer(model, ds, cfg)
+    # resilience wiring: guard + rotation BEFORE any long phase, so
+    # the parent's timeout SIGTERM is answered with an emergency
+    # checkpoint instead of lost work
+    from roc_tpu.resilience import preempt
+    from roc_tpu.resilience.recovery import CheckpointRotation
+    preempt.install()
+    rotation = CheckpointRotation(_gcn_ck_prefix(args.stage), keep=2)
+    resumed_from = rotation.restore_latest(trainer,
+                                           only_if_ahead=True)
+    if resumed_from is not None:
+        _probe_note(f"resumed_from_epoch={resumed_from}")
+        print(f"# resumed from emergency checkpoint (epoch "
+              f"{resumed_from}) — warm retry, not a cold rerun",
+              file=sys.stderr)
     # pre-warm BEFORE the timed phase: AOT-compile the trainer's whole
     # program set against the persistent cache (run_child enabled it
     # at min_compile_secs=0) and RECORD warm-vs-cold — the compile
@@ -836,21 +889,40 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
         warm = {"error": _errstr(e)}
         print(f"# prewarm failed (continuing cold): {warm['error']}",
               file=sys.stderr)
-    trainer.train(epochs=2)  # compile lap (barriered in the loop) + 1
-    trainer.sync()
-    compile_s = time.time() - t0
-    print(f"# compile+warmup: {compile_s:.1f}s", file=sys.stderr)
-
-    times = []
-    for _ in range(args.epochs):
-        t0 = time.time()
-        trainer.train(epochs=1)
+    from roc_tpu.resilience.preempt import (Preempted,
+                                            RESTARTABLE_EXIT_CODE)
+    try:
+        trainer.train(epochs=2)  # compile lap (barriered) + 1
         trainer.sync()
-        times.append((time.time() - t0) * 1000.0)
-    epoch_ms = float(np.median(times))
-    print(f"# epoch times (ms): {[round(t, 1) for t in times]}",
-          file=sys.stderr)
-    m = trainer.evaluate()
+        compile_s = time.time() - t0
+        print(f"# compile+warmup: {compile_s:.1f}s", file=sys.stderr)
+        # post-compile checkpoint: even a SIGKILL mid-timed-loop
+        # resumes the retry past the compile wall
+        rotation.save(trainer)
+        _probe_note(f"warmup done; checkpoint at epoch "
+                    f"{trainer.epoch}")
+
+        times = []
+        for _ in range(args.epochs):
+            t0 = time.time()
+            trainer.train(epochs=1)
+            trainer.sync()
+            times.append((time.time() - t0) * 1000.0)
+        epoch_ms = float(np.median(times))
+        print(f"# epoch times (ms): {[round(t, 1) for t in times]}",
+              file=sys.stderr)
+        m = trainer.evaluate()
+    except Preempted:
+        # the parent's timeout SIGTERM (or a real preemption): persist
+        # the in-flight progress through the rotation and exit
+        # restartable — the NEXT attempt resumes from here
+        path = rotation.save(trainer)
+        _probe_note(f"preempted; emergency checkpoint at epoch "
+                    f"{trainer.epoch}")
+        print(f"# preempted: emergency checkpoint "
+              f"{os.path.basename(path)} (epoch {trainer.epoch}) — "
+              f"exiting restartable", file=sys.stderr)
+        raise SystemExit(RESTARTABLE_EXIT_CODE)
     # the synthetic graph carries RANDOM labels: these accuracies only
     # prove the step runs end-to-end; they are NOT a quality signal
     # (real-data accuracy gates live in tests/, cf. VERDICT r3 weak #4)
@@ -873,6 +945,7 @@ def child_gcn(args, nodes: int, edges: int) -> dict:
             "prewarm_s": warm.get("prewarm_s"),
             "epoch_ms": round(epoch_ms, 2),
             "epoch_ms_all": [round(t, 1) for t in times],
+            "resumed_from_epoch": resumed_from,
             "labels": "synthetic_random",
             "random_label_train_acc": round(float(m["train_acc"]), 4),
             "random_label_test_acc": round(float(m["test_acc"]), 4)}
@@ -884,7 +957,11 @@ def child_serve(args) -> dict:
     server driven closed-loop and open-loop Poisson; the headline line
     picks up the precomputed backend's p50/p99/QPS
     (``serve_p50_ms``/``serve_p99_ms``/``serve_qps``), gated by the
-    sentinel like epoch time."""
+    sentinel like epoch time.  The kill-a-replica router drill
+    (micro_serve.run_router_drill — 2 CPU replicas, replica 1
+    SIGKILLed mid-load) contributes the availability columns
+    (``serve_shed_rate``/``serve_error_rate``/``serve_availability``)
+    the sentinel's availability checks gate."""
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -900,9 +977,17 @@ def child_serve(args) -> dict:
             rows[backend] = ms.run_backend(
                 backend, ds, Model.from_spec(model.to_spec()), cfg,
                 queries=200, batch=4, rate="auto", art_root=art)
+        try:
+            from roc_tpu.models.builder import Model
+            drill = ms.run_router_drill(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                queries=120, batch=4)
+        except Exception as e:  # noqa: BLE001 - latency rows survive
+            drill = {"error": _errstr(e)}
     out = {"platform": dev.platform, "device_kind": dev.device_kind,
            "V": int(ds.graph.num_nodes), "E": int(ds.graph.num_edges),
-           "queries": 200, "batch": 4, "backends": rows}
+           "queries": 200, "batch": 4, "backends": rows,
+           "router_drill": drill}
     pre, full = rows.get("precomputed"), rows.get("full")
     if pre and full:
         out["speedup_p50"] = round(
@@ -1105,19 +1190,25 @@ def _run_stage(name: str, timeout: float, argv,
     rec["elapsed_s"] = round(time.time() - t0, 1)
     if hb.fired:
         rec["heartbeats"] = hb.fired
-    if name == "probe" and not rec.get("ok"):
-        # where the probe died (claim-wait vs matmul) — wedge vs slow
-        # is diagnosable from the artifact alone, and the
-        # heartbeat-dated partial result below is what the parent's
-        # same-phase retry abort reads (a timed-out probe must never
-        # be a silent null: r04/r05 burned the whole deadline retrying
-        # into the identical wedge)
+    if name in ("probe", "small", "full") and not rec.get("ok"):
+        # where the attempt died (claim-wait vs matmul vs epoch N) —
+        # wedge vs slow is diagnosable from the artifact alone, and
+        # the heartbeat-dated partial result below is what the
+        # parent's same-phase retry abort reads (a timed-out probe
+        # must never be a silent null: r04/r05 burned the whole
+        # deadline retrying into the identical wedge).  GCN stages
+        # also record the checkpoint-resume evidence: a retry that
+        # resumed from the previous attempt's emergency checkpoint
+        # carries resumed_from_epoch (ROADMAP checkpoint-aware probe)
         prog = _read_probe_progress()
         rec["progress"] = prog
         rec["partial"] = {"t": _now_iso(), "last_phase": _probe_phase(prog),
                           "heartbeats": hb.fired,
                           "elapsed_s": rec["elapsed_s"],
                           **(partial_extra or {})}
+        resumed = _progress_resumed_epoch(prog)
+        if resumed is not None:
+            rec["partial"]["resumed_from_epoch"] = resumed
     _append_stage(rec)
     from roc_tpu.obs.events import emit
     emit("bench", f"stage {name}: "
@@ -1327,8 +1418,18 @@ def parent(args, argv) -> int:
             # measurement stages get ONE retry — the single-claim
             # tunnel can transiently fail any fresh child, not just the
             # probe (observed: a full-stage rc=1 with ~690s left), but
-            # a deterministic failure must not starve later stages
+            # a deterministic failure must not starve later stages.
+            # GCN stages are checkpoint-aware: attempt 0 starts from a
+            # cleared rotation; a timed-out attempt's emergency
+            # checkpoint lets attempt 1 RESUME instead of re-training
+            # cold (resumed_from_epoch lands in the result/partial)
+            if name in ("small", "full"):
+                _clear_gcn_checkpoints(name)
             for attempt in range(2):
+                try:  # fresh progress markers per attempt
+                    os.unlink(_probe_progress_path())
+                except OSError:
+                    pass
                 rec = _run_stage(name, eff_timeout, argv)
                 budget = remaining() - 20.0 - _TERM_GRACE
                 if rec.get("ok") or budget < min_budget:
@@ -1397,6 +1498,19 @@ def parent(args, argv) -> int:
                             "serve_qps": closed.get("qps"),
                             "serve_speedup_p50":
                                 sv["result"].get("speedup_p50")}
+        # availability columns from the kill-a-replica router drill —
+        # the sentinel gates these over the BENCH trajectory exactly
+        # like serve_p50_ms (obs/sentinel.py serve_shed_rate /
+        # serve_error_rate lower-better, serve_availability
+        # higher-better)
+        drill = sv["result"].get("router_drill") or {}
+        if drill.get("availability") is not None:
+            serve_fields.update(
+                serve_shed_rate=drill.get("shed_rate"),
+                serve_error_rate=drill.get("error_rate"),
+                serve_availability=drill.get("availability"),
+                serve_failover=drill.get("failover"),
+                serve_wrong=drill.get("wrong"))
     for name, metric in (("full", METRIC_FULL), ("small", METRIC_SMALL)):
         rec = results.get(name)
         if rec and rec.get("ok"):

@@ -168,6 +168,90 @@ def run_backend(backend, ds, model, cfg, queries, batch, rate,
     return row
 
 
+def run_router_drill(ds, model, cfg, art_root, queries=120,
+                     n_replicas=2, kill_batch=4, batch=4,
+                     deadline_ms=10_000.0, seed=0):
+    """Kill-a-replica load generation (ISSUE 13 acceptance): export
+    the precomputed backend, front it with a 2-replica Router, arm
+    ``replica_sigkill:<kill_batch>:1`` so replica 1 SIGKILLs itself
+    mid-load, and drive queries through the kill.  Every accepted
+    request must complete with a correct answer or a typed
+    deadline/shed failure — ``wrong`` (answers off by >1e-5 from the
+    reference) must be ZERO; failover/hedge counts and the
+    availability triple are the row.
+
+    Replicas always run on CPU: this scenario measures AVAILABILITY
+    under fault, not device latency (N replicas racing one single-
+    claim TPU tunnel would drill the tunnel, not the router), and
+    correctness/failover behavior is platform-independent.  The
+    latency rows stay with the single-process backends above."""
+    from roc_tpu.serve.errors import ServeOverload, ServeTimeout
+    from roc_tpu.serve.export import build_predictor, export_predictor
+    from roc_tpu.serve.router import Router
+    out_dir = os.path.join(art_root, "router")
+    pred = build_predictor(model, ds, cfg, backend="precomputed")
+    export_predictor(pred, out_dir,
+                     dataset_meta={"V": ds.graph.num_nodes,
+                                   "E": ds.graph.num_edges})
+    rng = np.random.RandomState(seed)
+    ids_seq = [rng.randint(0, ds.graph.num_nodes,
+                           size=batch).astype(np.int32)
+               for _ in range(queries)]
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["ROC_TPU_FAULT"] = f"replica_sigkill:{kill_batch}:1"
+    ok = wrong = timeout = shed = other = 0
+    lat = []
+    got: dict = {}
+    t_start = time.perf_counter()
+    with Router(out_dir, n_replicas=n_replicas, cpu=True, env=env,
+                default_deadline_ms=deadline_ms) as router:
+        futs = []
+        for i, ids in enumerate(ids_seq):
+            futs.append((i, time.perf_counter(), router.submit(ids)))
+            time.sleep(0.002)   # open-ish: keep both replicas busy
+        for i, t0, fut in futs:
+            try:
+                got[i] = np.asarray(fut.result(timeout=60))
+                lat.append((time.perf_counter() - t0) * 1e3)
+            except ServeTimeout:
+                timeout += 1
+            except ServeOverload:
+                shed += 1
+            except Exception:  # noqa: BLE001 - anything else is a bug
+                other += 1
+        # correctness reference AFTER the load: the SURVIVING replica
+        # re-answers every completed request's ids.  Same platform as
+        # the drill answers (replicas are CPU even when the parent
+        # process sits on a chip — a parent-device reference would
+        # compare fp32 across platforms and fail spuriously), and an
+        # independent dispatch: cross-request row mixups or torn
+        # batches during the failover cannot reproduce in a quiet
+        # one-at-a-time re-query
+        for i, rows in got.items():
+            want = np.asarray(router.query(ids_seq[i],
+                                           deadline_ms=60_000.0))
+            if np.abs(rows - want).max() > 1e-5:
+                wrong += 1
+            else:
+                ok += 1
+        stats = router.stats()
+    wall = time.perf_counter() - t_start
+    denom = max(queries, 1)
+    row = {"queries": queries, "ok": ok, "wrong": wrong,
+           "timeout": timeout, "shed": shed, "other_errors": other,
+           "failover": stats["n_failover"], "hedge": stats["n_hedge"],
+           "replicas_alive": sum(1 for r in stats["replicas"]
+                                 if r["alive"]),
+           "availability": round(ok / denom, 4),
+           "shed_rate": round(shed / denom, 4),
+           "error_rate": round((timeout + other + wrong) / denom, 4),
+           "wall_s": round(wall, 2)}
+    if lat:
+        row.update(_pcts(lat))
+    return row
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=20_000)
@@ -185,6 +269,10 @@ def main(argv=None):
                          "throughput)")
     ap.add_argument("--backends", default="precomputed,full")
     ap.add_argument("--max-wait-ms", type=float, default=0.2)
+    ap.add_argument("--drill", action="store_true",
+                    help="also run the kill-a-replica router drill "
+                         "(2 CPU replicas, replica 1 SIGKILLed "
+                         "mid-load; availability/failover row)")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--out", default=None,
                     help="write the result JSON here (e.g. "
@@ -219,6 +307,17 @@ def main(argv=None):
                   f"{row['closed']['qps']} qps | open p50 "
                   f"{row['open']['p50_ms']} ms p99 "
                   f"{row['open']['p99_ms']} ms", file=sys.stderr)
+        if args.drill:
+            from roc_tpu.models.builder import Model
+            row = run_router_drill(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                batch=args.batch)
+            out["router_drill"] = row
+            print(f"# router drill: {row['ok']}/{row['queries']} ok, "
+                  f"{row['wrong']} wrong, {row['timeout']} timeout, "
+                  f"{row['failover']} failed over "
+                  f"(availability {row['availability']})",
+                  file=sys.stderr)
     pre = out["backends"].get("precomputed")
     full = out["backends"].get("full")
     if pre and full:

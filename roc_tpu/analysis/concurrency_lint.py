@@ -32,6 +32,14 @@ bounded fixpoint) and attribute calls resolve only when unambiguous
 one class in the tree defines ``m``) — the auditor prefers missing an
 exotic alias to drowning the ratchet in false positives.
 
+Held regions come in two shapes (ISSUE 13 satellite): ``with lock:``
+blocks, and explicit ``lock.acquire()`` … ``lock.release()`` pairs —
+statements between the pair at the same nesting level are modeled as
+held, including the canonical ``acquire(); try: … finally:
+release()`` idiom (the try body is the held region).  An ``acquire()``
+whose release never appears in the same statement list holds to the
+end of the list — conservative, and exactly what a leaked lock does.
+
 Every rule suppresses per line with the standard self-documenting
 pragma (``# <why>: roc-lint: ok=<rule>``), findings ride the same
 shrink-only baseline ratchet, and the discovered surface (threads /
@@ -206,6 +214,8 @@ class TreeModel:
                     self.methods_by_name.setdefault(
                         f.node.name, []).append(f)
         self._acq_memo: Dict[Tuple[str, str], Set[str]] = {}
+        self._region_memo: Dict[Tuple[str, str],
+                                List[Tuple[str, "_HeldRegion"]]] = {}
 
     # ------------------------------------------------ name resolution
 
@@ -267,8 +277,11 @@ class TreeModel:
     # --------------------------------------------- lock-acquire model
 
     def direct_acquires(self, fd: FuncDef) -> List[Tuple[str, ast.With]]:
-        """(lock_id, with-node) for every with-block in ``fd`` whose
-        context manager resolves to a lock (``"?"`` kept)."""
+        """(lock_id, held-region) for every lock acquisition in
+        ``fd`` (``"?"`` kept): with-blocks, plus explicit
+        ``acquire()``/``release()`` regions (:meth:`acquire_regions`).
+        Both shapes expose a ``.body`` statement list, so every rule
+        walking held regions covers them identically."""
         mod = self.modules[fd.module]
         out = []
         for node in _walk_own(fd.node):
@@ -278,7 +291,57 @@ class TreeModel:
                                             fd.cls)
                     if lid is not None:
                         out.append((lid, node))
+        out.extend(self.acquire_regions(fd))
         return out
+
+    def acquire_regions(self, fd: FuncDef
+                        ) -> List[Tuple[str, "_HeldRegion"]]:
+        """Explicit ``lock.acquire()`` … ``lock.release()`` held
+        regions in ``fd``, one per acquire site (memoized): the
+        statements between the pair at the same nesting level, or —
+        the ``acquire(); try: … finally: release()`` idiom — the try
+        body (+ handlers/orelse).  A missing release holds to the end
+        of the statement list (that IS the leak)."""
+        memo = self._region_memo.get(fd.key)
+        if memo is not None:
+            return memo
+        mod = self.modules[fd.module]
+        out: List[Tuple[str, _HeldRegion]] = []
+        for lst in _stmt_lists(fd.node):
+            for i, stmt in enumerate(lst):
+                expr = _acquire_expr(stmt)
+                if expr is None:
+                    continue
+                lid = self.resolve_lock(mod, expr, fd.cls)
+                if lid is None:
+                    continue
+                nxt = lst[i + 1] if i + 1 < len(lst) else None
+                if isinstance(nxt, ast.Try) and any(
+                        self._is_release(mod, s, lid, fd.cls)
+                        for s in nxt.finalbody):
+                    body = (list(nxt.body)
+                            + [s for h in nxt.handlers
+                               for s in h.body]
+                            + list(nxt.orelse))
+                else:
+                    body = []
+                    for s in lst[i + 1:]:
+                        if self._is_release(mod, s, lid, fd.cls):
+                            break
+                        body.append(s)
+                out.append((lid, _HeldRegion(body, stmt.lineno)))
+        self._region_memo[fd.key] = out
+        return out
+
+    def _is_release(self, mod: ModuleModel, stmt: ast.AST, lid: str,
+                    cls: Optional[str]) -> bool:
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)
+                and isinstance(stmt.value.func, ast.Attribute)
+                and stmt.value.func.attr == "release"):
+            return False
+        return self.resolve_lock(mod, stmt.value.func.value,
+                                 cls) == lid
 
     def trans_acquires(self, fd: FuncDef, _depth: int = 0,
                        _stack: Optional[Set[Tuple[str, str]]] = None,
@@ -317,6 +380,52 @@ class TreeModel:
         if not _truncated[0]:
             self._acq_memo[fd.key] = out
         return out
+
+
+class _HeldRegion:
+    """A synthetic held-region node for an explicit ``acquire()``
+    pair: quacks like ``ast.With`` where the rules care (``.body`` is
+    the held statement list, ``.lineno`` the acquire site)."""
+
+    __slots__ = ("body", "lineno")
+
+    def __init__(self, body: List[ast.AST], lineno: int):
+        self.body = body
+        self.lineno = lineno
+
+
+def _acquire_expr(stmt: ast.AST) -> Optional[ast.AST]:
+    """The lock expression of a bare ``<lock>.acquire(...)`` statement
+    (an ``if lock.acquire(timeout=...):`` guard is NOT modeled — the
+    held region is conditional and the auditor prefers silence to a
+    false edge)."""
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+        f = stmt.value.func
+        if isinstance(f, ast.Attribute) and f.attr == "acquire":
+            return f.value
+    return None
+
+
+def _stmt_lists(func_node: ast.AST) -> Iterable[List[ast.AST]]:
+    """Every statement list of a function body, WITHOUT descending
+    into nested function definitions (their bodies are their own
+    entry points, like :func:`_walk_own`)."""
+    stack: List[ast.AST] = [func_node]
+    while stack:
+        node = stack.pop()
+        for field in ("body", "orelse", "finalbody"):
+            lst = getattr(node, field, None)
+            if isinstance(lst, list) and lst \
+                    and isinstance(lst[0], ast.stmt):
+                yield lst
+        for h in getattr(node, "handlers", None) or []:
+            if h.body:
+                yield h.body
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
 
 
 def _walk_own(func_node: ast.AST) -> Iterable[ast.AST]:
@@ -545,18 +654,31 @@ def _enclosing_while(m: ModuleModel, node: ast.AST) -> bool:
 
 def _held_lock(tm: TreeModel, m: ModuleModel, node: ast.AST,
                cls: Optional[str]) -> Optional[str]:
-    """Lock id (or ``"?"``) of the innermost enclosing with-block that
-    holds a lock, else None."""
+    """Lock id (or ``"?"``) of the innermost enclosing held region —
+    a with-block, or an explicit ``acquire()``/``release()`` span —
+    else None."""
+    seen = {id(node)}
     cur = node
     while cur in m.parents:
         cur = m.parents[cur]
+        seen.add(id(cur))
         if isinstance(cur, (ast.With, ast.AsyncWith)):
             for item in cur.items:
                 lid = tm.resolve_lock(m, item.context_expr, cls)
                 if lid is not None:
                     return lid
-        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
-                            ast.Module)):
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # explicit-pair regions of the enclosing function: the
+            # node is held if any region statement is on its ancestor
+            # chain (statements are the region's roots)
+            qn = _enclosing_func_qualname(m, node)
+            fd = m.funcs.get(qn) if qn else None
+            if fd is not None:
+                for lid, region in tm.acquire_regions(fd):
+                    if any(id(s) in seen for s in region.body):
+                        return lid
+            return None
+        if isinstance(cur, ast.Module):
             return None
     return None
 
@@ -701,6 +823,15 @@ def build_lock_graph(tm: TreeModel
                             if nid and nid not in ("?", lid):
                                 inner.setdefault(nid, node.lineno)
                     elif isinstance(node, ast.Call):
+                        f = node.func
+                        if isinstance(f, ast.Attribute) \
+                                and f.attr == "acquire":
+                            # explicit nested acquire: an edge exactly
+                            # like a nested with-block
+                            nid = tm.resolve_lock(m, f.value, fd.cls)
+                            if nid and nid not in ("?", lid):
+                                inner.setdefault(nid, node.lineno)
+                            continue
                         callee = tm.resolve_call(m, node, fd.cls)
                         if callee is not None:
                             for nid in tm.trans_acquires(callee):

@@ -51,6 +51,13 @@ MAD_K = 4.0
 REL_FLOOR = 0.25
 # n in {1, 2}: no spread estimate — flag only a gross excursion
 SMALL_SAMPLE_FACTOR = 1.5
+# serve-availability rates (shed/error/availability) are legitimately
+# 0.0 or 1.0 across a healthy history — a pure relative bound would
+# make them either unflaggable (zeros filtered) or hair-trigger
+# (bound == median == 0), so they carry an ABSOLUTE slack floor: a
+# shed/error rate may drift this many percentage points past the
+# history median (availability: below it) before the gate bites
+RATE_ABS_FLOOR = 0.05
 
 
 def _median(vals: List[float]) -> float:
@@ -62,17 +69,24 @@ def _median(vals: List[float]) -> float:
 def detect(history: List[Optional[float]], current: Optional[float],
            higher_is_better: bool = False,
            mad_k: float = MAD_K, rel_floor: float = REL_FLOOR,
-           small_factor: float = SMALL_SAMPLE_FACTOR
-           ) -> Dict[str, Any]:
+           small_factor: float = SMALL_SAMPLE_FACTOR,
+           allow_zero: bool = False,
+           abs_floor: float = 0.0) -> Dict[str, Any]:
     """One metric's verdict dict: ``verdict`` in {no_data, no_history,
-    ok, regression} plus the numbers behind it (median, bound, n)."""
+    ok, regression} plus the numbers behind it (median, bound, n).
+
+    ``allow_zero`` admits 0.0 as legitimate history (rates); purely
+    relative slack collapses at a zero median, so rate metrics pass an
+    ``abs_floor`` — the bound never sits closer than that absolute
+    margin to the median (see :data:`RATE_ABS_FLOOR`)."""
     out: Dict[str, Any] = {"current": current,
                            "higher_is_better": higher_is_better}
     if current is None:
         out.update(verdict="no_data", n=0)
         return out
     hist = [float(v) for v in history
-            if isinstance(v, (int, float)) and v > 0]
+            if isinstance(v, (int, float))
+            and (v > 0 or (allow_zero and v >= 0))]
     out["n"] = len(hist)
     if not hist:
         out["verdict"] = "no_history"
@@ -81,12 +95,12 @@ def detect(history: List[Optional[float]], current: Optional[float],
     out["median"] = round(med, 4)
     if len(hist) < 3:
         # small-sample rule: a median but no honest spread estimate
-        bound = (med / small_factor if higher_is_better
-                 else med * small_factor)
+        bound = (med / small_factor - abs_floor if higher_is_better
+                 else med * small_factor + abs_floor)
         out["rule"] = f"small_sample_{small_factor}x"
     else:
         sigma = 1.4826 * _median([abs(v - med) for v in hist])
-        slack = max(mad_k * sigma, rel_floor * med)
+        slack = max(mad_k * sigma, rel_floor * med, abs_floor)
         bound = med - slack if higher_is_better else med + slack
         out["rule"] = f"median_mad_k{mad_k:g}"
         out["sigma"] = round(sigma, 4)
@@ -106,8 +120,10 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     out: Dict[str, Any] = {"path": os.path.basename(path),
                            "step_ms": None, "compile_s": None,
                            "overlap_frac": None, "serve_p50_ms": None,
-                           "serve_qps": None, "dtype": None,
-                           "stage": None}
+                           "serve_qps": None, "serve_shed_rate": None,
+                           "serve_error_rate": None,
+                           "serve_availability": None,
+                           "dtype": None, "stage": None}
     try:
         with open(path) as f:
             doc = json.load(f)
@@ -124,8 +140,12 @@ def load_bench_round(path: str) -> Dict[str, Any]:
         out["step_ms"] = float(val)
     # serve rows (bench.py serve stage, PR 11): p50 request latency
     # and sustained QPS of the precomputed-propagation backend — the
-    # serving tier's trajectory is gated exactly like epoch time
-    for k in ("serve_p50_ms", "serve_qps"):
+    # serving tier's trajectory is gated exactly like epoch time.
+    # The availability triple (PR 13) rides the same headline line:
+    # shed/error rates and completed-over-submitted availability of
+    # the serve stage's load run.
+    for k in ("serve_p50_ms", "serve_qps", "serve_shed_rate",
+              "serve_error_rate", "serve_availability"):
         if isinstance(parsed.get(k), (int, float)):
             out[k] = float(parsed[k])
     out["dtype"] = parsed.get("dtype")
@@ -212,11 +232,11 @@ def check_run(rounds: List[Dict[str, Any]],
     rounds.  Returns ``{"checks": {...}, "regressed": [...],
     "ok": bool}``."""
     dtype = current.get("dtype")
-    step_hist = [r["step_ms"] for r in rounds
+    step_hist = [r.get("step_ms") for r in rounds
                  if dtype is None or r.get("dtype") in (None, dtype)]
     checks = {
         "step_time_ms": detect(step_hist, current.get("step_ms")),
-        "compile_time_s": detect([r["compile_s"] for r in rounds],
+        "compile_time_s": detect([r.get("compile_s") for r in rounds],
                                  current.get("compile_s")),
         "overlap_frac": detect([r.get("overlap_frac") for r in rounds],
                                current.get("overlap_frac"),
@@ -226,6 +246,21 @@ def check_run(rounds: List[Dict[str, Any]],
         "serve_qps": detect([r.get("serve_qps") for r in rounds],
                             current.get("serve_qps"),
                             higher_is_better=True),
+        # availability triple: rates are legitimately 0.0/1.0, so
+        # they run with allow_zero + the absolute slack floor
+        "serve_shed_rate": detect(
+            [r.get("serve_shed_rate") for r in rounds],
+            current.get("serve_shed_rate"), allow_zero=True,
+            abs_floor=RATE_ABS_FLOOR),
+        "serve_error_rate": detect(
+            [r.get("serve_error_rate") for r in rounds],
+            current.get("serve_error_rate"), allow_zero=True,
+            abs_floor=RATE_ABS_FLOOR),
+        "serve_availability": detect(
+            [r.get("serve_availability") for r in rounds],
+            current.get("serve_availability"),
+            higher_is_better=True, allow_zero=True,
+            abs_floor=RATE_ABS_FLOOR),
     }
     regressed = [name for name, v in checks.items()
                  if v["verdict"] == "regression"]
@@ -307,7 +342,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         for i in range(len(rounds) - 1, -1, -1):
             if any(rounds[i][k] is not None
                    for k in ("step_ms", "compile_s", "overlap_frac",
-                             "serve_p50_ms", "serve_qps")):
+                             "serve_p50_ms", "serve_qps",
+                             "serve_availability")):
                 cur_idx = i
                 break
         if cur_idx is None:
@@ -324,6 +360,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "overlap_frac": cur.get("overlap_frac"),
                    "serve_p50_ms": cur.get("serve_p50_ms"),
                    "serve_qps": cur.get("serve_qps"),
+                   "serve_shed_rate": cur.get("serve_shed_rate"),
+                   "serve_error_rate": cur.get("serve_error_rate"),
+                   "serve_availability": cur.get("serve_availability"),
                    "dtype": args.dtype or cur.get("dtype"),
                    "round": cur["path"]}
         history = rounds[:cur_idx]

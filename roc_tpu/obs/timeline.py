@@ -191,11 +191,17 @@ def _marker(rec: Dict[str, Any]) -> Optional[Tuple[str, Dict[str, Any]]]:
         return ("clock_sync", {"epoch": rec.get("epoch")})
     if cat == "serve":
         # server lifecycle markers on the serving process's lane (the
-        # per-microbatch spans ride the ordinary span batches)
+        # per-microbatch spans ride the ordinary span batches); router
+        # failover/hedge markers carry the replica index so a killed
+        # replica's failover is findable on the timeline (ISSUE 13
+        # acceptance)
         return (f"serve:{rec.get('kind', 'serve')}",
                 {"msg": rec.get("msg"),
                  "n_queries": rec.get("n_queries"),
-                 "rows": rec.get("rows")})
+                 "rows": rec.get("rows"),
+                 "replica": rec.get("replica"),
+                 "requeued": rec.get("requeued"),
+                 "version": rec.get("version")})
     if cat in ("bench", "programspace", "run"):
         return (f"{cat}", {"msg": rec.get("msg")})
     return None

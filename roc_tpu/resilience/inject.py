@@ -26,9 +26,27 @@ Sites (each fires AT MOST ONCE per process — ``FaultSpec.fired``):
 - ``stall_compile``    hang the first-compile barrier (the watchdog
                        deadline must convert it into a StallFailure).
 
+Serve sites (ISSUE 13): the same ``site:epoch[:proc]`` grammar drills
+the serving tier, with ``epoch`` read as the server's MICROBATCH index
+(``Server`` notes it per dispatch) and ``proc`` as the REPLICA index a
+router assigned (``note_proc_index`` — serve replicas are plain
+subprocesses with no jax distributed identity):
+
+- ``replica_sigkill``  SIGKILL this replica mid-dispatch — the router
+                       must fail over its in-flight requests.
+- ``replica_stall``    hang one dispatch indefinitely (straggler) —
+                       hedged re-dispatch / deadlines must cover.
+- ``table_swap_mid_query``  publish a real ``add_edges`` table-version
+                       swap between a microbatch's version capture and
+                       its device dispatch — the batch must finish
+                       bit-exact on the version it captured.
+- ``serve_io``         raise OSError from the dispatch site — the
+                       replica reports a retryable failure and the
+                       router re-dispatches elsewhere.
+
 Import-light by design: the hook points live in hot setup paths
-(checkpoint save, staging, the epoch loop) and an unarmed check is a
-couple of attribute reads.
+(checkpoint save, staging, the epoch loop, the serve dispatcher) and
+an unarmed check is a couple of attribute reads.
 """
 
 from __future__ import annotations
@@ -44,7 +62,9 @@ from ..obs.events import emit
 ENV_VAR = "ROC_TPU_FAULT"
 
 SITES = ("nan_grads", "sigkill", "sigterm", "kill_in_save",
-         "bitflip_checkpoint", "staging_io", "stall_compile")
+         "bitflip_checkpoint", "staging_io", "stall_compile",
+         "replica_sigkill", "replica_stall", "table_swap_mid_query",
+         "serve_io")
 
 
 @dataclass
@@ -67,6 +87,10 @@ _ENV_CHECKED = False
 # the epoch the training loop last entered (run_epoch_loop notes it) —
 # lets sites without epoch context (staging_io) match the armed epoch
 _EPOCH: Optional[int] = None
+# explicit process-identity override for serve replicas: a router's
+# replica subprocess has no jax distributed identity, so the ``:proc``
+# arm (replica index) is pinned by the replica itself at startup
+_PROC_OVERRIDE: Optional[int] = None
 
 
 def parse(spec: str) -> FaultSpec:
@@ -106,10 +130,19 @@ def arm(spec: Optional[str]) -> Optional[FaultSpec]:
 
 def disarm() -> None:
     """Reset (tests)."""
-    global _SPEC, _ENV_CHECKED, _EPOCH
+    global _SPEC, _ENV_CHECKED, _EPOCH, _PROC_OVERRIDE
     _SPEC = None
     _ENV_CHECKED = False
     _EPOCH = None
+    _PROC_OVERRIDE = None
+
+
+def note_proc_index(idx: int) -> None:
+    """Pin this process's identity for the ``:proc`` arm — serve
+    replicas call it with their router-assigned replica index (takes
+    precedence over ``jax.process_index()``)."""
+    global _PROC_OVERRIDE
+    _PROC_OVERRIDE = int(idx)
 
 
 def current() -> Optional[FaultSpec]:
@@ -132,6 +165,8 @@ def note_epoch(epoch: int) -> None:
 def _proc_ok(spec: FaultSpec) -> bool:
     if spec.proc is None:
         return True
+    if _PROC_OVERRIDE is not None:
+        return _PROC_OVERRIDE == spec.proc
     try:
         import jax
         return jax.process_index() == spec.proc
@@ -268,3 +303,44 @@ def maybe_stall() -> None:
         return
     _fire(spec, "stalling the compile barrier")
     time.sleep(3600.0)
+
+
+def serve_batch_hooks(server, batch_no: int) -> None:
+    """Serve-dispatch sites, called by ``Server._dispatch`` AFTER the
+    microbatch captured its table version and BEFORE the device
+    dispatch — exactly the window the versioned-swap and straggler
+    drills target.  ``batch_no`` is the server's microbatch index;
+    sites fire ``at_least`` so a burst that skips past the armed index
+    still drills (fired-once like every site)."""
+    spec = (_ready("replica_sigkill", batch_no, mode="at_least")
+            or _ready("replica_stall", batch_no, mode="at_least")
+            or _ready("table_swap_mid_query", batch_no,
+                      mode="at_least")
+            or _ready("serve_io", batch_no, mode="at_least"))
+    if spec is None:
+        return
+    if spec.site == "replica_sigkill":
+        _fire(spec, f"SIGKILL mid-dispatch (microbatch {batch_no})")
+        os.kill(os.getpid(), signal.SIGKILL)
+    elif spec.site == "replica_stall":
+        _fire(spec, f"stalling dispatch of microbatch {batch_no} — "
+                    f"hedging/deadlines must cover")
+        time.sleep(3600.0)
+    elif spec.site == "table_swap_mid_query":
+        _fire(spec, f"publishing a table-version swap under "
+                    f"microbatch {batch_no}'s captured version")
+        try:
+            # a REAL mutation (self edge on node 0): the in-flight
+            # batch must finish bit-exact on the version it captured
+            server.pred.invalidate([0], [0])
+        except NotImplementedError:
+            # backend without mutable tables (full / table-only):
+            # nothing to swap — the dated fault event above still
+            # records that the drill was exercised here
+            emit("resilience", "table_swap_mid_query: backend has no "
+                 "mutable table — swap skipped", kind="fault_noop",
+                 site=spec.site)
+    elif spec.site == "serve_io":
+        _fire(spec, f"OSError raised from the serve dispatch site "
+                    f"(microbatch {batch_no})")
+        raise OSError(f"injected serve I/O fault ({spec.spec_str()})")

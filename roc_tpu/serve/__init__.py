@@ -3,11 +3,19 @@
 - :mod:`.export` — ``python -m roc_tpu.export``: checkpoint/config →
   serving artifact (AOT-warmed predict executables + manifest).
 - :mod:`.predictor` — the bucketed query engine (full-graph and
-  precomputed-propagation backends).
+  precomputed-propagation backends), with atomically-published
+  versioned tables.
 - :mod:`.propagation` — ``S^k X`` tables + incremental edge-append
   invalidation.
-- :mod:`.server` — the coalescing microbatch request queue.
+- :mod:`.server` — the coalescing microbatch request queue (deadlines,
+  backpressure, graceful drain).
+- :mod:`.router` / :mod:`.replica` — N replica subprocesses behind one
+  ``submit`` (least-loaded dispatch, failover, hedging).
+- :mod:`.errors` — the typed failure vocabulary every layer shares.
 """
 
-from .predictor import SERVE_BUCKETS, Predictor, bucket_for  # noqa: F401
-from .server import Server  # noqa: F401
+from .errors import (ReplicaLost, ServeClosed, ServeError,  # noqa: F401
+                     ServeOverload, ServeTimeout)
+from .predictor import (SERVE_BUCKETS, Predictor, TableVersion,  # noqa: F401
+                        bucket_for)
+from .server import Server, ServeResult  # noqa: F401

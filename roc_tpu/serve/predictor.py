@@ -34,12 +34,31 @@ event stream alone.
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, \
+    Tuple
 
 import numpy as np
 
 from ..obs.events import emit
 from .propagation import PropagationCache
+
+
+class TableVersion(NamedTuple):
+    """One atomically-published serving table: ``version`` is a
+    monotonically increasing counter, ``table`` the device array every
+    dispatch under this version gathers from (the propagation table
+    for the precomputed backend, the feature matrix for full-graph).
+
+    Publishing a new version NEVER mutates the previous one: the new
+    buffer is the old one with exactly the affected rows rewritten
+    (``.at[rows].set`` — copy-on-write at the device boundary), so a
+    microbatch that captured version ``k`` at batch-take finishes
+    bit-exact on ``k``'s values while later batches see ``k+1``
+    (tests/test_serve_robustness.py pins this with a concurrent
+    stress over a live ``add_edges`` publish)."""
+    version: int
+    table: Any
 
 # Quantized microbatch sizes — the ONLY ids shapes a server ever
 # dispatches.  Quantization is what keeps the serve program set finite
@@ -120,6 +139,13 @@ class Predictor:
             raise ValueError(f"unknown serve backend {backend!r}; "
                              f"expected 'precomputed' or 'full'")
         self.num_classes = num_classes
+        # the versioned-table publish point: a single attribute swap
+        # under the lock (readers take a consistent (version, table)
+        # snapshot by reading the one attribute — tuple assignment is
+        # atomic, the lock serializes WRITERS against each other)
+        self._pub_lock = threading.Lock()
+        self._published = TableVersion(
+            0, self.table if backend == "precomputed" else self.feats)
         self._build_jits()
 
     # ------------------------------------------------------- programs
@@ -168,13 +194,17 @@ class Predictor:
                                   feats, gctx, key=None, train=False)
         return jnp.take(logits, ids, axis=0)
 
-    def _args_for(self, ids):
+    def _args_for(self, ids, pub: Optional[TableVersion] = None):
         """The per-dispatch argument tuple — ONE construction shared
         by the live call path and the candidate enumeration, so the
-        auditor/prewarm keys and the runtime programs cannot drift."""
-        if self.backend == "precomputed":
-            return (self.params, self.table, ids, self._gctx)
-        return (self.params, self.feats, ids, self._gctx)
+        auditor/prewarm keys and the runtime programs cannot drift.
+        ``pub`` pins a captured table version (the microbatch server
+        captures one per batch); None reads the current publication.
+        Versions only swap the table VALUES, never its shape/dtype,
+        so the program key is version-independent."""
+        if pub is None:
+            pub = self._published
+        return (self.params, pub.table, ids, self._gctx)
 
     def serve_candidates(self) -> List[Any]:
         """The exact serve program set, as prewarmable auditor
@@ -218,16 +248,25 @@ class Predictor:
 
     # --------------------------------------------------------- queries
 
-    def query_device(self, ids_padded):
+    def published(self) -> TableVersion:
+        """A consistent snapshot of the current table version (one
+        atomic attribute read).  Dispatch paths capture this ONCE per
+        microbatch so every request in the batch is served from one
+        version even while :meth:`invalidate` publishes a new one."""
+        return self._published
+
+    def query_device(self, ids_padded,
+                     pub: Optional[TableVersion] = None):
         """One padded-bucket dispatch; returns the device logits
         ``[bucket, C]``.  ``ids_padded`` length must be a bucket."""
         b = int(ids_padded.shape[0])
         if b not in self._jits:
             raise ValueError(f"ids length {b} is not a bucket "
                              f"{self.buckets}")
-        return self._jits[b](*self._args_for(ids_padded))
+        return self._jits[b](*self._args_for(ids_padded, pub))
 
-    def query(self, node_ids) -> np.ndarray:
+    def query(self, node_ids,
+              pub: Optional[TableVersion] = None) -> np.ndarray:
         """Synchronous convenience path: pad to the smallest fitting
         bucket, dispatch, fetch, slice.  The microbatch server
         (``serve/server.py``) is the production entry — it coalesces
@@ -239,6 +278,8 @@ class Predictor:
         if ids.size and (ids.min() < 0 or ids.max() >= self.num_nodes):
             raise ValueError(
                 f"node ids out of range [0, {self.num_nodes})")
+        if pub is None:
+            pub = self.published()  # one version for every chunk
         out: List[np.ndarray] = []
         cap = max(self.buckets)
         for lo in range(0, ids.size, cap):
@@ -246,7 +287,7 @@ class Predictor:
             b = bucket_for(chunk.size, self.buckets)
             padded = np.full(b, self.pad_id, dtype=np.int32)
             padded[:chunk.size] = chunk
-            logits = self.query_device(jnp.asarray(padded))
+            logits = self.query_device(jnp.asarray(padded), pub)
             # the result fetch IS this tier's product — the one
             # sanctioned host sync on the serve path
             got = jax.device_get(logits)  # roc-lint: ok=host-sync-hot-path
@@ -259,26 +300,57 @@ class Predictor:
     def invalidate(self, src, dst) -> int:
         """Edge-append invalidation hook: incrementally recompute the
         k-hop neighborhood rows of the propagation table
-        (``PropagationCache.add_edges``) and refresh exactly those
-        rows in the device copy.  Returns the number of rows
-        refreshed.  Control-plane op — the scatter below compiles a
-        tiny program per affected-set shape, deliberately OUTSIDE the
-        audited serve set (mutations are rare; quantizing them would
-        complicate the hot path for nothing)."""
+        (``PropagationCache.add_edges``) and publish a NEW table
+        version carrying exactly those rows (``refresh_rows``).
+        Returns the number of rows refreshed.  Control-plane op — the
+        scatter below compiles a tiny program per affected-set shape,
+        deliberately OUTSIDE the audited serve set (mutations are
+        rare; quantizing them would complicate the hot path for
+        nothing).  Mutators serialize on the publish lock; query
+        threads never block on it (they read the published snapshot)."""
         if self.backend != "precomputed" or self.cache is None:
             raise NotImplementedError(
                 "invalidation needs the precomputed backend (full-"
                 "graph serving recomputes every dispatch anyway)")
-        rows = self.cache.add_edges(src, dst)
-        self.refresh_rows(rows)
+        with self._pub_lock:
+            rows = self.cache.add_edges(src, dst)
+            version = self._publish_rows_locked(rows)
+        self._emit_publish(version, rows)
         return int(rows.size)
 
     def refresh_rows(self, rows: np.ndarray) -> None:
+        """Publish a new table version with ``rows`` re-uploaded from
+        the host cache.  The previous version's device buffer is left
+        untouched — in-flight dispatches pinned to it finish
+        bit-exact (``.at[rows].set`` materializes a fresh buffer:
+        copy-on-write at the device boundary)."""
+        with self._pub_lock:
+            version = self._publish_rows_locked(rows)
+        self._emit_publish(version, rows)
+
+    def _publish_rows_locked(self, rows: np.ndarray) -> Optional[int]:
         import jax.numpy as jnp
         if rows.size == 0:
-            return
+            return None
         vals = jnp.asarray(
             self.cache.table[rows].astype(np.float32),
             dtype=self.compute)
-        self.table = self.table.at[jnp.asarray(
+        old = self._published
+        new_table = old.table.at[jnp.asarray(
             rows.astype(np.int32))].set(vals)
+        self.table = new_table
+        self._published = TableVersion(old.version + 1, new_table)
+        return old.version + 1
+
+    def _emit_publish(self, version: Optional[int],
+                      rows: np.ndarray) -> None:
+        # after the publish lock is released: event I/O must never sit
+        # on the mutation critical section (roc-lint
+        # blocking-under-lock)
+        if version is None:
+            return
+        emit("serve", f"table version {version} published "
+             f"({rows.size} row(s) rewritten; in-flight queries "
+             f"finish on v{version - 1})", console=False,
+             kind="table_publish", version=version,
+             rows=int(rows.size))

@@ -80,6 +80,12 @@ class PropagationCache:
         self.x0 = x0
         self.stages = stages
         self.inv_sqrt = _inv_sqrt_degree(np.diff(self.row_ptr))
+        # mutation counter: bumps once per add_edges batch.  The
+        # DEVICE-side version boundary (what in-flight queries pin to)
+        # lives in Predictor.refresh_rows' atomic publish; this
+        # counter lets artifacts/stats say which host-table mutation
+        # generation a publish came from.
+        self.version = 0
 
     # ------------------------------------------------------------ build
 
@@ -205,11 +211,13 @@ class PropagationCache:
                     cur[affected] /= deg[affected, None]
             else:  # pragma: no cover - build() rejects unknown kinds
                 raise NotImplementedError(kind)
+        self.version += 1
         emit("serve", f"invalidate: {src.size} edge(s) appended, "
              f"{affected.size} table row(s) recomputed "
-             f"({affected.size / max(V, 1):.2%} of V)", console=False,
+             f"({affected.size / max(V, 1):.2%} of V, host table "
+             f"generation {self.version})", console=False,
              kind="invalidate", edges=int(src.size),
-             rows=int(affected.size))
+             rows=int(affected.size), version=self.version)
         return affected
 
     # ------------------------------------------------------ persistence

@@ -1,0 +1,230 @@
+"""Serve replica worker: ONE server process behind the Router.
+
+``python -m roc_tpu.serve.replica <artifact_dir> --replica N`` is what
+the :class:`~roc_tpu.serve.router.Router` spawns, N times, over the
+SAME exported artifact: each replica cold-loads the predictor
+(``load_predictor`` — zero new compiles against a warm persistent
+cache), runs a :class:`~roc_tpu.serve.server.Server`, and speaks a
+line-JSON protocol over stdin/stdout:
+
+stdin  (router → replica)
+    ``{"id": i, "ids": [...], "deadline_ms": f|null}``  one request
+    ``{"kind": "close"}``  drain-and-exit (stdin EOF means the same)
+
+stdout (replica → router)
+    ``{"kind": "ready", "replica": n, "num_nodes": V, ...}``  once
+    ``{"kind": "hb", "inflight": q, "served": n}``  liveness beats
+    ``{"kind": "res", "id": i, "ok": true, "rows": [[...]],
+    "version": v}``  or ``{"kind": "res", "id": i, "ok": false,
+    "error": "<TypeName>", "msg": ..., "retryable": bool}``
+    ``{"kind": "drained", "clean": bool}``  final line before exit 0
+
+Lifecycle is the PR-8 preemption contract applied to serving: a
+:class:`~roc_tpu.resilience.preempt.PreemptionGuard` turns SIGTERM
+into a **graceful drain** — stop admitting (late requests fail typed
+``ServeClosed``), finish every in-flight microbatch, write the
+``drained`` line, exit 0.  The scheduler's grace window ends a serving
+process the same way it ends a training epoch.
+
+Fault drills arm per replica through the standard
+``ROC_TPU_FAULT=site:epoch:proc`` grammar: ``proc`` is THIS replica's
+index (pinned via ``inject.note_proc_index``), ``epoch`` the
+microbatch index (``inject.serve_batch_hooks`` in the Server
+dispatcher).  ``serve_io`` comes back to the router as a *retryable*
+error; ``replica_sigkill``/``replica_stall`` are what the failover and
+hedging paths exist for.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from . import errors as serve_errors
+
+# failure types the ROUTER may transparently re-dispatch to another
+# replica: transient I/O (the serve_io drill class) and anything that
+# names this replica's internal state rather than the request.
+# Deadline/shed/closed failures are the CONTRACT — they propagate
+# typed to the client, never retried into a second replica's queue.
+RETRYABLE = (OSError,)
+
+HB_ENV = "ROC_TPU_SERVE_HB_S"
+DEFAULT_HB_S = 1.0
+
+
+def hb_interval() -> float:
+    try:
+        # env-string parse, not a device fetch: roc-lint: ok=host-sync-hot-path
+        return max(0.05, float(os.environ.get(HB_ENV, DEFAULT_HB_S)))
+    except ValueError:
+        return DEFAULT_HB_S
+
+
+class _Wire:
+    """stdout writer: one lock, one flushed line per message — the
+    same serializer-lock shape as the event bus's JSONL sink."""
+
+    def __init__(self, stream):
+        self._stream = stream
+        self._lock = threading.Lock()
+
+    def send(self, obj: Dict[str, Any]) -> None:
+        line = json.dumps(obj)
+        with self._lock:
+            # the lock IS the line serializer (dispatcher callbacks,
+            # the hb thread, and the main thread all write); the hold
+            # is one buffered line + flush: roc-lint: ok=blocking-under-lock
+            self._stream.write(line + "\n")
+            # same bounded hold: roc-lint: ok=blocking-under-lock
+            self._stream.flush()
+
+
+def _error_payload(req_id: int, e: BaseException) -> Dict[str, Any]:
+    # the Server wraps dispatch failures in ServeError with the raw
+    # exception chained — retryability reads through the chain, so an
+    # injected serve_io OSError still comes back retryable
+    retryable = isinstance(e, RETRYABLE) \
+        or isinstance(getattr(e, "__cause__", None), RETRYABLE)
+    return {"kind": "res", "id": req_id, "ok": False,
+            "error": type(e).__name__, "msg": str(e)[:300],
+            "retryable": retryable}
+
+
+def serve_loop(server, wire: _Wire, replica: int,
+               drain_timeout_s: float = 30.0) -> bool:
+    """Read requests until stdin EOF, a ``close`` message, or a
+    preemption signal; then drain.  Returns the drain verdict."""
+    from ..resilience import preempt
+
+    inflight = [0]
+    served = [0]
+    stop = threading.Event()
+
+    def on_done(req_id):
+        def cb(fut):
+            inflight[0] -= 1   # dispatcher-thread only; hb reads racily
+            try:
+                rows = fut.result()
+                served[0] += 1
+                wire.send({"kind": "res", "id": req_id, "ok": True,
+                           "rows": rows.tolist(),
+                           "version": int(getattr(rows, "version",
+                                                  0))})
+            except BaseException as e:  # noqa: BLE001 - wire it back
+                wire.send(_error_payload(req_id, e))
+        return cb
+
+    def hb_loop():
+        iv = hb_interval()
+        while not stop.wait(iv):
+            wire.send({"kind": "hb", "inflight": inflight[0],
+                       "served": served[0],
+                       "mono": round(time.monotonic(), 3)})
+
+    def read_loop():
+        for line in sys.stdin:
+            if stop.is_set():
+                break
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except ValueError:
+                continue
+            if msg.get("kind") == "close":
+                break
+            req_id = msg.get("id")
+            if req_id is None:
+                continue
+            inflight[0] += 1
+            fut = server.submit(msg.get("ids") or [],
+                                deadline_ms=msg.get("deadline_ms"))
+            fut.add_done_callback(on_done(req_id))
+        stop.set()
+
+    hb = threading.Thread(target=hb_loop, name="replica:hb",
+                          daemon=True)
+    reader = threading.Thread(target=read_loop, name="replica:stdin",
+                              daemon=True)
+    hb.start()
+    reader.start()
+    # the main thread owns the lifecycle: SIGTERM (preemption guard
+    # flag) or reader exit (EOF / close message) both funnel into ONE
+    # drain path — readline retries EINTR (PEP 475), so the signal
+    # can only be acted on from a poll loop like this
+    while not stop.wait(0.05):
+        if preempt.requested():
+            stop.set()
+    clean = server.drain(timeout=drain_timeout_s)
+    hb.join(timeout=2.0)
+    wire.send({"kind": "drained", "clean": bool(clean),
+               "replica": replica, "served": served[0]})
+    return clean
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m roc_tpu.serve.replica", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("artifact", help="exported serving artifact dir")
+    ap.add_argument("--replica", type=int, default=0,
+                    help="router-assigned replica index (the :proc "
+                         "arm of serve fault drills)")
+    ap.add_argument("--shard", default=None,
+                    help="lo:hi node range this replica ADVERTISES "
+                         "(routing metadata for the future 2-D mesh; "
+                         "the artifact still carries the full table)")
+    ap.add_argument("--max-wait-ms", type=float, default=0.2)
+    ap.add_argument("--max-queue", type=int, default=None)
+    ap.add_argument("--drain-timeout", type=float, default=30.0)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ..obs.events import set_clock_identity
+    from ..resilience import inject, preempt
+    # identity FIRST: the fault arm and every event this process emits
+    # (its timeline lane included) carry the replica index
+    inject.note_proc_index(args.replica)
+    set_clock_identity(proc=args.replica)
+    preempt.install()
+
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    from ..obs.heartbeat import Heartbeat
+    from ..utils.compile_cache import enable_compile_cache
+    from .export import load_predictor
+    from .server import DEFAULT_MAX_QUEUE, Server
+    enable_compile_cache()
+    with Heartbeat(f"replica{args.replica} loading artifact"):
+        pred = load_predictor(args.artifact)
+    shard = None
+    if args.shard:
+        lo, hi = args.shard.split(":")
+        shard = [int(lo), int(hi)]
+    wire = _Wire(sys.stdout)
+    server = Server(
+        pred, max_wait_ms=args.max_wait_ms,
+        name=f"replica{args.replica}",
+        max_queue=(DEFAULT_MAX_QUEUE if args.max_queue is None
+                   else args.max_queue))
+    wire.send({"kind": "ready", "replica": args.replica,
+               "pid": os.getpid(),
+               "num_nodes": int(pred.num_nodes),
+               "num_classes": pred.num_classes,
+               "buckets": list(pred.buckets),
+               "backend": pred.backend, "shard": shard})
+    serve_loop(server, wire, args.replica,
+               drain_timeout_s=args.drain_timeout)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

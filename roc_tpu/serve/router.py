@@ -1,0 +1,665 @@
+"""Multi-replica routing: N server replicas behind one ``submit``.
+
+A :class:`Router` fronts N REAL replica subprocesses (each
+``python -m roc_tpu.serve.replica`` cold-loading the same exported
+artifact — see ``serve/replica.py`` for the wire protocol) behind the
+same ``submit(node_ids) -> Future`` surface a single
+:class:`~roc_tpu.serve.server.Server` offers, adding the availability
+properties one process cannot have:
+
+- **least-loaded dispatch** — each request goes to the eligible
+  replica with the fewest in-flight requests.  Eligibility is
+  *shard-aware*: a replica may advertise a ``[lo, hi)`` node range
+  (the future 2-D mesh's table shards); requests spanning ranges are
+  split per shard-group and reassembled in order — with today's
+  full-range replicas that degenerates to pure least-loaded.
+- **health + failover** — liveness rides the replica heartbeat lines
+  (the ``obs`` heartbeat cadence, ``ROC_TPU_SERVE_HB_S``); a silent
+  replica leaves a dated ``stall`` event exactly like a wedged bench
+  stage.  When a replica dies (EOF/exit — the ``replica_sigkill``
+  drill), its in-flight requests are requeued onto survivors and the
+  failover lands as a timeline marker (``serve`` event,
+  kind=``failover``).
+- **hedged re-dispatch** — a request in flight longer than the
+  ``hedge_pct`` percentile of completed latencies (floored at
+  ``hedge_min_ms``) is duplicated onto a second replica; first answer
+  wins.  This is what bounds the ``replica_stall`` drill: a stuck
+  replica costs one hedge, not a hung client.
+- **deadlines + backpressure** — the router's monitor expires pending
+  requests past ``deadline_ms`` with typed ``ServeTimeout`` even when
+  every replica is wedged (never a hang), and ``max_inflight`` sheds
+  with ``ServeOverload`` at submit.
+
+The failure contract is the serve tier's one contract
+(``serve/errors.py``): an accepted request completes with a correct
+answer or fails typed.  Replica-side *retryable* failures (the
+``serve_io`` drill) are re-dispatched transparently, bounded by
+``max_tries``; deadline/shed/closed failures propagate as themselves.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs.events import emit
+from .errors import (ReplicaLost, ServeClosed, ServeError,
+                     ServeOverload, ServeTimeout)
+from .replica import hb_interval
+
+# monitor cadence: deadline expiry + hedging both resolve on this
+# grain, so it sits well under the smallest deadline worth setting
+_MONITOR_TICK_S = 0.01
+
+# typed names a replica may report; anything else maps to ServeError
+_TYPED = {"ServeTimeout": ServeTimeout, "ServeOverload": ServeOverload,
+          "ServeClosed": ServeClosed, "ValueError": ValueError}
+
+
+class _Replica:
+    """Router-side handle for one replica subprocess."""
+
+    def __init__(self, idx: int, proc: subprocess.Popen):
+        self.idx = idx
+        self.proc = proc
+        self.wlock = threading.Lock()
+        self.alive = True
+        self.requeued = False   # failover ran for this corpse already
+        self.ready: Dict[str, Any] = {}
+        self.shard: Optional[Tuple[int, int]] = None
+        self.inflight = 0
+        self.served = 0
+        self.last_hb = time.monotonic()
+        self.silent_noted = False
+        self.reader: Optional[threading.Thread] = None
+
+    def covers(self, lo: int, hi: int) -> bool:
+        if self.shard is None:
+            return True
+        return self.shard[0] <= lo and hi <= self.shard[1]
+
+    def send(self, obj: Dict[str, Any]) -> bool:
+        line = json.dumps(obj) + "\n"
+        try:
+            with self.wlock:
+                # per-replica pipe serializer; the hold is one small
+                # flushed line (the event-bus JSONL precedent):
+                # roc-lint: ok=blocking-under-lock
+                self.proc.stdin.write(line)
+                # same bounded hold: roc-lint: ok=blocking-under-lock
+                self.proc.stdin.flush()
+            return True
+        except (OSError, ValueError):
+            return False
+
+
+class _Sub:
+    """One wire request: a shard-slice of a client submit, assigned to
+    (up to two, when hedged) replicas."""
+
+    __slots__ = ("wire_id", "parent", "slot", "ids", "deadline_t",
+                 "replica", "hedge_replica", "t_sent", "tries")
+
+    def __init__(self, wire_id, parent, slot, ids, deadline_t):
+        self.wire_id = wire_id
+        self.parent = parent
+        self.slot = slot
+        self.ids = ids
+        self.deadline_t = deadline_t
+        self.replica: Optional[int] = None
+        self.hedge_replica: Optional[int] = None
+        self.t_sent = 0.0
+        self.tries = 0
+
+
+class _Parent:
+    """One client submit: future + per-shard result slots."""
+
+    __slots__ = ("fut", "n_left", "parts", "order", "version")
+
+    def __init__(self, fut: Future, n_slots: int, order):
+        self.fut = fut
+        self.n_left = n_slots
+        self.parts: List[Optional[np.ndarray]] = [None] * n_slots
+        self.order = order
+        self.version: Optional[int] = None
+
+
+class Router:
+    """See module docstring.  ``Router(artifact_dir, n_replicas=2)``
+    spawns the replicas; ``submit``/``query``/``stats``/``close``
+    mirror :class:`~roc_tpu.serve.server.Server`."""
+
+    def __init__(self, artifact_dir: str, n_replicas: int = 2,
+                 shards: Optional[Sequence[Tuple[int, int]]] = None,
+                 max_wait_ms: float = 0.2,
+                 max_inflight: int = 1024,
+                 default_deadline_ms: Optional[float] = None,
+                 hedge_pct: float = 0.95,
+                 hedge_min_ms: float = 50.0,
+                 max_tries: int = 3,
+                 cpu: bool = False,
+                 ready_timeout_s: float = 180.0,
+                 env: Optional[Dict[str, str]] = None,
+                 replica_args: Optional[Sequence[str]] = None):
+        if n_replicas < 1:
+            raise ValueError("need at least one replica")
+        if shards is not None and len(shards) != n_replicas:
+            raise ValueError("one shard range per replica")
+        self.artifact_dir = artifact_dir
+        self.max_inflight = int(max_inflight)
+        self.default_deadline_ms = default_deadline_ms
+        self.hedge_pct = float(hedge_pct)
+        self.hedge_min_ms = float(hedge_min_ms)
+        self.max_tries = int(max_tries)
+        self._lock = threading.Lock()
+        self._pending: Dict[int, _Sub] = {}
+        self._next_id = 0
+        self._closed = False
+        self._stop = threading.Event()
+        self._lat_ms: List[float] = []     # completed-latency window
+        self._n_submitted = 0
+        self._n_shed = 0
+        self._n_timeout = 0
+        self._n_failover = 0
+        self._n_hedge = 0
+        self._n_ok = 0
+        self._n_failed = 0
+        self.num_nodes: Optional[int] = None
+        # the router's own lane handshake, like Server's
+        emit("timeline", f"clock_sync: serve router up "
+             f"({n_replicas} replica(s) over {artifact_dir})",
+             console=False, kind="clock_sync", server="router")
+        self._replica_args = list(replica_args or [])
+        self._monitor: Optional[threading.Thread] = None
+        self.replicas: List[_Replica] = []
+        for i in range(n_replicas):
+            self.replicas.append(self._spawn(
+                i, shards[i] if shards else None, max_wait_ms, cpu,
+                env))
+        self._await_ready(ready_timeout_s)
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="router:monitor",
+            daemon=True)
+        self._monitor.start()
+
+    # ------------------------------------------------------- lifecycle
+
+    def _spawn(self, idx: int, shard, max_wait_ms: float, cpu: bool,
+               env: Optional[Dict[str, str]]) -> _Replica:
+        cmd = [sys.executable, "-m", "roc_tpu.serve.replica",
+               self.artifact_dir, "--replica", str(idx),
+               "--max-wait-ms", str(max_wait_ms)]
+        if shard is not None:
+            cmd += ["--shard", f"{shard[0]}:{shard[1]}"]
+        if cpu:
+            cmd += ["--cpu"]
+        cmd += self._replica_args
+        child_env = dict(env) if env is not None else os.environ.copy()
+        # `-m roc_tpu.serve.replica` must resolve regardless of the
+        # caller's cwd (a bench child runs from an arbitrary dir):
+        # the package's parent dir rides PYTHONPATH
+        pkg_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        child_env["PYTHONPATH"] = (
+            pkg_root + os.pathsep + child_env["PYTHONPATH"]
+            if child_env.get("PYTHONPATH") else pkg_root)
+        proc = subprocess.Popen(
+            cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            text=True, env=child_env)
+        rep = _Replica(idx, proc)
+        if shard is not None:
+            rep.shard = (int(shard[0]), int(shard[1]))
+        rep.reader = threading.Thread(
+            target=self._read_loop, args=(rep,),
+            name=f"router:read{idx}", daemon=True)
+        rep.reader.start()
+        return rep
+
+    def _await_ready(self, timeout_s: float) -> None:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                ready = [r for r in self.replicas if r.ready]
+                dead = [r for r in self.replicas if not r.alive]
+            if dead:
+                self.close()
+                raise ServeError(
+                    f"replica(s) {[r.idx for r in dead]} died during "
+                    f"startup (see stderr)")
+            if len(ready) == len(self.replicas):
+                self.num_nodes = int(ready[0].ready["num_nodes"])
+                emit("serve", f"router ready: {len(ready)} replica(s), "
+                     f"V={self.num_nodes}", console=False,
+                     kind="router_ready", replicas=len(ready))
+                return
+            time.sleep(0.05)
+        self.close()
+        raise ServeError(f"replicas not ready within {timeout_s:.0f}s")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            pending = list(self._pending.values())
+            self._pending.clear()
+        self._stop.set()
+        for sub in pending:
+            if not sub.parent.fut.done():
+                sub.parent.fut.set_exception(
+                    ServeClosed("router closed with requests in "
+                                "flight"))
+        # graceful first: close stdin → replica drains and exits 0
+        for rep in self.replicas:
+            try:
+                rep.proc.stdin.close()
+            except (OSError, ValueError):
+                pass
+        deadline = time.monotonic() + 15.0
+        for rep in self.replicas:
+            try:
+                rep.proc.wait(timeout=max(0.1,
+                                          deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                # a wedged replica (the replica_stall drill) cannot
+                # drain — escalate the way bench does: TERM, then KILL
+                rep.proc.terminate()
+                try:
+                    rep.proc.wait(timeout=5.0)
+                except subprocess.TimeoutExpired:
+                    rep.proc.kill()
+                    rep.proc.wait()
+        if self._monitor is not None:
+            self._monitor.join(timeout=5.0)
+        for rep in self.replicas:
+            if rep.reader is not None:
+                rep.reader.join(timeout=5.0)
+        s = self.stats()
+        emit("serve", f"router closed: {s['n_ok']} ok / "
+             f"{s['n_timeout']} timeout / {s['n_shed']} shed / "
+             f"{s['n_failover']} failover / {s['n_hedge']} hedge",
+             console=False, kind="router_summary", **s)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---------------------------------------------------------- submit
+
+    def submit(self, node_ids,
+               deadline_ms: Optional[float] = None) -> Future:
+        """One client request; resolves to the fp32 ``[n, C]`` logits
+        or a typed ``serve/errors.py`` failure."""
+        ids = np.asarray(node_ids, dtype=np.int32).ravel()
+        fut: Future = Future()
+        if ids.size and self.num_nodes is not None and (
+                ids.min() < 0 or ids.max() >= self.num_nodes):
+            fut.set_exception(ValueError(
+                f"node ids out of range [0, {self.num_nodes})"))
+            return fut
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline_t = (None if deadline_ms is None
+                      else time.monotonic() + max(0.0, deadline_ms)
+                      / 1e3)
+        groups = self._shard_groups(ids)
+        with self._lock:
+            if self._closed:
+                fut.set_exception(ServeClosed("router is closed"))
+                return fut
+            if len(self._pending) + len(groups) > self.max_inflight:
+                self._n_shed += 1
+                fut.set_exception(ServeOverload(
+                    f"router in-flight cap {self.max_inflight} "
+                    f"reached — load shed"))
+                return fut
+            self._n_submitted += 1
+            parent = _Parent(fut, len(groups),
+                             [g[1] for g in groups])
+            subs = []
+            for slot, (gids, _order) in enumerate(groups):
+                wire_id = self._next_id
+                self._next_id += 1
+                sub = _Sub(wire_id, parent, slot, gids, deadline_t)
+                self._pending[wire_id] = sub
+                subs.append(sub)
+        for sub in subs:
+            self._dispatch(sub)
+        return fut
+
+    def query(self, node_ids,
+              deadline_ms: Optional[float] = None) -> np.ndarray:
+        return self.submit(node_ids, deadline_ms=deadline_ms).result()
+
+    def _shard_groups(self, ids: np.ndarray):
+        """Split ``ids`` into per-shard-group sub-requests.  Returns
+        ``[(gids, positions)]``; with full-range replicas this is one
+        group carrying everything."""
+        ranges = sorted({r.shard for r in self.replicas
+                         if r.shard is not None})
+        if not ranges:
+            return [(ids, np.arange(ids.size))]
+        groups = []
+        claimed = np.zeros(ids.size, dtype=bool)
+        for lo, hi in ranges:
+            mask = (ids >= lo) & (ids < hi) & ~claimed
+            if mask.any():
+                claimed |= mask
+                groups.append((ids[mask], np.nonzero(mask)[0]))
+        if not claimed.all():
+            # ids outside every advertised shard: any full-range
+            # replica takes them; else they ride the first group
+            rest = ~claimed
+            groups.append((ids[rest], np.nonzero(rest)[0]))
+        return groups or [(ids, np.arange(ids.size))]
+
+    # -------------------------------------------------------- dispatch
+
+    def _pick_replica(self, sub: _Sub,
+                      exclude: Sequence[int] = ()) -> Optional[_Replica]:
+        lo = int(sub.ids.min()) if sub.ids.size else 0
+        hi = int(sub.ids.max()) + 1 if sub.ids.size else 0
+        with self._lock:
+            # exclude is HARD: a hedge must never land back on the
+            # replica it hedges around (a wedged-but-alive replica
+            # would absorb its own hedge and defeat the bound), and a
+            # broken-pipe exclude must never be re-picked mid-loop
+            cands = [r for r in self.replicas
+                     if r.alive and r.ready and r.idx not in exclude
+                     and r.covers(lo, hi)]
+            if not cands:
+                return None
+            return min(cands, key=lambda r: r.inflight)
+
+    def _dispatch(self, sub: _Sub, hedge: bool = False) -> None:
+        """Assign ``sub`` to the least-loaded eligible replica and put
+        it on the wire; a dead pipe fails over immediately."""
+        exclude = ([sub.replica] if hedge and sub.replica is not None
+                   else [])
+        while True:
+            rep = self._pick_replica(sub, exclude=exclude)
+            if rep is None:
+                if hedge:
+                    return     # nowhere to hedge — original still owns
+                self._fail_sub(sub, ReplicaLost(
+                    "no live replica covers this request's shard"))
+                return
+            remaining_ms = (None if sub.deadline_t is None else
+                            max(0.0, (sub.deadline_t - time.monotonic())
+                                * 1e3))
+            ok = rep.send({"id": sub.wire_id,
+                           "ids": sub.ids.tolist(),
+                           "deadline_ms": remaining_ms})
+            if ok:
+                with self._lock:
+                    rep.inflight += 1
+                    if hedge:
+                        sub.hedge_replica = rep.idx
+                    else:
+                        sub.replica = rep.idx
+                        sub.t_sent = time.monotonic()
+                        sub.tries += 1
+                return
+            # broken pipe: this replica is gone.  Requeue its OTHER
+            # in-flight requests (skip= keeps THIS sub out — the loop
+            # below re-dispatches it itself, a double-send would act
+            # like an accidental hedge)
+            self._mark_dead(rep, "write failed", skip=sub)
+            exclude = list(exclude) + [rep.idx]
+
+    def _fail_sub(self, sub: _Sub, exc: Exception) -> None:
+        """Fail the whole parent (pop every sibling sub).  Counts ONE
+        failure per parent, and only when the request was actually
+        still pending — a request completed by _on_result in the
+        monitor's snapshot-to-call window, or a sibling of an
+        already-failed parent, must not inflate the stats."""
+        with self._lock:
+            popped = self._pending.pop(sub.wire_id, None) is not None
+            for wid, other in list(self._pending.items()):
+                if other.parent is sub.parent:
+                    self._pending.pop(wid)
+                    popped = True
+            count = popped and not sub.parent.fut.done()
+            if count:
+                if isinstance(exc, ServeTimeout):
+                    self._n_timeout += 1
+                self._n_failed += 1
+        if count and not sub.parent.fut.done():
+            try:
+                sub.parent.fut.set_exception(exc)
+            except Exception:  # noqa: BLE001 - lost the completion race
+                pass
+
+    # --------------------------------------------------------- readers
+
+    def _read_loop(self, rep: _Replica) -> None:
+        try:
+            for line in rep.proc.stdout:
+                if self._stop.is_set():
+                    break
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                kind = msg.get("kind")
+                if kind == "ready":
+                    with self._lock:
+                        rep.ready = msg
+                        if msg.get("shard"):
+                            rep.shard = tuple(msg["shard"])
+                        rep.last_hb = time.monotonic()
+                elif kind == "hb":
+                    with self._lock:
+                        rep.last_hb = time.monotonic()
+                        rep.silent_noted = False
+                elif kind == "res":
+                    self._on_result(rep, msg)
+                elif kind == "drained":
+                    with self._lock:
+                        rep.last_hb = time.monotonic()
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._mark_dead(rep, "stdout EOF")
+
+    def _on_result(self, rep: _Replica, msg: Dict[str, Any]) -> None:
+        with self._lock:
+            rep.inflight = max(0, rep.inflight - 1)
+            sub = self._pending.get(msg.get("id"))
+            if sub is not None and msg.get("ok"):
+                del self._pending[sub.wire_id]
+                self._lat_ms.append(
+                    (time.monotonic() - sub.t_sent) * 1e3)
+                if len(self._lat_ms) > 512:
+                    del self._lat_ms[:256]
+                rep.served += 1
+        if sub is None:
+            return   # hedge already won (or expired): late twin
+        if msg.get("ok"):
+            rows = np.asarray(msg["rows"], dtype=np.float32)
+            self._complete(sub, rows, msg.get("version"))
+            return
+        # typed failure from the replica
+        retryable = bool(msg.get("retryable"))
+        if retryable:
+            with self._lock:
+                still = sub.wire_id in self._pending
+                tries = sub.tries
+            if still and tries < self.max_tries:
+                emit("serve", f"retryable failure on replica "
+                     f"{rep.idx} ({msg.get('error')}) — "
+                     f"re-dispatching", console=False,
+                     kind="redispatch", replica=rep.idx,
+                     error=msg.get("error"))
+                self._dispatch(sub)
+                return
+        exc_type = _TYPED.get(msg.get("error"), ServeError)
+        self._fail_sub(sub, exc_type(
+            f"replica {rep.idx}: {msg.get('msg', msg.get('error'))}"))
+
+    def _complete(self, sub: _Sub, rows: np.ndarray,
+                  version: Optional[int]) -> None:
+        parent = sub.parent
+        done = False
+        with self._lock:
+            parent.parts[sub.slot] = rows
+            if version is not None:
+                parent.version = (version if parent.version is None
+                                  else max(parent.version, version))
+            parent.n_left -= 1
+            done = parent.n_left == 0
+            if done:
+                self._n_ok += 1
+        if not done or parent.fut.done():
+            return
+        if len(parent.parts) == 1:
+            out = parent.parts[0]
+        else:
+            n = sum(p.shape[0] for p in parent.parts)
+            out = np.empty((n, parent.parts[0].shape[1]), np.float32)
+            for part, pos in zip(parent.parts, parent.order):
+                out[np.asarray(pos)] = part
+        from .server import ServeResult
+        res = out.view(ServeResult)
+        res.version = int(parent.version or 0)
+        parent.fut.set_result(res)
+
+    # -------------------------------------------------- failover/hedge
+
+    def _mark_dead(self, rep: _Replica, why: str,
+                   skip: Optional[_Sub] = None) -> None:
+        """Mark a replica dead and fail over its in-flight requests —
+        exactly once per corpse, whichever of the reader (EOF), the
+        monitor (poll), or a failed write gets here first."""
+        with self._lock:
+            was_alive = rep.alive
+            rep.alive = False
+            if rep.requeued or self._closed:
+                if not was_alive:
+                    return
+                orphans = []
+            else:
+                rep.requeued = True
+                orphans = [s for s in self._pending.values()
+                           if (s.replica == rep.idx
+                               or s.hedge_replica == rep.idx)
+                           and s is not skip]
+                self._n_failover += len(orphans)
+            closed = self._closed
+        if closed or (not was_alive and not orphans):
+            return
+        # the failover marker the timeline renders on the router lane
+        emit("serve", f"replica {rep.idx} died ({why}): failing over "
+             f"{len(orphans)} in-flight request(s)",
+             kind="failover", replica=rep.idx, requeued=len(orphans))
+        for sub in orphans:
+            if sub.hedge_replica == rep.idx:
+                with self._lock:
+                    sub.hedge_replica = None
+                continue
+            # requeue onto a survivor (deadline still enforced by the
+            # monitor; a request whose deadline already passed expires
+            # there as ServeTimeout, never silently dropped)
+            self._dispatch(sub)
+
+    def _hedge_threshold_ms(self) -> float:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+        if not lat:
+            return self.hedge_min_ms
+        q = lat[min(len(lat) - 1, int(self.hedge_pct * len(lat)))]
+        return max(self.hedge_min_ms, q * 2.0)
+
+    def _monitor_loop(self) -> None:
+        hb_timeout = 3.0 * hb_interval()
+        while not self._stop.wait(_MONITOR_TICK_S):
+            now = time.monotonic()
+            # deadline expiry — authoritative, replica-independent:
+            # this is the "never a hang" backstop
+            with self._lock:
+                expired = [s for s in self._pending.values()
+                           if s.deadline_t is not None
+                           and s.deadline_t <= now]
+            for sub in expired:
+                self._fail_sub(sub, ServeTimeout(
+                    "deadline expired in flight"))
+            # hedging: slow in-flight subs get a second replica
+            thr_s = self._hedge_threshold_ms() / 1e3
+            with self._lock:
+                slow = [s for s in self._pending.values()
+                        if s.hedge_replica is None and s.t_sent
+                        and now - s.t_sent > thr_s
+                        and len([r for r in self.replicas
+                                 if r.alive]) > 1]
+            for sub in slow:
+                self._n_hedge += 1
+                emit("serve", f"hedging request {sub.wire_id} "
+                     f"(in flight {1e3 * (now - sub.t_sent):.0f} ms "
+                     f"on replica {sub.replica})", console=False,
+                     kind="hedge", replica=sub.replica)
+                self._dispatch(sub, hedge=True)
+            # health: dead processes + silent heartbeats
+            for rep in list(self.replicas):
+                if rep.alive and rep.proc.poll() is not None:
+                    self._mark_dead(rep,
+                                    f"exit rc={rep.proc.returncode}")
+                    continue
+                with self._lock:
+                    silent = (rep.alive and rep.ready
+                              and now - rep.last_hb > hb_timeout
+                              and not rep.silent_noted)
+                    if silent:
+                        rep.silent_noted = True
+                        age = now - rep.last_hb
+                if silent:
+                    # same evidence trail as a wedged bench stage
+                    emit("stall", f"replica {rep.idx} heartbeat "
+                         f"silent for {age:.1f}s",
+                         stage=f"serve_replica{rep.idx}",
+                         elapsed_s=round(age, 1))
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            lat = sorted(self._lat_ms)
+            reps = [{"replica": r.idx, "alive": r.alive,
+                     "inflight": r.inflight, "served": r.served,
+                     "shard": list(r.shard) if r.shard else None}
+                    for r in self.replicas]
+            n_sub = self._n_submitted
+            n_shed = self._n_shed
+            out = {"n_submitted": n_sub, "n_ok": self._n_ok,
+                   "n_failed": self._n_failed,
+                   "n_timeout": self._n_timeout,
+                   "n_shed": n_shed,
+                   "n_failover": self._n_failover,
+                   "n_hedge": self._n_hedge,
+                   "replicas": reps}
+
+        def pct(p):
+            if not lat:
+                return None
+            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 4)
+
+        denom = max(n_sub + n_shed, 1)
+        out["p50_ms"] = pct(0.50)
+        out["p99_ms"] = pct(0.99)
+        out["shed_rate"] = round(n_shed / denom, 4)
+        out["error_rate"] = round(out["n_failed"] / denom, 4)
+        out["availability"] = round(out["n_ok"] / denom, 4)
+        return out

@@ -1,14 +1,39 @@
 """Microbatch request queue: many concurrent queries, one device
-dispatch.
+dispatch — with deadlines, backpressure, and versioned-table pinning.
 
-``Server.submit(node_ids) -> Future`` is the serving tier's public
-face: a dispatcher thread drains whatever requests are queued, packs
-them into ONE padded, bucket-quantized device dispatch
+``Server.submit(node_ids, deadline_ms=...) -> Future`` is the serving
+tier's public face: a dispatcher thread drains whatever requests are
+queued, packs them into ONE padded, bucket-quantized device dispatch
 (``Predictor.query_device``), and completes each caller's future with
 its slice of the result.  Coalescing is bit-exact: every served row is
 an independent dot-product chain, so a row's logits are identical
 whether it shipped alone or inside a 512-wide microbatch
 (tests/test_serve.py pins this).
+
+The robustness contract (ISSUE 13, drilled in
+tests/test_serve_robustness.py) — an accepted request either completes
+with a correct answer or fails with a typed ``serve/errors.py``
+exception, never a hang, never a wrong value:
+
+- **deadlines** — ``deadline_ms`` expires queued requests with
+  :class:`~roc_tpu.serve.errors.ServeTimeout` at microbatch
+  boundaries, so a deadline'd request resolves within ~one microbatch
+  of its deadline;
+- **backpressure** — the admission queue is bounded (``max_queue``);
+  past it, ``submit`` sheds immediately with
+  :class:`~roc_tpu.serve.errors.ServeOverload` (shed-rate in
+  ``stats()``), instead of queueing unboundedly and timing everyone
+  out;
+- **versioned tables** — each microbatch captures ONE
+  ``Predictor.published()`` table version at batch-take; an
+  ``add_edges`` publish mid-flight cannot tear a batch (results carry
+  ``.version``, a :class:`ServeResult` ndarray view);
+- **lifecycle** — ``close()`` rejects late ``submit()`` with
+  :class:`~roc_tpu.serve.errors.ServeClosed` (never a race against
+  the dispatcher shutdown); ``drain()`` is the graceful half: stop
+  admitting, finish everything in flight, then close — the SIGTERM
+  path a replica worker takes (``serve/replica.py`` wires it to the
+  PR-8 preemption guard).
 
 Observability: the server emits a ``clock_sync`` timeline handshake at
 startup (so the merged Perfetto trace gives the server process its own
@@ -16,12 +41,16 @@ aligned lane) and batches a ``serve_batch`` span per microbatch into
 the same ``timeline``-category span events the trainers use — the
 request pipeline renders next to the training lanes with zero new
 merger code.  A ``serve`` summary event (queries, batches, latency
-percentiles) closes the session.
+percentiles, shed/timeout counts) closes the session.
 
 The request loop is a hot path under roc-lint's
 ``host-sync-hot-path`` rule (``analysis/ast_lint.py`` scopes
 ``roc_tpu/serve/`` in): the ONLY device→host sync is the result fetch
-inside the predictor, which is the product.
+inside the predictor, which is the product.  The serve fault sites
+(``resilience/inject.py serve_batch_hooks``: replica_sigkill /
+replica_stall / table_swap_mid_query / serve_io) hook the dispatch
+between version capture and device dispatch — the exact window the
+versioned-swap drill targets.
 """
 
 from __future__ import annotations
@@ -29,17 +58,51 @@ from __future__ import annotations
 import threading
 import time
 from concurrent.futures import Future
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..obs.events import emit
-from .predictor import Predictor, bucket_for
+from ..resilience import inject
+from .errors import (ServeClosed, ServeError, ServeOverload,
+                     ServeTimeout)
+from .predictor import Predictor
 
 # spans accumulate and flush as ONE timeline event per this many
 # microbatches (and at close) — per-batch emits would put JSONL I/O on
 # the request path
 _SPAN_FLUSH_EVERY = 64
+
+# admission-queue bound (requests, not rows): past it submit() sheds
+# with ServeOverload.  Sized so a saturated open-loop burst fails fast
+# instead of building seconds of queueing delay.
+DEFAULT_MAX_QUEUE = 1024
+
+
+class ServeResult(np.ndarray):
+    """The fp32 ``[n, C]`` logits, plus the table ``version`` the
+    request's microbatch was served under — an ndarray view, so every
+    existing consumer keeps treating results as plain arrays."""
+    version: int = 0
+
+
+def _result(rows: np.ndarray, version: int) -> ServeResult:
+    out = rows.view(ServeResult)
+    out.version = int(version)
+    return out
+
+
+class _Req:
+    """One queued request: ids, the caller's future, and the absolute
+    monotonic deadline (None = no deadline)."""
+
+    __slots__ = ("ids", "fut", "deadline_t")
+
+    def __init__(self, ids: np.ndarray, fut: Future,
+                 deadline_t: Optional[float]):
+        self.ids = ids
+        self.fut = fut
+        self.deadline_t = deadline_t
 
 
 class Server:
@@ -49,21 +112,35 @@ class Server:
     first queued request to let concurrent submitters join the batch
     (0 = dispatch immediately; the default 0.2 ms trades ~a fifth of a
     millisecond of p50 for a much fatter microbatch under load).
-    """
+    ``max_queue`` bounds the admission queue (see module docstring);
+    ``default_deadline_ms`` applies to submits that pass none."""
 
     def __init__(self, predictor: Predictor,
                  max_wait_ms: float = 0.2,
-                 name: str = "serve"):
+                 name: str = "serve",
+                 max_queue: int = DEFAULT_MAX_QUEUE,
+                 default_deadline_ms: Optional[float] = None):
         self.pred = predictor
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self.name = name
+        self.max_queue = int(max_queue)
+        self.default_deadline_ms = default_deadline_ms
         self._lock = threading.Condition()
-        self._queue: List[Tuple[np.ndarray, Future]] = []
+        self._queue: List[_Req] = []
         self._closed = False
+        self._draining = False
+        self._dispatching = False
         self._spans: List[Tuple[str, float, float]] = []
         self._batch_ms: List[float] = []
         self._batch_n: List[int] = []
-        self._n_queries = 0
+        self._n_queries = 0          # accepted into the queue
+        self._n_shed = 0             # ServeOverload at submit
+        self._n_timeout = 0          # ServeTimeout at a batch boundary
+        self._n_rejected_closed = 0  # ServeClosed at submit
+        self._n_errors = 0           # dispatch failures (batch-wide)
+        self._n_ok = 0               # requests completed with rows
+        self._batch_seq = 0
+        self._versions = set()       # table versions actually served
         # the lane handshake: wall/mono stamped by the bus — the
         # timeline merger aligns this process's spans on it
         emit("timeline", f"clock_sync: serve server '{name}' up "
@@ -76,9 +153,12 @@ class Server:
 
     # ---------------------------------------------------------- public
 
-    def submit(self, node_ids) -> Future:
+    def submit(self, node_ids,
+               deadline_ms: Optional[float] = None) -> Future:
         """Queue a query; the returned future resolves to the fp32
-        ``[len(node_ids), C]`` logits."""
+        ``[len(node_ids), C]`` logits (a :class:`ServeResult` carrying
+        the table ``version`` it was served under), or to one of the
+        typed ``serve/errors.py`` failures — never a bare hang."""
         ids = np.asarray(node_ids, dtype=np.int32).ravel()
         fut: Future = Future()
         if ids.size and (ids.min() < 0
@@ -86,28 +166,51 @@ class Server:
             fut.set_exception(ValueError(
                 f"node ids out of range [0, {self.pred.num_nodes})"))
             return fut
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        deadline_t = (None if deadline_ms is None
+                      else time.monotonic() + max(0.0, deadline_ms)
+                      / 1e3)
         with self._lock:
-            if self._closed:
-                fut.set_exception(RuntimeError("server is closed"))
+            if self._closed or self._draining:
+                self._n_rejected_closed += 1
+                fut.set_exception(ServeClosed(
+                    f"server '{self.name}' is "
+                    + ("draining" if self._draining and not self._closed
+                       else "closed")))
                 return fut
-            self._queue.append((ids, fut))
+            if len(self._queue) >= self.max_queue:
+                self._n_shed += 1
+                fut.set_exception(ServeOverload(
+                    f"admission queue full ({self.max_queue} queued) "
+                    f"— load shed"))
+                return fut
+            self._queue.append(_Req(ids, fut, deadline_t))
             self._n_queries += 1
             self._lock.notify()
         return fut
 
-    def query(self, node_ids) -> np.ndarray:
+    def query(self, node_ids,
+              deadline_ms: Optional[float] = None) -> np.ndarray:
         """Synchronous convenience: ``submit(...).result()``."""
-        return self.submit(node_ids).result()
+        return self.submit(node_ids, deadline_ms=deadline_ms).result()
 
     def stats(self) -> Dict[str, Any]:
-        """Microbatch accounting since startup.  Snapshots under the
-        server lock: the dispatcher thread appends to these series
-        concurrently (roc-lint unguarded-shared-state — a sorted()
-        over a list mid-append is exactly the race class)."""
+        """Microbatch + robustness accounting since startup.
+        Snapshots under the server lock: the dispatcher thread appends
+        to these series concurrently (roc-lint
+        unguarded-shared-state — a sorted() over a list mid-append is
+        exactly the race class)."""
         with self._lock:
             ms = sorted(self._batch_ms)
             batch_n = list(self._batch_n)
             n_queries = self._n_queries
+            n_shed = self._n_shed
+            n_timeout = self._n_timeout
+            n_rejected = self._n_rejected_closed
+            n_errors = self._n_errors
+            n_ok = self._n_ok
+            versions = sorted(self._versions)
 
         def pct(p: float) -> Optional[float]:
             if not ms:
@@ -116,25 +219,63 @@ class Server:
             return round(q, 4)
 
         mean_rows = np.mean(batch_n) if batch_n else None
+        submitted = n_queries + n_shed + n_rejected
+        denom = max(submitted, 1)
         return {"n_queries": n_queries,
                 "n_batches": len(ms),
                 "rows_per_batch": (round(float(mean_rows), 2)
                                    if mean_rows is not None else None),
                 "batch_p50_ms": pct(0.50),
-                "batch_p99_ms": pct(0.99)}
+                "batch_p99_ms": pct(0.99),
+                "n_shed": n_shed,
+                "n_timeout": n_timeout,
+                "n_rejected_closed": n_rejected,
+                "n_errors": n_errors,
+                "n_ok": n_ok,
+                "shed_rate": round(n_shed / denom, 4),
+                "error_rate": round((n_timeout + n_errors) / denom, 4),
+                "availability": round(n_ok / denom, 4),
+                "table_versions": versions[-8:],
+                }
+
+    def drain(self, timeout: Optional[float] = 30.0) -> bool:
+        """Graceful shutdown, the SIGTERM path: stop admitting (late
+        submits fail typed ``ServeClosed``), let the dispatcher finish
+        every already-accepted request, then close.  Returns True when
+        everything in flight completed within ``timeout``."""
+        with self._lock:
+            if self._closed:
+                return True
+            self._draining = True
+            self._lock.notify_all()
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while self._queue or self._dispatching:
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if left is not None and left <= 0:
+                    break
+                self._lock.wait(timeout=left)
+            drained = not self._queue and not self._dispatching
+        emit("serve", f"server '{self.name}' drained "
+             f"({'clean' if drained else 'TIMED OUT with work left'})",
+             console=False, kind="drain", clean=drained)
+        self.close()
+        return drained
 
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
-            self._lock.notify()
+            self._lock.notify_all()
         self._thread.join(timeout=10.0)
         self._flush_spans(final=True)
         s = self.stats()
         emit("serve", f"server '{self.name}' closed: "
              f"{s['n_queries']} queries in {s['n_batches']} batches "
-             f"(p50 {s['batch_p50_ms']} ms)", console=False,
+             f"(p50 {s['batch_p50_ms']} ms, shed {s['n_shed']}, "
+             f"timeout {s['n_timeout']})", console=False,
              kind="summary", **s)
 
     def __enter__(self) -> "Server":
@@ -145,27 +286,68 @@ class Server:
 
     # ------------------------------------------------------- dispatcher
 
-    def _take_batch(self) -> Optional[List[Tuple[np.ndarray, Future]]]:
+    def _expire_locked(self, now: float) -> List[_Req]:
+        """Split deadline-expired entries out of the queue (holding
+        the lock); the CALLER completes their futures outside it — a
+        done-callback may re-enter ``submit`` and the condition's lock
+        is not reentrant."""
+        if not any(r.deadline_t is not None and r.deadline_t <= now
+                   for r in self._queue):
+            return []
+        live: List[_Req] = []
+        dead: List[_Req] = []
+        for r in self._queue:
+            if r.deadline_t is not None and r.deadline_t <= now:
+                dead.append(r)
+            else:
+                live.append(r)
+        self._queue = live
+        self._n_timeout += len(dead)
+        return dead
+
+    @staticmethod
+    def _fail_timeouts(dead: List[_Req]) -> None:
+        for r in dead:
+            if not r.fut.done():
+                r.fut.set_exception(ServeTimeout(
+                    "deadline expired before dispatch "
+                    "(queued behind a full microbatch)"))
+
+    def _take_batch(self) -> Optional[List[_Req]]:
         """Block for work; after the first request, linger up to
-        ``max_wait_s`` so concurrent submitters coalesce.  Returns
-        None at shutdown."""
-        with self._lock:
-            while not self._queue and not self._closed:
-                self._lock.wait()
-            if not self._queue:
-                return None
-        if self.max_wait_s > 0:
-            deadline = time.monotonic() + self.max_wait_s
-            cap = max(self.pred.buckets)
-            while time.monotonic() < deadline:
-                with self._lock:
-                    if (sum(i.size for i, _ in self._queue) >= cap
-                            or self._closed):
-                        break
-                time.sleep(self.max_wait_s / 8.0)
-        with self._lock:
-            batch, self._queue = self._queue, []
-        return batch
+        ``max_wait_s`` so concurrent submitters coalesce.  Expires
+        deadline'd entries at every boundary (never dispatches one).
+        Returns None at shutdown."""
+        while True:
+            with self._lock:
+                while not self._queue and not self._closed:
+                    self._lock.wait()
+                dead = self._expire_locked(time.monotonic())
+                have = bool(self._queue)
+                closed = self._closed
+            self._fail_timeouts(dead)
+            if not have:
+                if closed:
+                    return None
+                continue    # everything queued had expired; re-wait
+            if self.max_wait_s > 0:
+                deadline = time.monotonic() + self.max_wait_s
+                cap = max(self.pred.buckets)
+                while time.monotonic() < deadline:
+                    with self._lock:
+                        if (sum(r.ids.size for r in self._queue) >= cap
+                                or self._closed or self._draining):
+                            break
+                    time.sleep(self.max_wait_s / 8.0)
+            with self._lock:
+                dead = self._expire_locked(time.monotonic())
+                batch, self._queue = self._queue, []
+                if batch:
+                    self._dispatching = True
+            self._fail_timeouts(dead)
+            if batch:
+                return batch
+            # the linger expired everything it was waiting on — re-wait
 
     def _loop(self) -> None:
         while True:
@@ -175,15 +357,40 @@ class Server:
             try:
                 self._dispatch(batch)
             except Exception as e:  # noqa: BLE001 - fail the futures
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+                with self._lock:
+                    self._n_errors += len(batch)
+                # the typed-failure contract covers dispatch errors
+                # too: wrap foreign exceptions in ServeError, chained
+                # so the replica wire (and post-mortems) can still
+                # see the underlying class (serve_io's retryable
+                # OSError rides __cause__)
+                exc: Exception = e
+                if not isinstance(e, (ServeError, ValueError)):
+                    exc = ServeError(
+                        f"dispatch failed: {type(e).__name__}: {e}")
+                    exc.__cause__ = e
+                for r in batch:
+                    if not r.fut.done():
+                        r.fut.set_exception(exc)
+            finally:
+                with self._lock:
+                    self._dispatching = False
+                    self._lock.notify_all()
 
-    def _dispatch(self, batch: List[Tuple[np.ndarray, Future]]) -> None:
-        ids = (np.concatenate([i for i, _ in batch])
-               if len(batch) > 1 else batch[0][0])
+    def _dispatch(self, batch: List[_Req]) -> None:
+        ids = (np.concatenate([r.ids for r in batch])
+               if len(batch) > 1 else batch[0].ids)
+        with self._lock:
+            self._batch_seq += 1
+            batch_no = self._batch_seq
+        # ONE consistent table version for the whole microbatch,
+        # captured BEFORE the fault hooks: the table_swap_mid_query
+        # drill publishes a new version right here, and this batch
+        # must still finish bit-exact on `pub`
+        pub = self.pred.published()
+        inject.serve_batch_hooks(self, batch_no)
         t0 = time.monotonic()
-        rows = self.pred.query(ids)
+        rows = self.pred.query(ids, pub=pub)
         ms = (time.monotonic() - t0) * 1e3
         # the device dispatch above runs UNLOCKED; only the bounded
         # bookkeeping appends hold the lock (stats() reads them from
@@ -193,14 +400,18 @@ class Server:
         with self._lock:
             self._batch_ms.append(ms)
             self._batch_n.append(int(ids.size))
+            self._n_ok += len(batch)
+            self._versions.add(int(pub.version))
             self._spans.append(("serve_batch", t0, ms))
             flush = len(self._spans) >= _SPAN_FLUSH_EVERY
         if flush:
             self._flush_spans()
         lo = 0
-        for req_ids, fut in batch:
-            fut.set_result(rows[lo:lo + req_ids.size])
-            lo += req_ids.size
+        for r in batch:
+            if not r.fut.done():
+                r.fut.set_result(
+                    _result(rows[lo:lo + r.ids.size], pub.version))
+            lo += r.ids.size
 
     def _flush_spans(self, final: bool = False) -> None:
         with self._lock:

@@ -40,13 +40,16 @@ python -m roc_tpu.prewarm --config all || exit 1
 #    The live run's own verdict is recorded by bench.py into this
 #    round's headline line ("sentinel" field).
 python -m roc_tpu.sentinel --json || exit 1
-# 0b. serve smoke (ISSUE 11): export a predictor artifact, cold-load
-#     it warm-start (zero new compiles — the artifact's programs were
-#     AOT-persisted by the export), and drive a 100-query load gen on
-#     CPU.  Gate ENFORCED: a serving tier that cannot export/load/
-#     answer on CPU must not reach the chip stages (bench.py's serve
-#     stage runs the same harness there).
-python benchmarks/micro_serve.py --cpu --queries 100 \
+# 0b. serve smoke (ISSUE 11 + 13): export a predictor artifact,
+#     cold-load it warm-start (zero new compiles — the artifact's
+#     programs were AOT-persisted by the export), drive a 100-query
+#     load gen on CPU, and run the kill-a-replica router drill
+#     (--drill: 2 replicas, replica 1 SIGKILLed mid-load — zero
+#     lost/wrong answers or the chain stops).  Gate ENFORCED: a
+#     serving tier that cannot export/load/answer/fail-over on CPU
+#     must not reach the chip stages (bench.py's serve stage runs the
+#     same harness there).
+python benchmarks/micro_serve.py --cpu --queries 100 --drill \
   --out benchmarks/micro_serve_cpu.json > /dev/null || exit 1
 # 1. staged headline refresh (regression guard before the new rows;
 #    now includes the serve stage — serve_p50_ms/p99/qps land in the

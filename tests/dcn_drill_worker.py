@@ -1,0 +1,86 @@
+"""Worker for the multi-process DCN fault drill (ISSUE 13 satellite —
+the drill matrix's real-DCN arm, advertised since PR 8).
+
+Same spawn pattern as ``timeline_worker.py``: each of ``nproc``
+processes owns ``4 // nproc`` virtual CPU devices, meets the others
+through ``jax.distributed.initialize`` (Gloo loopback), and trains the
+P=4 workload — but THROUGH the resilience stack: preemption guard
+installed, a shared checkpoint rotation (multihost: process 0 writes,
+everyone restores), ``train_with_recovery`` rounds, and an optional
+armed fault (the ``site:epoch:proc`` grammar — ``sigkill:3:1`` kills
+ONLY process 1 mid-run, the drill the test re-spawns around).
+
+Exit codes follow the CLI contract: 0 = reached the target epoch,
+75 = restartable (preempted / stalled), anything else = a real bug.
+
+Usage: python dcn_drill_worker.py <coordinator> <nproc> <pid> <outdir>
+       [fault]
+"""
+
+import os
+import sys
+
+
+def main() -> None:
+    coordinator, nproc, pid, outdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    fault = sys.argv[5] if len(sys.argv) > 5 else None
+    n_parts = 4
+    local_dev = n_parts // nproc
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={local_dev}")
+    os.environ["ROC_TPU_EVENTS"] = os.path.join(
+        outdir, f"ev_p{pid}.jsonl")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from roc_tpu.parallel import multihost as mh
+    mh.init_distributed(coordinator, nproc, pid)
+    assert jax.process_count() == nproc, jax.process_count()
+
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.core.partition import partition_graph
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.obs.heartbeat import StallFailure
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.resilience import inject, preempt
+    from roc_tpu.resilience.preempt import (Preempted,
+                                            RESTARTABLE_EXIT_CODE)
+    from roc_tpu.resilience.recovery import (CheckpointRotation,
+                                             train_with_recovery)
+    from roc_tpu.train.trainer import TrainConfig
+
+    preempt.install()
+    if fault:
+        inject.arm(fault)
+
+    ds = synthetic_dataset(32 * n_parts, 6, in_dim=12, num_classes=3,
+                           seed=0)
+    mesh = mh.make_parts_mesh(n_parts)
+    cfg = TrainConfig(
+        epochs=6, verbose=False, aggr_impl="ell", symmetric=True,
+        dropout_rate=0.0, eval_every=2,
+        metrics_path=os.path.join(outdir, f"m_p{pid}.jsonl"))
+    pg = partition_graph(ds.graph, n_parts, node_multiple=8,
+                         edge_multiple=cfg.chunk)
+    data = mh.shard_dataset_local(ds, pg, mesh, aggr_impl="ell")
+    tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
+                            ds, n_parts, cfg, mesh=mesh, data=data,
+                            pg=pg)
+    rotation = CheckpointRotation(os.path.join(outdir, "ck"), keep=3)
+    try:
+        # max_retries=0: in a multi-process run an in-process retry
+        # cannot work once a PEER is gone (the first collective wedges
+        # again) — the restartable-exit + re-spawn path IS the drill
+        train_with_recovery(tr, cfg.epochs, rotation,
+                            checkpoint_every=2, max_retries=0)
+    except (Preempted, StallFailure):
+        sys.exit(RESTARTABLE_EXIT_CODE)
+    m = tr.evaluate()
+    print(f"WORKER_OK pid={pid} loss={m['train_loss']:.8f}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

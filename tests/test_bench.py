@@ -250,3 +250,47 @@ def test_stale_record_not_promoted(tmp_path):
              art_dir=str(tmp_path), timeout=120)
     line = _last_json(r.stdout)
     assert line["value"] == 2359.25
+
+
+def test_gcn_stage_checkpoint_resume(tmp_path):
+    """ISSUE-13 satellite (ROADMAP checkpoint-aware bench probe): a
+    GCN stage child that died mid-round leaves a rotation checkpoint;
+    the retry attempt RESUMES from it (resumed_from_epoch in the
+    result) instead of re-training cold, and _clear_gcn_checkpoints
+    keeps rounds from contaminating each other.  Driven through
+    bench.child_gcn in a subprocess with a tiny rig."""
+    code = (
+        "import os, sys, types, json\n"
+        f"os.environ['ROC_TPU_BENCH_ARTIFACTS'] = {str(tmp_path)!r}\n"
+        f"sys.path.insert(0, {_REPO!r})\n"
+        "import bench\n"
+        "args = types.SimpleNamespace(cpu=True, layers='12-8-3',\n"
+        "    impl='ell', chunk=512, dtype='float32', epochs=1,\n"
+        "    stage='small')\n"
+        "r1 = bench.child_gcn(args, 256, 2048)\n"
+        "assert r1['resumed_from_epoch'] is None, r1\n"
+        "# the post-warmup rotation checkpoint exists\n"
+        "import glob\n"
+        "cks = glob.glob(bench._gcn_ck_prefix('small') + '.*.npz')\n"
+        "assert cks, 'no rotation checkpoint written'\n"
+        "# attempt 2 (same parent round): resumes from the rotation\n"
+        "args2 = types.SimpleNamespace(cpu=True, layers='12-8-3',\n"
+        "    impl='ell', chunk=512, dtype='float32', epochs=1,\n"
+        "    stage='small')\n"
+        "r2 = bench.child_gcn(args2, 256, 2048)\n"
+        "assert r2['resumed_from_epoch'] is not None, r2\n"
+        "assert r2['resumed_from_epoch'] >= 2, r2\n"
+        "# fresh ROUND: the parent clears the rotation first\n"
+        "bench._clear_gcn_checkpoints('small')\n"
+        "assert not glob.glob(bench._gcn_ck_prefix('small') + '.*.npz')\n"
+        "# the resume evidence rides the progress file into partials\n"
+        "prog = bench._read_probe_progress()\n"
+        "assert bench._progress_resumed_epoch(prog) == "
+        "r2['resumed_from_epoch']\n"
+        "print('RESUME_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, timeout=300,
+                       cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-3000:]
+    assert "RESUME_OK" in r.stdout

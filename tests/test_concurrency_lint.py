@@ -354,6 +354,96 @@ def test_thread_shutdown_attr_joins_are_class_scoped(tmp_path):
     assert got[0].line == 4
 
 
+# ---------------------------------- explicit acquire()/release() pairs
+
+def test_acquire_release_regions_model_held_locks(tmp_path):
+    """ISSUE-13 satellite: explicit ``.acquire()``/``.release()``
+    pairs model held regions exactly like with-blocks — the ordering
+    graph closes cycles through them, blocking calls inside the span
+    flag (including the ``acquire(); try: ... finally: release()``
+    idiom), and statements AFTER the release are free."""
+    _plant(tmp_path, "roc_tpu/acq.py",
+           "import threading\n"
+           "import time\n"
+           "A = threading.Lock()\n"
+           "B = threading.Lock()\n"
+           "def t1():\n"
+           "    A.acquire()\n"
+           "    try:\n"
+           "        time.sleep(1.0)\n"                         # line 8
+           "        with B:\n"
+           "            pass\n"
+           "    finally:\n"
+           "        A.release()\n"
+           "def t2():\n"
+           "    B.acquire()\n"
+           "    with A:\n"                                     # line 15
+           "        pass\n"
+           "    B.release()\n"
+           "def t3():\n"
+           "    A.acquire()\n"
+           "    time.sleep(0.5)\n"                             # line 20
+           "    A.release()\n"
+           "    time.sleep(0.5)\n")                            # line 22
+    got = run_concurrency_lint(str(tmp_path))
+    # A->B through t1's try/finally region, B->A through t2's span
+    cyc = [f for f in got if f.rule == "lock-order-cycle"]
+    assert len(cyc) == 1, [f.msg for f in got]
+    assert "A" in cyc[0].msg and "B" in cyc[0].msg
+    bl_lines = sorted(f.line for f in got
+                      if f.rule == "blocking-under-lock")
+    assert 8 in bl_lines       # sleep inside the try/finally region
+    assert 20 in bl_lines      # sleep inside the plain span
+    assert 22 not in bl_lines  # sleep AFTER the release is free
+
+
+def test_acquire_without_release_holds_to_end(tmp_path):
+    """A missing release is modeled as held-to-end-of-list — exactly
+    what the leaked lock does at runtime."""
+    _plant(tmp_path, "roc_tpu/leak.py",
+           "import threading\n"
+           "import time\n"
+           "A = threading.Lock()\n"
+           "def leaky():\n"
+           "    A.acquire()\n"
+           "    time.sleep(0.5)\n")                            # line 6
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["blocking-under-lock"])
+    assert [f.line for f in got] == [6], [f.msg for f in got]
+
+
+def test_acquire_release_covers_unguarded_shared_state(tmp_path):
+    """A public method reading thread-written state between
+    ``acquire()`` and ``release()`` counts as guarded; the same read
+    outside the span still fires — the Router/Server locking styles
+    are both fully covered."""
+    _plant(tmp_path, "roc_tpu/ug2.py",
+           "import threading\n"
+           "class W:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self.items = []\n"
+           "        self._t = threading.Thread(target=self._run)\n"
+           "        self._t.start()\n"
+           "    def _run(self):\n"
+           "        with self._lock:\n"
+           "            self.items.append(1)\n"
+           "    def good(self):\n"
+           "        self._lock.acquire()\n"
+           "        try:\n"
+           "            return len(self.items)\n"
+           "        finally:\n"
+           "            self._lock.release()\n"
+           "    def bad(self):\n"
+           "        return len(self.items)\n"                  # line 18
+           "    def close(self):\n"
+           "        self._t.join()\n")
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["unguarded-shared-state"])
+    assert [f.line for f in got] == [18], \
+        [(f.line, f.msg) for f in got]
+
+
 # ------------------------------------------------- registration + tree
 
 def test_rules_registered_and_not_trace():

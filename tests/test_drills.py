@@ -253,6 +253,87 @@ def test_drill_nan_grads_p4(tmp_path, ref):
                    ref("p2", ELL + ["--parts", "2"]))
 
 
+def _spawn_dcn_workers(tmp_path, fault=None, timeout=240):
+    """Two REAL OS processes over gloo loopback (the timeline_worker
+    spawn pattern), through the resilience stack; returns the
+    completed Popen objects + outputs."""
+    import socket
+    worker = os.path.join(_REPO, "tests", "dcn_drill_worker.py")
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("ROC_TPU_FAULT", "JAX_COORDINATOR_ADDRESS")}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    argv = lambda i: ([sys.executable, worker, f"localhost:{port}",
+                       "2", str(i), str(tmp_path)]
+                      + ([fault] if fault else []))
+    procs = [subprocess.Popen(argv(i), env=env,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for i in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    while len(outs) < len(procs):
+        outs.append("<killed: peer-death collective wedge>")
+    return procs, outs
+
+
+def test_drill_dcn_two_process_sigkill_recovery(tmp_path, ref):
+    """The drill matrix's REAL multi-process DCN arm (advertised since
+    PR 8): 2 gloo-loopback processes x 2 devices (P=4), a
+    ``sigkill:3:1`` fault killing ONLY process 1 mid-run — the
+    ``site:epoch:proc`` arm finally drilled across real process
+    boundaries.  Re-spawning the pair resumes both processes from the
+    shared rotation's newest checkpoint (process 0 wrote it, both
+    restore) and the run finishes at the uninterrupted P=2 reference
+    loss — recovery parity across a real DCN restart."""
+    procs, outs = _spawn_dcn_workers(tmp_path, fault="sigkill:3:1")
+    assert procs[1].returncode == -signal.SIGKILL, \
+        (procs[1].returncode, outs[1][-2000:])
+    # proc 0 loses its peer mid-collective: anything but success is
+    # acceptable (wedge-killed, gloo error, restartable exit) — the
+    # drill only requires that it did NOT claim completion
+    assert "WORKER_OK" not in outs[0], outs[0][-2000:]
+    # the checkpoint round before the fault landed on shared storage
+    assert (tmp_path / "ck.2.npz").exists(), \
+        sorted(os.listdir(tmp_path))
+    # supervisor restart: identical command, no fault
+    procs2, outs2 = _spawn_dcn_workers(tmp_path)
+    for p, out in zip(procs2, outs2):
+        assert p.returncode == 0, out[-3000:]
+        assert "WORKER_OK" in out
+    # uninterrupted reference: the IDENTICAL P=4 workload in-process
+    # on the 8-virtual-device rig (the worker's exact dataset /
+    # partition / config, minus the fault and the process boundary)
+    from roc_tpu.core.graph import synthetic_dataset
+    from roc_tpu.core.partition import partition_graph
+    from roc_tpu.models.gcn import build_gcn
+    from roc_tpu.parallel import multihost as mh
+    from roc_tpu.parallel.distributed import DistributedTrainer
+    from roc_tpu.train.trainer import TrainConfig
+    ds = synthetic_dataset(32 * 4, 6, in_dim=12, num_classes=3,
+                           seed=0)
+    cfg = TrainConfig(epochs=6, verbose=False, aggr_impl="ell",
+                      symmetric=True, dropout_rate=0.0, eval_every=2)
+    pg = partition_graph(ds.graph, 4, node_multiple=8,
+                         edge_multiple=cfg.chunk)
+    tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
+                            ds, 4, cfg, mesh=mh.make_parts_mesh(4),
+                            pg=pg)
+    tr.train(6)
+    _assert_parity(_final_loss(tmp_path / "m_p0.jsonl"),
+                   float(tr.evaluate()["train_loss"]))
+
+
 def test_drill_elastic_restart_p2_to_p4(tmp_path, ref):
     """Preempted at P=2, restarted at P=4: the checkpointed replicated
     params ride through while the partition (and its quantized plan

@@ -92,6 +92,47 @@ def test_bench_round_extracts_overlap_frac(tmp_path):
     assert check_run(rounds, {"overlap_frac": 0.6})["ok"]
 
 
+def test_serve_availability_checks_bite():
+    """ISSUE-13 satellite: the availability triple gates the serve
+    trajectory — a healthy all-zero shed history still bites on a
+    synthetic shed storm (allow_zero + the absolute floor), and an
+    availability collapse regresses while normal jitter passes."""
+    rounds = [{"path": f"r{i}", "serve_shed_rate": 0.0,
+               "serve_error_rate": 0.0, "serve_availability": 1.0}
+              for i in range(4)]
+    res = check_run(rounds, {"serve_shed_rate": 0.3,
+                             "serve_error_rate": 0.2,
+                             "serve_availability": 0.5})
+    assert set(res["regressed"]) == {"serve_shed_rate",
+                                     "serve_error_rate",
+                                     "serve_availability"}
+    # jitter inside the absolute floor passes on the same history
+    ok = check_run(rounds, {"serve_shed_rate": 0.01,
+                            "serve_error_rate": 0.02,
+                            "serve_availability": 0.97})
+    assert ok["ok"], ok
+
+
+def test_serve_availability_loaded_from_round(tmp_path):
+    """bench.py's headline carries the triple; load_bench_round reads
+    it back like serve_p50_ms."""
+    from roc_tpu.obs.sentinel import load_bench_round
+    doc = {"parsed": {"value": 100.0, "unit": "ms",
+                      "serve_p50_ms": 0.5, "serve_qps": 1000.0,
+                      "serve_shed_rate": 0.0,
+                      "serve_error_rate": 0.01,
+                      "serve_availability": 0.99}}
+    p = tmp_path / "BENCH_r20.json"
+    p.write_text(json.dumps(doc))
+    r = load_bench_round(str(p))
+    assert r["serve_shed_rate"] == 0.0
+    assert r["serve_error_rate"] == 0.01
+    assert r["serve_availability"] == 0.99
+    rounds = [dict(r, path=f"r{i}") for i in range(3)]
+    bad = check_run(rounds, {"serve_availability": 0.4})
+    assert bad["regressed"] == ["serve_availability"]
+
+
 def test_check_run_filters_step_history_by_dtype():
     rounds = [{"path": "a", "step_ms": 7920.0, "compile_s": None,
                "overlap_frac": None, "dtype": "float32"},

@@ -262,6 +262,50 @@ def test_server_multithreaded_submit_stress(sgc_rig):
         srv.submit([0]).result()
 
 
+def test_close_rejects_late_submit_typed_serve_closed(sgc_rig):
+    """ISSUE-13 satellite (rides next to the 8-thread stress test):
+    ``close()`` rejects late ``submit()`` with the TYPED ServeClosed —
+    a subclass of the old RuntimeError contract — and submitters
+    RACING the close always resolve typed or with correct rows, never
+    by hanging on a dispatcher that already exited."""
+    import threading
+    from roc_tpu.serve.errors import ServeClosed
+    from roc_tpu.serve.export import build_predictor
+    from roc_tpu.serve.server import Server
+    ds, tr, _ = sgc_rig
+    pred = build_predictor(_sgc_model(), ds, _config(),
+                           params=tr.params, backend="auto")
+    solo = pred.query(np.arange(20))
+    srv = Server(pred, max_wait_ms=0.5)
+    outcomes: list = []
+
+    def spam(seed):
+        for q in range(40):
+            fut = srv.submit([q % 20])
+            try:
+                rows = fut.result(timeout=30)
+                outcomes.append(("ok", q % 20, rows))
+            except ServeClosed:
+                outcomes.append(("closed", q % 20, None))
+
+    threads = [threading.Thread(target=spam, args=(s,))
+               for s in range(3)]
+    for t in threads:
+        t.start()
+    srv.close()     # races the spammers on purpose
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads)
+    assert len(outcomes) == 3 * 40
+    for kind, i, rows in outcomes:
+        if kind == "ok":
+            assert np.array_equal(rows, solo[[i]])
+    # after close the rejection is deterministic AND typed
+    with pytest.raises(ServeClosed):
+        srv.submit([0]).result()
+    assert srv.stats()["n_rejected_closed"] >= 1
+
+
 def test_server_oversized_and_error_paths(sgc_rig):
     from roc_tpu.serve.export import build_predictor
     from roc_tpu.serve.server import Server
