@@ -3,7 +3,7 @@ plus a rule-driven source lint — regressions against the invariants the
 ROC performance story rests on are caught BEFORE merge, not after a
 chip run.
 
-Six levels, mirroring XLA's own cost_analysis / HLO-verifier split:
+Seven levels, mirroring XLA's own cost_analysis / HLO-verifier split:
 
 - :mod:`ast_lint` — source-level rules over the tree (stdout
   discipline, host syncs in hot paths, jits bypassing the compile
@@ -11,7 +11,8 @@ Six levels, mirroring XLA's own cost_analysis / HLO-verifier split:
 - :mod:`concurrency_lint` — the host-side threading/signal surface
   (lock-order cycles, signal-handler safety, condvar predicates,
   unguarded shared state, blocking under locks, thread shutdown
-  paths), jax-free like the AST level;
+  paths, multi-process artifact-lock ownership), jax-free like the
+  AST level;
 - :mod:`jaxpr_lint` — rules over the ClosedJaxprs of both trainers'
   step functions and the recorded-op model graph (bf16 upcasts,
   host callbacks under jit, large non-donated buffers, cross-shard
@@ -19,7 +20,14 @@ Six levels, mirroring XLA's own cost_analysis / HLO-verifier split:
 - :mod:`hlo_lint` — rules over the optimized HLO text +
   ``cost_analysis`` that ``ObservedJit`` already captures
   (fusion-breaking copies of activation-scale tensors, bytes-accessed
-  vs the core/memory.py model).
+  vs the core/memory.py model);
+- :mod:`programspace` — the enumerated compiled-program set and its
+  shrink-only ``program_budget`` ratchet;
+- :mod:`collective_lint` — SPMD collective choreography at P>=2;
+- :mod:`sharding_lint` — sharding propagation over the candidate
+  jaxprs: the replication ledger vs ``replication_budget``,
+  full-width re-gathers, sharding mismatches, donation under
+  sharding, and the (parts, model) mesh-portability report.
 
 :mod:`driver` assembles the lint units (synthetic dataset, both
 trainers, the 8-virtual-device mesh) and runs every rule;

@@ -9,6 +9,7 @@ Usage:
     python -m roc_tpu.analysis [--strict]          # full run
     python -m roc_tpu.analysis --select stdout-print   # one rule
     python -m roc_tpu.analysis --select concurrency    # level six
+    python -m roc_tpu.analysis --select sharding       # level seven
     python -m roc_tpu.analysis --update-baseline   # shrink ratchet
     python -m roc_tpu.analysis --json              # machine-readable
 
@@ -58,7 +59,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "stage entirely.  'concurrency' expands to "
                         "every level-six concurrency/signal-safety "
                         "rule (jax-free — the scripts/test.sh and "
-                        "round6_chain.sh preflight selection)")
+                        "round6_chain.sh preflight selection); "
+                        "'sharding' expands to every level-seven "
+                        "sharding/replication rule (runs the rig "
+                        "builds + jaxpr walks, no compiles)")
     p.add_argument("--no-trace", action="store_true",
                    help="skip the jaxpr/HLO trace stage (AST only)")
     p.add_argument("--baseline", default=None,
@@ -80,12 +84,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     select = ([s.strip() for s in args.select.split(",") if s.strip()]
               if args.select else None)
     if select:
-        # group alias: 'concurrency' names the whole level-six rule
-        # set, expanded BEFORE the trace gating below so a
-        # concurrency-only preflight never touches (or forces) jax
+        # group aliases: 'concurrency' names the whole level-six rule
+        # set (expanded BEFORE the trace gating below so a
+        # concurrency-only preflight never touches or forces jax);
+        # 'sharding' names the level-seven set the same way
         from .concurrency_lint import CONCURRENCY_RULES
-        select = [r for s in select for r in
-                  (CONCURRENCY_RULES if s == "concurrency" else (s,))]
+        from .sharding_lint import SHARDING_RULES
+        groups = {"concurrency": CONCURRENCY_RULES,
+                  "sharding": SHARDING_RULES}
+        select = [r for s in select
+                  for r in groups.get(s, (s,))]
     trace = not args.no_trace
     from .driver import is_trace_rule
     if trace and (select is None
@@ -99,7 +107,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     from .driver import all_rule_names, analyze
     from .findings import (load_baseline, shrink_baseline,
-                           shrink_program_budget, split_findings)
+                           split_findings)
 
     if args.list_rules:
         for name in all_rule_names():
@@ -117,79 +125,110 @@ def main(argv: Optional[List[str]] = None) -> int:
     baseline_path = args.baseline or os.path.join(
         root, "scripts", "lint_baseline.json")
     extras: dict = {}
-    from .findings import load_program_budget
+    from .findings import load_budget, load_program_budget
     findings = analyze(root, select=select, trace=trace,
                        program_budget=load_program_budget(
                            baseline_path),
+                       replication_budget=load_budget(
+                           baseline_path, "replication_budget"),
                        extras=extras)
     reports = extras.get("programspace", [])
+    sh_reports = extras.get("sharding", [])
     # stale-entry accounting and the shrink ratchet are scoped to the
     # rules that actually ran: an AST-only / --select run must not
     # declare trace-rule baseline entries "no longer firing"
     active = set(select) if select else set(all_rule_names())
     if not trace:
         active = {r for r in active if not is_trace_rule(r)}
-    # program_budget keys get the same stale accounting as finding
-    # fingerprints, scoped to runs where the auditor level ran: a
-    # bound for a config name that no longer EXISTS in the rig set
-    # (renamed/removed — not merely unhosted on this box, whose bound
-    # is deliberately kept) is an orphan that would otherwise disarm
-    # the compile-explosion tripwire silently (the renamed config
-    # restarts at budget=None, which never fires)
-    from .driver import _needs_programspace
+    # the two numeric ratchet TRACKS (findings.BUDGET_SECTIONS) share
+    # one set of semantics — bound over measurement = finding (the
+    # auditor emits it), measurement below bound = slack, missing
+    # bound = tripwire disarmed, bound for a config that no longer
+    # exists = orphan; slack/orphans/unbounded all fail --strict
+    # until --update-baseline commits the shrink — so they are
+    # processed by ONE loop over track descriptors
+    from .driver import _needs_programspace, _needs_sharding
     ps_ran = trace and _needs_programspace(select)
+    sh_ran = trace and _needs_sharding(select)
+    tracks = [
+        {"section": "program_budget", "label": "program budget",
+         "ran": ps_ran, "reports": reports,
+         "measured_key": "programs", "noun": "count",
+         "guards": "the compile-explosion bound no longer guards "
+                   "anything; "},
+        {"section": "replication_budget",
+         "label": "replication budget",
+         "ran": sh_ran, "reports": sh_reports,
+         "measured_key": "replicated_bytes", "noun": "bytes",
+         "guards": ""},
+    ]
     rig_names: set = set()
-    if ps_ran:
+    if any(t["ran"] for t in tracks):
         from .programspace import rig_configs
         rig_names = set(rig_configs())
 
-    def _budget_orphans() -> List[str]:
-        if not ps_ran:
+    def _orphans(track) -> List[str]:
+        # bounds for rig configs that no longer EXIST (renamed or
+        # removed — not merely unhosted on this box, whose bound is
+        # deliberately kept) would otherwise disarm the tripwire
+        # silently: the renamed config restarts at budget=None
+        if not track["ran"]:
             return []
-        return sorted(set(load_program_budget(baseline_path))
+        return sorted(set(load_budget(baseline_path,
+                                      track["section"]))
                       - rig_names)
 
-    orphans = _budget_orphans()
+    for t in tracks:
+        t["orphans"] = _orphans(t)
     baseline = load_baseline(baseline_path)
     new, old, stale = split_findings(findings, baseline,
                                      active_rules=active)
     dropped = 0
     if args.update_baseline:
-        # shrink FIRST (findings AND budget), then re-split against
+        # shrink FIRST (findings AND budgets), then re-split against
         # the updated file: all output below must describe the state
         # this run LEAVES, not the entries it just removed — a CI
         # consumer would otherwise re-flag a ratchet the same
         # invocation already cleared, and a first-ever run would
         # print bounds instructing the user to run the flag they are
         # running
+        from .findings import shrink_budget
         kept = shrink_baseline(baseline_path, findings,
                                active_rules=active)
         dropped = len(baseline) - len(kept)
-        if ps_ran:
-            budget = shrink_program_budget(
-                baseline_path,
-                {r["config"]: r["programs"] for r in reports},
+        for t in tracks:
+            if not t["ran"]:
+                continue
+            budget = shrink_budget(
+                baseline_path, t["section"],
+                {r["config"]: r[t["measured_key"]]
+                 for r in t["reports"]},
                 known=rig_names)
-            for rep in reports:
+            for rep in t["reports"]:
                 b = budget.get(rep["config"])
                 rep["budget"] = b
                 if b is not None:
-                    rep["delta"] = rep["programs"] - b
+                    rep["delta"] = rep[t["measured_key"]] - b
+            t["orphans"] = _orphans(t)
         baseline = load_baseline(baseline_path)
         new, old, stale = split_findings(findings, baseline,
                                          active_rules=active)
-        orphans = _budget_orphans()
     # budget slack — same ratchet semantics as stale findings: a
-    # measured program count BELOW the recorded bound must be
-    # committed via --update-baseline, or a later program-count
-    # regression would hide inside the slack and the compile-wall
-    # tripwire would never fire.  A measured config with NO bound at
-    # all is the limiting case of slack (infinite headroom — the
-    # tripwire is disarmed for it), so under --strict it fails the
-    # same way until --update-baseline initializes the bound.
-    slack = [r for r in reports if r.get("delta") is not None
-             and r["delta"] < 0]
-    unbounded = [r for r in reports if r.get("budget") is None]
+    # measurement BELOW the recorded bound must be committed via
+    # --update-baseline, or a later regression would hide inside the
+    # slack and the tripwire would never fire.  A measured config
+    # with NO bound at all is the limiting case of slack (infinite
+    # headroom — the tripwire is disarmed for it), so under --strict
+    # it fails the same way until --update-baseline initializes.
+    for t in tracks:
+        t["slack"] = [r for r in t["reports"]
+                      if r.get("delta") is not None
+                      and r["delta"] < 0]
+        t["unbounded"] = [r for r in t["reports"]
+                          if r.get("budget") is None]
+    any_ratchet_debt = bool(stale) or any(
+        t["slack"] or t["orphans"] or t["unbounded"] for t in tracks)
+    prog, repl = tracks
 
     if args.json:
         import json as _json
@@ -201,18 +240,23 @@ def main(argv: Optional[List[str]] = None) -> int:
                  "detail": f.detail}
                 for f in new + old],
             "stale": sorted(stale),
-            "budget_stale": orphans,
+            "budget_stale": prog["orphans"],
             "program_space": reports,
+            "sharding": sh_reports,
+            "replication_budget_stale": repl["orphans"],
             "concurrency_surface": extras.get("concurrency"),
             "summary": {"new": len(new), "baselined": len(old),
                         "stale": len(stale),
-                        "budget_slack": len(slack),
-                        "budget_stale": len(orphans),
-                        "budget_unbounded": len(unbounded)},
+                        "budget_slack": len(prog["slack"]),
+                        "budget_stale": len(prog["orphans"]),
+                        "budget_unbounded": len(prog["unbounded"]),
+                        "replication_slack": len(repl["slack"]),
+                        "replication_stale": len(repl["orphans"]),
+                        "replication_unbounded":
+                            len(repl["unbounded"])},
         }
         print(_json.dumps(payload, indent=2))
-        return (1 if new or ((stale or slack or orphans or unbounded)
-                             and args.strict)
+        return (1 if new or (any_ratchet_debt and args.strict)
                 else 0)
 
     for f in new:
@@ -235,6 +279,23 @@ def main(argv: Optional[List[str]] = None) -> int:
         if delta is not None and delta > 0 and sys.stdout.isatty():
             line = f"\x1b[31m{line}\x1b[0m"
         print(line)
+    # the sharding auditor's replication budget — the 2-D-mesh
+    # tripwire: replicated bytes/step on the canonical candidate
+    # mesh, ratcheted exactly like the program counts above
+    for rep in sh_reports:
+        b = rep.get("budget")
+        delta = rep.get("delta")
+        d_txt = ("no baseline — run --update-baseline" if b is None
+                 else f"baseline {b}, delta {delta:+d}")
+        line = (f"replication budget {rep['config']}: "
+                f"{rep['replicated_bytes']} replicated B/step on "
+                f"{rep['canonical_shape'][0]}x"
+                f"{rep['canonical_shape'][1]}, "
+                f"{rep['full_width_sites']} full-width site(s) "
+                f"({d_txt})")
+        if delta is not None and delta > 0 and sys.stdout.isatty():
+            line = f"\x1b[31m{line}\x1b[0m"
+        print(line)
     if args.update_baseline:
         print(f"baseline: kept {len(baseline)}, dropped {dropped} "
               f"stale entr{'y' if dropped == 1 else 'ies'} "
@@ -247,35 +308,37 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"fire(s) — run --update-baseline to ratchet down:")
             for fp in sorted(stale):
                 print(f"  {fp}")
-        if slack:
+        for t in tracks:
             verb = "FAIL" if args.strict else "note"
-            print(f"{verb}: {len(slack)} program budget(s) above the "
-                  f"measured count — run --update-baseline to "
-                  f"ratchet down:")
-            for rep in slack:
-                print(f"  {rep['config']}: {rep['programs']} measured"
-                      f" < {rep['budget']} baselined")
-        if orphans:
-            verb = "FAIL" if args.strict else "note"
-            print(f"{verb}: {len(orphans)} program budget entr"
-                  f"{'y' if len(orphans) == 1 else 'ies'} for "
-                  f"unknown rig config(s) — the compile-explosion "
-                  f"bound no longer guards anything; run "
-                  f"--update-baseline to drop:")
-            for cfg in orphans:
-                print(f"  {cfg}")
-        if unbounded and args.strict:
-            print(f"FAIL: {len(unbounded)} measured config(s) have "
-                  f"no program_budget bound (tripwire disarmed) — "
-                  f"run --update-baseline to initialize:")
-            for rep in unbounded:
-                print(f"  {rep['config']}: {rep['programs']} measured")
+            if t["slack"]:
+                print(f"{verb}: {len(t['slack'])} {t['label']}(s) "
+                      f"above the measured {t['noun']} — run "
+                      f"--update-baseline to ratchet down:")
+                for rep in t["slack"]:
+                    print(f"  {rep['config']}: "
+                          f"{rep[t['measured_key']]} measured < "
+                          f"{rep['budget']} baselined")
+            if t["orphans"]:
+                print(f"{verb}: {len(t['orphans'])} {t['label']} "
+                      f"entr{'y' if len(t['orphans']) == 1 else 'ies'}"
+                      f" for unknown rig config(s) — {t['guards']}run "
+                      f"--update-baseline to drop:")
+                for cfg in t["orphans"]:
+                    print(f"  {cfg}")
+            if t["unbounded"] and args.strict:
+                print(f"FAIL: {len(t['unbounded'])} measured "
+                      f"config(s) have no {t['section']} bound "
+                      f"(tripwire disarmed) — run --update-baseline "
+                      f"to initialize:")
+                for rep in t["unbounded"]:
+                    print(f"  {rep['config']}: "
+                          f"{rep[t['measured_key']]} measured")
 
     print(f"roc-lint: {len(new)} new, {len(old)} baselined, "
           f"{len(stale)} stale")
     if new:
         return 1
-    if (stale or slack or orphans or unbounded) and args.strict:
+    if any_ratchet_debt and args.strict:
         return 1
     return 0
 

@@ -65,6 +65,7 @@ CONCURRENCY_RULES = (
     "unguarded-shared-state",
     "blocking-under-lock",
     "thread-no-shutdown-path",
+    "artifact-lock-ownership",
 )
 
 # threading constructors that create an *acquirable mutual-exclusion*
@@ -1252,6 +1253,256 @@ def _const_target_name(ts: ThreadStart) -> Optional[str]:
     return None
 
 
+# ----------------------------------- rule: artifact lock ownership
+
+# On-disk artifacts shared ACROSS PROCESSES (the multi-process half
+# of this level, ISSUE 14 satellite): the checkpoint-rotation prefix
+# (N training processes, one rotation dir — the DCN drill's shared
+# rotation), the persistent compile-cache dir (prewarm children +
+# bench probes + serve replicas), and the prewarm warm-state JSON.
+# Each has ONE sanctioned ownership protocol:
+#
+# - rotation prefix: the shared-rotation handshake — process 0 writes,
+#   everyone else returns (utils/checkpoint.checkpoint_trainer's
+#   ``jax.process_index() != 0`` gate), or a per-process prefix;
+# - compile cache: jax's cache is multi-writer-safe by design
+#   (content-addressed entries) — surfaced, never flagged;
+# - warm state: atomic tmp + ``os.replace`` publish inside
+#   write_warm_state — surfaced, never flagged.
+#
+# The rule: a rotation WRITE site (``<rotation>.save(...)``,
+# ``checkpoint_trainer(...)``, ``save_checkpoint(...)``) with no
+# process-ownership evidence anywhere on its call chain is a finding
+# — two training processes pruning one rotation prefix unhandshaked
+# corrupt each other's keep-window exactly like two threads on one
+# unguarded list.
+
+_ROTATION_CTOR = "CheckpointRotation"
+_ROTATION_WRITERS = {"checkpoint_trainer", "save_checkpoint"}
+_PER_PROCESS_PATH_MARKERS = ("getpid", "process_index", "pid")
+_GATE_ATTRS = {"process_index", "process_count"}
+
+
+def _refs_process_gate(tm: TreeModel, fd: FuncDef, _depth: int = 0,
+                       _stack: Optional[Set[Tuple[str, str]]] = None
+                       ) -> bool:
+    """True when ``fd`` (or a resolvable callee within depth 4)
+    consults the process identity — the shared-rotation handshake's
+    signature."""
+    if _stack is None:
+        _stack = set()
+    if fd.key in _stack or _depth > 4:
+        return False
+    _stack.add(fd.key)
+    mod = tm.modules[fd.module]
+    try:
+        for node in _walk_own(fd.node):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr in _GATE_ATTRS:
+                return True
+            if isinstance(node, ast.Name) and node.id in _GATE_ATTRS:
+                return True
+            if isinstance(node, ast.Call):
+                callee = tm.resolve_call(mod, node, fd.cls)
+                if callee is not None and _refs_process_gate(
+                        tm, callee, _depth + 1, _stack):
+                    return True
+        return False
+    finally:
+        _stack.discard(fd.key)
+
+
+def _rotation_assigns(nodes: Iterable[ast.AST],
+                      m: Optional[ModuleModel] = None
+                      ) -> Dict[Tuple[str, str], str]:
+    """``('name'|'attr', identifier) -> prefix source`` for every
+    ``X = CheckpointRotation(<prefix>, ...)`` assignment among
+    ``nodes``.  With ``m``, self-attr identifiers are qualified by
+    their enclosing CLASS (``Cls.attr``) — two classes reusing one
+    attribute name must never vouch for each other's prefixes."""
+    out: Dict[Tuple[str, str], str] = {}
+    for node in nodes:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        f = node.value.func
+        ctor = (f.id if isinstance(f, ast.Name)
+                else f.attr if isinstance(f, ast.Attribute) else None)
+        if ctor != _ROTATION_CTOR:
+            continue
+        prefix = ""
+        if node.value.args:
+            try:
+                prefix = ast.unparse(node.value.args[0])
+            except Exception:  # noqa: BLE001 - py<3.9 has no unparse
+                prefix = "?"
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                out[("name", tgt.id)] = prefix
+            elif isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                cls = _enclosing_class(m, node) if m else None
+                out[("attr", f"{cls or ''}.{tgt.attr}")] = prefix
+    return out
+
+
+def _module_rotation_bindings(m: ModuleModel
+                              ) -> Dict[Tuple[str, str], str]:
+    """The module-wide rotation bindings: self-attr bindings
+    (class-scoped keys) plus module-LEVEL name bindings.  Name
+    assignments inside functions are deliberately excluded — their
+    values must never shadow a module-level binding of the same name
+    (a function-local per-process prefix would otherwise vouch for
+    an unrelated module-level shared-prefix writer)."""
+    out = {k: v
+           for k, v in _rotation_assigns(ast.walk(m.tree), m).items()
+           if k[0] == "attr"}
+    out.update(
+        {k: v for k, v in _rotation_assigns(
+            (n for n in ast.iter_child_nodes(m.tree)), m).items()
+         if k[0] == "name"})
+    return out
+
+
+def _rotation_bindings(m: ModuleModel,
+                       fd: Optional[FuncDef] = None,
+                       base: Optional[Dict[Tuple[str, str], str]]
+                       = None) -> Dict[Tuple[str, str], str]:
+    """Rotation bindings visible to ``fd``: name-bindings are
+    FUNCTION-scoped (two functions reusing ``rot`` must not vouch
+    for each other's prefixes — the per-process exemption of one
+    must never leak onto the other), self-attr bindings are
+    class-scoped, module-level names module-wide.  ``base`` lets a
+    caller hoist :func:`_module_rotation_bindings` out of a per-
+    function loop."""
+    out = dict(base if base is not None
+               else _module_rotation_bindings(m))
+    if fd is not None:
+        out.update(_rotation_assigns(
+            (n for n in _walk_own(fd.node)
+             if isinstance(n, ast.Assign)), m))
+    return out
+
+
+def _rotation_save_gated(tm: TreeModel) -> bool:
+    """Whether the tree's own ``CheckpointRotation.save`` carries the
+    handshake (transitively) — then every ``<rotation>.save(...)``
+    call site inherits the evidence.  False when the class is not in
+    the tree (fixture trees importing it from elsewhere must carry
+    their own gate)."""
+    for fd in tm.methods_by_name.get("save", []):
+        if fd.cls == _ROTATION_CTOR and _refs_process_gate(tm, fd):
+            return True
+    return False
+
+
+def check_artifact_lock_ownership(tm: TreeModel) -> List[Finding]:
+    """[artifact-lock-ownership] see the section comment above.
+    Ownership evidence, any one of which clears a write site: the
+    process-identity gate on the enclosing function or anywhere down
+    the written-through call chain; a per-process prefix
+    (pid/process_index in the path expression); or the standard
+    pragma documenting why single-writer is guaranteed."""
+    findings: List[Finding] = []
+    rot_gated = _rotation_save_gated(tm)
+    for m in tm.modules.values():
+        base = _module_rotation_bindings(m)
+        for fd in set(m.funcs.values()):
+            bindings = _rotation_bindings(m, fd, base=base)
+            for node in _walk_own(fd.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                label = prefix = None
+                chain_gated = False
+                if isinstance(f, ast.Attribute) and f.attr == "save":
+                    recv = f.value
+                    key = None
+                    if isinstance(recv, ast.Name):
+                        key = ("name", recv.id)
+                        # convention fallback: a parameter named
+                        # rotation* IS a CheckpointRotation (the
+                        # train_with_recovery shape)
+                        if key not in bindings \
+                                and not recv.id.startswith("rotation"):
+                            key = None
+                    elif isinstance(recv, ast.Attribute) \
+                            and isinstance(recv.value, ast.Name) \
+                            and recv.value.id == "self":
+                        key = ("attr", f"{fd.cls or ''}.{recv.attr}")
+                        if key not in bindings:
+                            key = None
+                    if key is None:
+                        continue
+                    label = f"{key[1]}.save()"
+                    prefix = bindings.get(key, "")
+                    chain_gated = rot_gated
+                elif isinstance(f, ast.Name) \
+                        and f.id in _ROTATION_WRITERS:
+                    label = f"{f.id}()"
+                    callee = tm.resolve_call(m, node, fd.cls)
+                    chain_gated = (callee is not None
+                                   and _refs_process_gate(tm, callee))
+                else:
+                    continue
+                if chain_gated or _refs_process_gate(tm, fd):
+                    continue
+                if prefix and any(mk in prefix for mk in
+                                  _PER_PROCESS_PATH_MARKERS):
+                    continue
+                findings.append(Finding(
+                    "artifact-lock-ownership", m.rel,
+                    f"{label} in {fd.qualname} writes a checkpoint-"
+                    f"rotation prefix"
+                    + (f" ({prefix})" if prefix else "")
+                    + " with no process-ownership evidence: under "
+                      "multi-process SPMD every process would write "
+                      "and prune the same rotation — gate on "
+                      "jax.process_index() (the shared-rotation "
+                      "handshake) or use a per-process prefix",
+                    line=node.lineno,
+                    key=f"writer|{fd.qualname}|{label}"))
+    return findings
+
+
+def artifact_surface(tm: TreeModel) -> List[Dict[str, Any]]:
+    """Per-module artifact-lock inventory for the surface table:
+    which process-shared on-disk artifacts each module touches
+    (rotation prefixes with their ownership evidence, compile-cache
+    enables, warm-state publishes)."""
+    rot_gated = _rotation_save_gated(tm)
+    out: List[Dict[str, Any]] = []
+    for rel in sorted(tm.modules):
+        m = tm.modules[rel]
+        arts: List[Dict[str, Any]] = []
+        for (kind, name), prefix in sorted(
+                _rotation_assigns(ast.walk(m.tree), m).items()):
+            arts.append({"kind": "rotation",
+                         "name": name.lstrip("."),
+                         "path": prefix,
+                         "owner": ("proc0-gate" if rot_gated
+                                   else "unknown")})
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            callee = (f.id if isinstance(f, ast.Name)
+                      else f.attr if isinstance(f, ast.Attribute)
+                      else None)
+            if callee == "enable_compile_cache":
+                arts.append({"kind": "compile-cache",
+                             "line": node.lineno,
+                             "owner": "multi-writer-safe"})
+            elif callee == "write_warm_state":
+                arts.append({"kind": "warm-state",
+                             "line": node.lineno,
+                             "owner": "atomic-replace"})
+        if arts:
+            out.append({"module": rel, "artifacts": arts})
+    return out
+
+
 # ------------------------------------------------- surface + entrypoint
 
 def concurrency_surface(tm: TreeModel) -> Dict[str, Any]:
@@ -1291,13 +1542,17 @@ def concurrency_surface(tm: TreeModel) -> Dict[str, Any]:
                              "line": reg.node.lineno})
         mods.append({"module": rel, "threads": threads,
                      "locks": locks, "handlers": handlers})
+    artifacts = artifact_surface(tm)
     return {
         "modules": mods,
+        "artifacts": artifacts,
         "totals": {
             "modules": len(mods),
             "threads": sum(len(x["threads"]) for x in mods),
             "locks": sum(len(x["locks"]) for x in mods),
-            "handlers": sum(len(x["handlers"]) for x in mods)}}
+            "handlers": sum(len(x["handlers"]) for x in mods),
+            "artifacts": sum(len(x["artifacts"])
+                             for x in artifacts)}}
 
 
 _CHECKS = {
@@ -1307,6 +1562,7 @@ _CHECKS = {
     "unguarded-shared-state": check_unguarded_shared_state,
     "blocking-under-lock": check_blocking_under_lock,
     "thread-no-shutdown-path": check_thread_shutdown,
+    "artifact-lock-ownership": check_artifact_lock_ownership,
 }
 
 

@@ -28,6 +28,7 @@ from .hlo_lint import check_bytes_model, check_large_copy
 from .jaxpr_lint import JAXPR_RULES, JaxprUnit, run_jaxpr_lint
 from .programspace import (_C, _DEG, _F, _H, _V, PROGRAMSPACE_RULES,
                            audit_program_space)
+from .sharding_lint import SHARDING_RULES, audit_sharding
 
 HLO_RULES = ("hlo-large-copy", "hlo-bytes-model")
 
@@ -44,12 +45,13 @@ IMBALANCE_THRESHOLD = 1.5
 
 def is_trace_rule(name: str) -> bool:
     """True for rules that need the jax trace/build stage (jaxpr-*,
-    hlo-*, collective-*, the program-space auditor, and the
-    built-trainer checks) — shared by the driver's stage gating and
-    the CLI's stale-entry scoping."""
+    hlo-*, collective-*, the program-space and sharding auditors, and
+    the built-trainer checks) — shared by the driver's stage gating
+    and the CLI's stale-entry scoping."""
     return (name.startswith(("jaxpr-", "hlo-", "collective-"))
             or name in EXTRA_TRACE_RULES
-            or name in PROGRAMSPACE_RULES)
+            or name in PROGRAMSPACE_RULES
+            or name in SHARDING_RULES)
 
 
 def check_partition_imbalance(unit: str, real_edges,
@@ -98,16 +100,19 @@ def all_rule_names() -> List[str]:
     return ([r.name for r in AST_RULES] + list(CONCURRENCY_RULES)
             + list(JAXPR_RULES)
             + list(HLO_RULES) + list(EXTRA_TRACE_RULES)
-            + list(COLLECTIVE_RULES) + list(PROGRAMSPACE_RULES))
+            + list(COLLECTIVE_RULES) + list(PROGRAMSPACE_RULES)
+            + list(SHARDING_RULES))
 
 
 def _needs_trace(select: Optional[List[str]]) -> bool:
     """True when the jaxpr/HLO/collective trainer-build stage must
-    run.  Program-space rules have their own rig builds
-    (audit_program_space) and alone don't need this stage."""
+    run.  Program-space and sharding rules have their own rig builds
+    (audit_program_space / audit_sharding) and alone don't need this
+    stage."""
     if select is None:
         return True
     return any(is_trace_rule(s) and s not in PROGRAMSPACE_RULES
+               and s not in SHARDING_RULES
                for s in select)
 
 
@@ -268,21 +273,34 @@ def _needs_programspace(select: Optional[List[str]]) -> bool:
     return any(s in PROGRAMSPACE_RULES for s in select)
 
 
+def _needs_sharding(select: Optional[List[str]]) -> bool:
+    if select is None:
+        return True
+    return any(s in SHARDING_RULES for s in select)
+
+
 def analyze(root: str, select: Optional[List[str]] = None,
             trace: bool = True,
             program_budget: Optional[Dict[str, int]] = None,
+            replication_budget: Optional[Dict[str, int]] = None,
             extras: Optional[Dict[str, Any]] = None) -> List[Finding]:
     """AST lint over ``root`` plus (when ``trace`` and a trace rule is
     selected) the jaxpr/HLO/collective stage and the program-space
-    auditor.  Every finding is also emitted as an
+    and sharding auditors.  Every finding is also emitted as an
     ``analysis``-category event.
 
-    ``program_budget`` is the per-rig-config program-count bound for
-    the compile-explosion rule; None loads it from ``root``'s
-    ``scripts/lint_baseline.json`` (``program_budget`` key).
-    ``extras``, when a dict, receives the auditor's compile-budget
-    reports under ``'programspace'``."""
+    ``program_budget`` / ``replication_budget`` are the ratcheted
+    per-rig-config bounds for the compile-explosion and
+    replication-budget rules; None loads them from ``root``'s
+    ``scripts/lint_baseline.json``.  ``extras``, when a dict,
+    receives the auditors' reports under ``'programspace'`` /
+    ``'sharding'``."""
     t0 = time.perf_counter()
+    baseline_path = None
+    if program_budget is None or replication_budget is None:
+        import os
+        baseline_path = os.path.join(root, "scripts",
+                                     "lint_baseline.json")
     findings = run_ast_lint(root, select=select)
     # level six: the concurrency/signal-safety auditor — pure AST
     # (no jax, no trace stage), so it runs under every selection that
@@ -294,13 +312,20 @@ def analyze(root: str, select: Optional[List[str]] = None,
         findings.extend(build_trace_findings(select=select))
     if trace and _needs_programspace(select):
         if program_budget is None:
-            import os
-
             from .findings import load_program_budget
-            program_budget = load_program_budget(os.path.join(
-                root, "scripts", "lint_baseline.json"))
+            program_budget = load_program_budget(baseline_path)
         findings.extend(audit_program_space(
             select=select, program_budget=program_budget,
+            extras=extras))
+    # level seven: the sharding & replication auditor — its own rig
+    # builds (no compiles), like the program-space level
+    if trace and _needs_sharding(select):
+        if replication_budget is None:
+            from .findings import load_budget
+            replication_budget = load_budget(baseline_path,
+                                             "replication_budget")
+        findings.extend(audit_sharding(
+            select=select, replication_budget=replication_budget,
             extras=extras))
     findings = dedupe(findings)
     for f in findings:

@@ -68,53 +68,81 @@ def load_baseline(path: str) -> Set[str]:
     return set(_load_raw(path).get("findings", []))
 
 
+# ratcheted numeric budget sections of the baseline file.  Each is a
+# {config-name: integer bound} map with identical shrink-only
+# semantics: a bound can initialize (absent key) and shrink via
+# --update-baseline, never grow — growing means fixing the regression
+# or hand-editing the JSON (the same deliberate escape hatch as the
+# findings list).  ``program_budget`` ratchets the compile-explosion
+# program counts (PR 6); ``replication_budget`` ratchets the sharding
+# auditor's replicated-bytes-per-step ledger totals (level seven).
+BUDGET_SECTIONS = ("program_budget", "replication_budget")
+
+
+def load_budget(path: str, section: str) -> Dict[str, int]:
+    """One ratcheted budget section (``BUDGET_SECTIONS``) from the
+    baseline file; missing file/key = no bounds recorded yet."""
+    return {str(k): int(v) for k, v in
+            _load_raw(path).get(section, {}).items()}
+
+
 def load_program_budget(path: str) -> Dict[str, int]:
     """Per-rig-config program-count bounds (the compile-explosion
     ratchet) from the same baseline file; missing file/key = no
     bounds recorded yet."""
-    return {str(k): int(v) for k, v in
-            _load_raw(path).get("program_budget", {}).items()}
+    return load_budget(path, "program_budget")
 
 
 def save_baseline(path: str, fingerprints: Iterable[str],
-                  program_budget: Optional[Dict[str, int]] = None
+                  program_budget: Optional[Dict[str, int]] = None,
+                  budgets: Optional[Dict[str, Dict[str, int]]] = None
                   ) -> None:
-    """Write the baseline.  ``program_budget=None`` preserves the
-    file's existing budget section untouched — the finding ratchet and
-    the program-count ratchet shrink independently."""
-    if program_budget is None:
-        program_budget = load_program_budget(path)
+    """Write the baseline.  Budget sections not passed are preserved
+    from the file untouched — the finding ratchet and each numeric
+    ratchet shrink independently.  ``program_budget`` is the legacy
+    spelling of ``budgets={'program_budget': ...}``."""
+    sections = dict(budgets or {})
+    if program_budget is not None:
+        sections["program_budget"] = program_budget
+    for name in BUDGET_SECTIONS:
+        if name not in sections:
+            sections[name] = load_budget(path, name)
     data: Dict[str, Any] = {"version": 1,
                             "findings": sorted(set(fingerprints))}
-    if program_budget:
-        data["program_budget"] = {k: int(program_budget[k])
-                                  for k in sorted(program_budget)}
+    for name in BUDGET_SECTIONS:
+        if sections.get(name):
+            data[name] = {k: int(sections[name][k])
+                          for k in sorted(sections[name])}
     with open(path, "w") as f:
         json.dump(data, f, indent=2)
         f.write("\n")
 
 
-def shrink_program_budget(path: str, counts: Dict[str, int],
-                          known: Optional[Set[str]] = None
-                          ) -> Dict[str, int]:
-    """Ratchet-only budget update: for every config the auditor
+def shrink_budget(path: str, section: str, counts: Dict[str, int],
+                  known: Optional[Set[str]] = None) -> Dict[str, int]:
+    """Ratchet-only budget update for one section: for every config
     MEASURED this run, record ``min(stored, measured)`` — a bound can
-    initialize (absent key) and shrink, never grow; growing past the
-    bound means fixing the program explosion or hand-editing the JSON
-    (the same deliberate escape hatch as the findings list).  Configs
-    not measured (e.g. a single-device box skipping the P=2 rig) keep
+    initialize (absent key) and shrink, never grow.  Configs not
+    measured (e.g. a single-device box skipping the P=2 rig) keep
     their stored bounds.  ``known``, when given, is the full rig
     config-name set: bounds for configs that no longer EXIST (renamed
     or removed rigs — not merely unhosted on this box) are dropped,
     the budget analogue of a stale finding fingerprint.  Returns the
     budget written."""
-    budget = load_program_budget(path)
+    budget = load_budget(path, section)
     if known is not None:
         budget = {k: v for k, v in budget.items() if k in known}
     for cfg, n in counts.items():
         budget[cfg] = min(budget.get(cfg, int(n)), int(n))
-    save_baseline(path, load_baseline(path), program_budget=budget)
+    save_baseline(path, load_baseline(path), budgets={section: budget})
     return budget
+
+
+def shrink_program_budget(path: str, counts: Dict[str, int],
+                          known: Optional[Set[str]] = None
+                          ) -> Dict[str, int]:
+    """:func:`shrink_budget` over the compile-explosion section."""
+    return shrink_budget(path, "program_budget", counts, known=known)
 
 
 def _rule_of(fingerprint: str) -> str:

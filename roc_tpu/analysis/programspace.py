@@ -295,6 +295,12 @@ class Candidate:
     donate: Tuple[int, ...] = ()
     observed: bool = True
     aot: Optional[Callable[[], Any]] = None
+    # per-top-level-arg semantic labels for the sharding auditor's
+    # ledger/seeding ("params" / "opt_state" / "data" / "tables" /
+    # "other"); () = classify by shape alone.  One enumeration, three
+    # consumers (program keys, prewarm, replication ledger) — the
+    # roles live on the record so they can never drift from the args.
+    roles: Tuple[str, ...] = ()
 
 
 def candidate_programs(tr) -> List["Candidate"]:
@@ -314,10 +320,10 @@ def candidate_programs(tr) -> List["Candidate"]:
     if hasattr(tr, "serve_candidates"):          # serve Predictor
         return list(tr.serve_candidates())
 
-    def add(slot, jitfn, args, donate=(), observed=True):
+    def add(slot, jitfn, args, donate=(), observed=True, roles=()):
         cands.append(Candidate(
             slot=slot, fn=jitfn, args=args, donate=donate,
-            observed=observed,
+            observed=observed, roles=roles,
             aot=lambda j=jitfn, a=args: j.lower(*a).compile()))
 
     if getattr(tr, "pg", None) is not None:       # distributed
@@ -326,17 +332,24 @@ def candidate_programs(tr) -> List["Candidate"]:
         graph_args = (d.edge_src, d.edge_dst, d.in_degree, d.ell_idx,
                       d.ell_row_pos, d.ell_row_id, d.ring_idx,
                       d.sect_idx, d.sect_sub_dst, d.bd_tabs, fuse)
+        graph_roles = ("tables",) * len(graph_args)
         add("dist_train_step", tr._train_step._jit,
             (tr.params, tr.opt_state, d.feats, d.labels, d.mask)
-            + graph_args + (tr.key, lr), donate=(0, 1))
+            + graph_args + (tr.key, lr), donate=(0, 1),
+            roles=("params", "opt_state", "data", "data", "data")
+            + graph_roles + ("other", "other"))
         add("dist_eval_step", tr._eval_step._jit,
-            (tr.params, d.feats, d.labels, d.mask) + graph_args)
+            (tr.params, d.feats, d.labels, d.mask) + graph_args,
+            roles=("params", "data", "data", "data") + graph_roles)
     elif tr._head is None:                        # plain single-device
         add("train_step", tr._train_step._jit,
             (tr.params, tr.opt_state, tr.key, lr, tr.feats,
-             tr.labels, tr.mask, tr.gctx), donate=(0, 1))
+             tr.labels, tr.mask, tr.gctx), donate=(0, 1),
+            roles=("params", "opt_state", "other", "other", "data",
+                   "data", "data", "tables"))
         add("eval_step", tr._eval_step._jit,
-            (tr.params, tr.feats, tr.labels, tr.mask, tr.gctx))
+            (tr.params, tr.feats, tr.labels, tr.mask, tr.gctx),
+            roles=("params", "data", "data", "data", "tables"))
     else:                                         # streamed head
         # abstract stand-ins, never materialized: [V, H] at the >HBM
         # tier is multi-GB, and warm_trainer runs this on LIVE bench
@@ -354,12 +367,16 @@ def candidate_programs(tr) -> List["Candidate"]:
             tr.params)
         add("tail_grad", tr._tail_grad._jit,
             (tr.params, y, tr.key, tr.labels, tr.mask, tr.gctx),
-            donate=(1,))
+            donate=(1,),
+            roles=("params", "data", "other", "data", "data",
+                   "tables"))
         add("tail_eval", tr._tail_eval._jit,
-            (tr.params, y, tr.labels, tr.mask, tr.gctx))
+            (tr.params, y, tr.labels, tr.mask, tr.gctx),
+            roles=("params", "data", "data", "data", "tables"))
         add("apply_update", tr._apply_update._jit,
             (tr.params, tr.opt_state, grads, lr),
-            donate=(0, 1, 2))
+            donate=(0, 1, 2),
+            roles=("params", "opt_state", "data", "other"))
         cands.extend(_head_block_candidates(tr, y))
     return cands
 
@@ -429,6 +446,7 @@ def _head_block_candidates(tr, y) -> List["Candidate"]:
                 fn=(lambda xx, ww, kk, u=use_mask: _head_fwd_block(
                     xx, ww, rate, kk, u)),
                 args=(x, w0, key), observed=False,
+                roles=("data", "params", "other"),
                 aot=(lambda xx=x, kk=key, u=use_mask:
                      _head_fwd_block.lower(
                          xx, w0, rate, kk, u).compile())))
@@ -437,6 +455,7 @@ def _head_block_candidates(tr, y) -> List["Candidate"]:
             fn=(lambda dw, xx, dy, kk, r=rows: _head_wgrad_block(
                 dw, xx, dy, 0, r, rate, kk, True)),
             args=(dW, x, y, tr.key), observed=False,
+            roles=("params", "data", "data", "other"),
             aot=(lambda xx=x, r=rows: _head_wgrad_block.lower(
                 dW, xx, y, 0, r, rate, tr.key, True).compile())))
     return cands

@@ -166,6 +166,97 @@ def estimate_plan_bytes(num_nodes: int, num_edges: int,
     return total
 
 
+def per_axis_plan_bytes(num_nodes: int, num_edges: int,
+                        layer_dims: Sequence[int], parts: int = 1,
+                        model: int = 1, dtype_bytes: int = 4,
+                        halo: str = "gather", features: str = "hbm",
+                        remat: bool = False,
+                        remat_policy: str = "save_aggregates",
+                        ring_padding: float = 1.7
+                        ) -> Dict[str, Dict[str, int]]:
+    """Per-component, per-mesh-axis byte attribution of one train
+    step on an abstract ``(parts, model)`` mesh — the planner-side
+    half of the sharding auditor's replication ledger
+    (analysis/sharding_lint.py) and the "modeled per-device HBM"
+    column of the mesh-portability report.
+
+    Same coarse accounting as :func:`estimate_plan_bytes` (whose
+    ``parts``-only totals this reproduces at ``model=1``), but each
+    component reports WHICH axes divide it: params/opt-state and
+    activations split over ``model`` on their feature axis (the 2-D
+    design's pjit'd dense ops), vertex-scale tensors split over
+    ``parts``, edge/halo index tables split over ``parts`` only —
+    they carry no feature axis, so the model axis REPLICATES them,
+    and the ledger must say so rather than divide by the whole mesh.
+
+    Returns ``{component: {"bytes": total, "parts_div": p,
+    "model_div": m, "per_device": total // (p*m)}}`` plus a
+    ``"total"`` row; ``replicated`` in a component marks the axes
+    (divisor 1 while the mesh axis is >1) it is replicated over."""
+    V_p = -(-num_nodes // max(parts, 1))
+    E_p = -(-num_edges // max(parts, 1))
+    b = dtype_bytes
+    F = layer_dims[0]
+    hiddens = list(layer_dims[1:])
+    h_max = max(hiddens + [F])
+    w = sum(layer_dims[i] * layer_dims[i + 1]
+            for i in range(len(layer_dims) - 1))
+
+    def comp(total: int, parts_div: int, model_div: int
+             ) -> Dict[str, int]:
+        per_dev = int(total) // max(parts_div * model_div, 1)
+        rep = []
+        if parts > 1 and parts_div == 1:
+            rep.append("parts")
+        if model > 1 and model_div == 1:
+            rep.append("model")
+        return {"bytes": int(total), "parts_div": parts_div,
+                "model_div": model_div, "per_device": per_dev,
+                "replicated": rep}
+
+    out: Dict[str, Dict[str, int]] = {}
+    # params + Adam m/v: feature-axis (model) sharded on the 2-D
+    # mesh, replicated over parts either way (the reference reads
+    # weights whole in every task)
+    out["params"] = comp(w * b, 1, model)
+    out["opt_state"] = comp(2 * w * b, 1, model)
+    if features == "hbm":
+        out["features"] = comp(num_nodes * F * b, parts, model)
+    else:
+        out["features"] = comp(65536 * F * b * parts, parts, model)
+    # edge/halo index tables: int32 per edge + row positions — no
+    # feature axis, so the model axis replicates them
+    tab = E_p * 4 * parts + V_p * 4 * parts
+    if halo == "ring":
+        tab += int(2 * E_p * 4 * ring_padding) * parts
+    out["tables"] = comp(tab, parts, 1)
+    if remat:
+        act = (_ACT_FACTOR_REMAT_FULL if remat_policy == "full"
+               else _ACT_FACTOR_REMAT_SAVE_AGG)
+    else:
+        act = _ACT_FACTOR_SAVED
+    act_bytes = sum(num_nodes * h * b * act for h in hiddens)
+    if features == "hbm":
+        act_bytes += num_nodes * F * b * (1 if remat else 2)
+    out["activations"] = comp(act_bytes, parts, model)
+    # halo transient: the gathered whole-region matrix is per-device
+    # [P * V_p, h] — replicated over parts BY DESIGN (that is what a
+    # gather is), feature-sharded over model; the ring keeps two
+    # block buffers instead
+    if halo == "gather":
+        out["halo"] = comp(parts * V_p * h_max * b * parts, parts,
+                           model)
+    else:
+        out["halo"] = comp(2 * V_p * h_max * b * parts, parts, model)
+    total = sum(c["bytes"] for c in out.values())
+    per_dev = sum(c["per_device"] for c in out.values())
+    out["total"] = {"bytes": int(total), "per_device": int(per_dev),
+                    "replicated": sorted({a for c in out.values()
+                                          for a in c.get("replicated",
+                                                         [])})}
+    return out
+
+
 def choose_memory_plan(num_nodes: int, num_edges: int,
                        layer_dims: Sequence[int], num_parts: int = 1,
                        dtype_bytes: int = 4,

@@ -61,10 +61,14 @@ from typing import Any, Dict, List, Optional
 #             export/prewarm reports, server open/close summaries
 #             (query/batch counts, latency percentiles), propagation-
 #             table invalidations
+#   sharding  replication-ledger / mesh-portability reports from the
+#             sharding auditor (analysis/sharding_lint.py): per-rig
+#             replicated bytes vs the ratcheted budget, full-width
+#             sites, modeled per-device HBM per (parts, model) shape
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
               "costmodel", "programspace", "resilience", "timeline",
-              "serve")
+              "serve", "sharding")
 
 
 # ---------------------------------------------------------- clock tuple

@@ -15,3 +15,30 @@ every submodule (and ``models/builder.py``) needs without a cycle.
 # ring.py / multihost.py / models/builder.py can all import it
 # cycle-free; distributed.py re-exports it).
 PARTS_AXIS = "parts"
+
+# THE name of the feature/model mesh axis of the planned
+# ``(parts, model)`` 2-D mesh (ROADMAP: vertex shards x feature
+# shards).  No trainer builds a 2-D mesh yet — the name exists so the
+# sharding auditor (analysis/sharding_lint.py), the memory model's
+# per-axis attribution (core/memory.py), and the eventual pjit'd
+# dense ops all agree on ONE spelling before the refactor lands,
+# exactly like PARTS_AXIS predating multihost.
+MODEL_AXIS = "model"
+
+
+def candidate_mesh_shapes(num_devices: int = 8):
+    """The ``(parts, model)`` shapes the mesh-portability audit
+    models on a ``num_devices``-wide rig: every factorization with
+    both factors >= 1, parts-major (1x8, 2x4, 4x2, 8x1 on the
+    8-virtual-device CPU rig; the degenerate all-parts shape is
+    today's 1-D mesh and anchors the comparison).  Pure arithmetic —
+    importable without jax."""
+    return [(p, num_devices // p) for p in range(1, num_devices + 1)
+            if num_devices % p == 0]
+
+
+def mesh_axes(shape) -> dict:
+    """``{axis-name: size}`` for a ``(parts, model)`` shape tuple —
+    the one place the positional shape meets the axis names."""
+    parts, model = shape
+    return {PARTS_AXIS: int(parts), MODEL_AXIS: int(model)}

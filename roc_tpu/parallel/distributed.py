@@ -1178,11 +1178,19 @@ class DistributedTrainer:
         non-addressable shards there) — rigs and tests never compile
         it.
 
-        ``node_ids`` fetches only a ``[len(ids), C]`` row subset: the
-        ids map to padded shard coordinates host-side and gather on
-        device, so the full sharded logits never cross device→host
-        (the serve tier's gather path; under multi-process SPMD the
-        gather runs on the replicated copy for addressability)."""
+        ``node_ids`` fetches only a row subset: the ids map to padded
+        shard coordinates host-side and the rows are read PER SHARD
+        from the addressable shard buffers — no device-side gather.
+        The previous form dispatched ``jnp.take`` on the
+        P('parts')-sharded logits, which made GSPMD all-gather the
+        full [V, C] logits onto EVERY device before taking n rows —
+        the dist-eval-gather full-width-materialization site the
+        sharding auditor (analysis/sharding_lint.py) exists to
+        catch; now only the shards holding requested rows cross
+        device→host, O(V_p) each, and the request path adds no
+        collective and no compiled program.  Under multi-process
+        SPMD the rows are read from the replicated copy instead
+        (non-addressable shards)."""
         _, logits = self._run_eval_step()
         if jax.process_count() > 1:
             if self._predict_gather is None:
@@ -1193,13 +1201,50 @@ class DistributedTrainer:
                     verbose=self.config.verbose)
             logits = self._predict_gather(logits)
         if node_ids is not None:
-            rows = jnp.asarray(self._padded_rows_of(node_ids))
-            flat = logits.reshape(self.pg.padded_num_nodes, -1)
-            return np.asarray(jax.device_get(
-                jnp.take(flat, rows, axis=0)))
+            rows = self._padded_rows_of(node_ids)
+            if jax.process_count() == 1:
+                picked = self._rows_from_shards(logits, rows)
+                if picked is not None:
+                    return picked
+            flat = np.asarray(jax.device_get(logits)).reshape(
+                self.pg.padded_num_nodes, -1)
+            return flat[rows]
         arr = np.asarray(jax.device_get(logits))
         arr = arr.reshape(self.pg.num_parts, self.pg.part_nodes, -1)
         return unpad_nodes(arr, self.pg)
+
+    def _rows_from_shards(self, logits,
+                          rows: np.ndarray) -> Optional[np.ndarray]:
+        """Row subset of the P('parts')-sharded padded logits read
+        per-shard: only shards that hold a requested row are fetched
+        (O(V_p * C) device→host each), and nothing materializes on
+        device.  None when the shard layout is not the expected 1-D
+        padded-part split (caller falls back to a whole-array
+        device_get — still collective-free)."""
+        pn = self.pg.part_nodes
+        C = int(logits.shape[-1])
+        rows = np.asarray(rows, dtype=np.int64)
+        want = set((rows // pn).tolist())
+        hosts: Dict[int, np.ndarray] = {}
+        try:
+            for sh in logits.addressable_shards:
+                idx = sh.index[0]
+                start = idx.start or 0
+                data = np.asarray(sh.data).reshape(-1, C)
+                if data.shape[0] != pn or start % pn:
+                    return None
+                part = start // pn
+                if part in want:
+                    hosts[part] = data
+        except (AttributeError, TypeError, IndexError):
+            return None
+        if not want.issubset(hosts):
+            return None
+        out = np.empty((rows.size, C), dtype=logits.dtype)
+        for p in want:
+            sel = (rows // pn) == p
+            out[sel] = hosts[p][rows[sel] % pn]
+        return out
 
     def _build_predict_gather(self):
         mesh = self.mesh

@@ -324,6 +324,16 @@ def summarize(events: List[Dict[str, Any]],
           ["module", "threads", "sync objects", "signal handlers"],
           rows, out)
 
+    # sharding: the level-seven auditor's replication ledger +
+    # mesh-portability report (cat=sharding events, or the
+    # --sharding payload below via summarize_sharding)
+    sh = [e for e in events if e.get("cat") == "sharding"
+          and "replicated_bytes" in e]
+    if sh:
+        summarize_sharding(
+            [{**e, "ledger": e.get("ledger") or []} for e in sh],
+            out)
+
     stalls = [e for e in events if e.get("cat") == "stall"]
     by_stage: Dict[str, List[float]] = {}
     for e in stalls:
@@ -333,6 +343,74 @@ def summarize(events: List[Dict[str, Any]],
             for st, vs in by_stage.items()]
     _rows("stalls (heartbeats)", ["stage", "beats", "max_wait"],
           rows, out)
+    return 0
+
+
+def summarize_sharding(reports: List[Dict[str, Any]],
+                       out=None) -> int:
+    """Render the sharding auditor's per-rig records: the
+    replication-budget line, the mesh-portability per-device HBM at
+    every (parts, model) shape, every full-width-materialization
+    site with its modeled per-device bytes, and the top of the
+    replication ledger.  Input: the ``sharding`` list of
+    ``python -m roc_tpu.analysis --select sharding --json`` (or the
+    equivalent ``sharding`` event records)."""
+    out = out if out is not None else sys.stdout
+    for rep in reports:
+        cfg = rep.get("config", "?")
+        b = rep.get("budget")
+        d = rep.get("delta")
+        shape = rep.get("canonical_shape") or ["?", "?"]
+        print(f"\n== sharding {cfg} (parts={rep.get('parts')}) ==",
+              file=out)
+        print(f"  replicated/step on {shape[0]}x{shape[1]}: "
+              f"{_fmt_bytes(rep.get('replicated_bytes'))}  "
+              f"(budget "
+              + ("unset — run --update-baseline" if b is None
+                 else f"{_fmt_bytes(b)}, delta {d:+d} B") + ")",
+              file=out)
+        rows = []
+        for m in rep.get("mesh_shapes") or []:
+            reps_ = sorted({a for c in (m.get("components")
+                                        or {}).values()
+                            for a in c.get("replicated", [])})
+            rows.append([f"{m.get('parts')}x{m.get('model')}",
+                         _fmt_bytes(m.get("per_device_bytes")),
+                         ",".join(reps_) or "-"])
+        _rows(f"{cfg}: modeled per-device HBM by (parts x model)",
+              ["mesh", "per_device", "replicated components"],
+              rows, out)
+        rows = []
+        sites = rep.get("sites")
+        if sites is None:
+            sites = [s for slot in rep.get("slots") or []
+                     for s in slot.get("sites") or []]
+        for s in sites:
+            per = s.get("per_device_bytes") or {}
+            rows.append([
+                str(s.get("op")), str(s.get("kind")),
+                f"{s.get('dtype')}{s.get('shape')}",
+                "/".join(s.get("lost") or []),
+                str(s.get("layer")), str(s.get("src") or "-")]
+                + [_fmt_bytes(per.get(k)) for k in
+                   ("1x8", "2x4", "4x2")])
+        _rows(f"{cfg}: full-width-materialization sites "
+              f"(portability sim)",
+              ["op", "kind", "tensor", "lost", "layer", "src",
+               "dev@1x8", "dev@2x4", "dev@4x2"], rows, out)
+        rows = []
+        for e in (rep.get("ledger") or [])[:10]:
+            rows.append([
+                str(e.get("role")),
+                f"{e.get('dtype')}{e.get('shape')}",
+                _fmt_bytes(e.get("bytes")),
+                ",".join(e.get("split") or []) or "-",
+                ",".join(e.get("replicated") or []) or "-",
+                _fmt_bytes(e.get("per_device_bytes"))])
+        _rows(f"{cfg}: replication ledger (top 10, "
+              f"{shape[0]}x{shape[1]})",
+              ["role", "tensor", "bytes", "split", "replicated",
+               "per_device"], rows, out)
     return 0
 
 
@@ -358,11 +436,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="roc_tpu.report", description=__doc__,
         formatter_class=argparse.RawDescriptionHelpFormatter)
-    ap.add_argument("events", nargs="+",
+    ap.add_argument("events", nargs="*",
                     help="event-log JSONL file(s) (--events / "
                          "ROC_TPU_EVENTS artifacts; repeat or glob "
                          "for multi-process runs — one file per "
-                         "process)")
+                         "process).  Optional with --sharding, which "
+                         "can render without a run artifact")
     ap.add_argument("--metrics", action="append", default=None,
                     help="training metrics JSONL (--metrics artifact) "
                          "to fold into the span/throughput tables; "
@@ -373,7 +452,57 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "concurrency-surface table (threads / locks "
                          "/ signal handlers per module) from it "
                          "instead of the event stream")
+    ap.add_argument("--sharding", nargs="?", const="__live__",
+                    default=None, metavar="FILE",
+                    help="render the sharding auditor's replication "
+                         "ledger + mesh-portability report.  With "
+                         "FILE: a `python -m roc_tpu.analysis "
+                         "--select sharding --json` payload.  "
+                         "Without FILE (and no event files): run "
+                         "the audit live on the 8-virtual-device "
+                         "CPU rig — the one mode of this tool that "
+                         "imports jax")
     args = ap.parse_args(argv)
+    # --sharding FILE loads the payload up front, whether or not
+    # event files are also given — an explicitly-passed report must
+    # render either way (with events, its tables follow the event
+    # summary)
+    sharding_reports: Optional[List[Dict[str, Any]]] = None
+    if args.sharding is not None and args.sharding != "__live__":
+        try:
+            with open(args.sharding) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {args.sharding}: {e}",
+                  file=sys.stderr)
+            return 2
+        reports = (payload.get("sharding", payload)
+                   if isinstance(payload, dict) else payload)
+        sharding_reports = reports if isinstance(reports, list) \
+            else []
+    if not args.events:
+        if args.sharding == "__live__":
+            # live audit: the single backend-touching mode, kept out
+            # of every artifact-reading path (module docstring) —
+            # forced onto the CPU rig exactly like the analysis CLI
+            from roc_tpu.analysis import force_cpu_rig
+            force_cpu_rig()
+            from roc_tpu.analysis.findings import load_budget
+            from roc_tpu.analysis.sharding_lint import audit_sharding
+            import os
+            base = (os.getcwd() if os.path.isdir(
+                os.path.join(os.getcwd(), "roc_tpu"))
+                else os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))))
+            budget = load_budget(
+                os.path.join(base, "scripts", "lint_baseline.json"),
+                "replication_budget")
+            extras: Dict[str, Any] = {}
+            audit_sharding(replication_budget=budget, extras=extras)
+            return summarize_sharding(extras.get("sharding", []))
+        if sharding_reports is not None:
+            return summarize_sharding(sharding_reports)
+        ap.error("event files required (or --sharding)")
     events: List[Dict[str, Any]] = []
     for path in _expand(args.events):
         try:
@@ -407,7 +536,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         # accept the full --json object or a bare surface dict
         concurrency = payload.get("concurrency_surface", payload) \
             if isinstance(payload, dict) else None
-    return summarize(events, metrics, concurrency=concurrency)
+    rc = summarize(events, metrics, concurrency=concurrency)
+    if sharding_reports is not None:
+        summarize_sharding(sharding_reports)
+    return rc
 
 
 if __name__ == "__main__":

@@ -226,6 +226,7 @@ class Predictor:
             cands.append(Candidate(
                 slot=self._slot(b), fn=jit, args=args, donate=(),
                 observed=False,
+                roles=("params", "data", "other", "tables"),
                 aot=lambda j=jit, a=args: j.lower(*a).compile()))
         return cands
 

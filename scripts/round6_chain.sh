@@ -29,6 +29,13 @@ python -m roc_tpu.analysis --json \
 #    artifact (`python -m roc_tpu.report --concurrency <file>`)
 python -m roc_tpu.analysis --json --select concurrency \
   > benchmarks/concurrency_report.json || exit 1
+#    sharding & replication audit (roc-lint level seven): the
+#    replication ledger vs the ratcheted replication_budget plus the
+#    (parts, model) mesh-portability worklist — the 2-D-mesh
+#    tripwire runs BEFORE chip time, and the artifact renders via
+#    `python -m roc_tpu.report --sharding benchmarks/sharding_report.json`
+python -m roc_tpu.analysis --json --select sharding \
+  > benchmarks/sharding_report.json || exit 1
 #    --jobs stays 1 on the chip host: libtpu owns the accelerator
 #    exclusively, so parallel prewarm children would fail backend
 #    init (sequential children each claim and release it)

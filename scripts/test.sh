@@ -16,6 +16,17 @@ shift 6 || true
 CONC_REPORT="${TMPDIR:-/tmp}/roc_concurrency_report.json"
 python -m roc_tpu.analysis --select concurrency --json \
     > "$CONC_REPORT" || { cat "$CONC_REPORT"; exit 1; }
+# sharding & replication preflight (roc-lint level seven): walk both
+# trainers' candidate jaxprs on the CPU rig (no compiles) and hold
+# the replication ledger against the ratcheted replication_budget —
+# a PR that adds a replicated buffer, voids a donation under
+# sharding, or re-gathers a constrained tensor to full width fails
+# HERE, before chip time; the --json report carries the ledger +
+# mesh-portability worklist for
+# `python -m roc_tpu.report --sharding <file>`
+SHARD_REPORT="${TMPDIR:-/tmp}/roc_sharding_report.json"
+python -m roc_tpu.analysis --select sharding --json \
+    > "$SHARD_REPORT" || { cat "$SHARD_REPORT"; exit 1; }
 # pre-flight static analysis (roc-lint): regressions against the
 # perf invariants fail HERE, before any chip time is spent.  The run
 # also prints the program-space compile-budget delta vs

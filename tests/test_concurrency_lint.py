@@ -444,6 +444,171 @@ def test_acquire_release_covers_unguarded_shared_state(tmp_path):
         [(f.line, f.msg) for f in got]
 
 
+# ----------------------------------- artifact lock ownership (ISSUE 14)
+
+def test_artifact_lock_ownership_fires_on_ungated_writers(tmp_path):
+    """Two writers to one rotation prefix without the shared-rotation
+    handshake = one finding per write site; a process_index-gated
+    writer and a per-process prefix are the sanctioned protocols."""
+    _plant(tmp_path, "roc_tpu/ck.py",
+           "from roc_tpu.resilience.recovery import "
+           "CheckpointRotation\n"
+           "def writer_a(tr):\n"
+           "    rot = CheckpointRotation('shared/ck')\n"
+           "    rot.save(tr)\n"                               # line 4
+           "def writer_b(tr):\n"
+           "    rot = CheckpointRotation('shared/ck')\n"
+           "    rot.save(tr)\n"                               # line 7
+           "def gated_writer(tr):\n"
+           "    import jax\n"
+           "    rot = CheckpointRotation('shared/ck')\n"
+           "    if jax.process_index() == 0:\n"
+           "        rot.save(tr)\n"
+           "def per_proc_writer(tr):\n"
+           "    import os\n"
+           "    rot = CheckpointRotation(f'ck.{os.getpid()}')\n"
+           "    rot.save(tr)\n")
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["artifact-lock-ownership"])
+    assert sorted(f.line for f in got) == [4, 7], \
+        [(f.line, f.msg) for f in got]
+    assert all(f.rule == "artifact-lock-ownership" for f in got)
+    assert "shared-rotation handshake" in got[0].msg
+
+
+def test_artifact_lock_ownership_bindings_are_function_scoped(
+        tmp_path):
+    """One function's per-process prefix must not vouch for another
+    function's shared prefix just because both bind the name
+    ``rot``."""
+    _plant(tmp_path, "roc_tpu/ck.py",
+           "from roc_tpu.resilience.recovery import "
+           "CheckpointRotation\n"
+           "import os\n"
+           "def per_proc(tr):\n"
+           "    rot = CheckpointRotation(f'ck.{os.getpid()}')\n"
+           "    rot.save(tr)\n"
+           "def shared(tr):\n"
+           "    rot = CheckpointRotation('shared/ck')\n"
+           "    rot.save(tr)\n")                              # line 8
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["artifact-lock-ownership"])
+    assert [f.line for f in got] == [8], \
+        [(f.line, f.msg) for f in got]
+
+
+def test_artifact_lock_ownership_local_binding_no_module_shadow(
+        tmp_path):
+    """A function-local per-process binding must not shadow the
+    MODULE-level shared binding another function writes through."""
+    _plant(tmp_path, "roc_tpu/ck.py",
+           "import os\n"
+           "from roc_tpu.resilience.recovery import "
+           "CheckpointRotation\n"
+           "rot = CheckpointRotation('shared/ck')\n"
+           "def module_writer(tr):\n"
+           "    rot.save(tr)\n"                               # line 5
+           "def per_proc(tr):\n"
+           "    rot = CheckpointRotation(f'ck.{os.getpid()}')\n"
+           "    rot.save(tr)\n")
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["artifact-lock-ownership"])
+    assert [f.line for f in got] == [5], \
+        [(f.line, f.msg) for f in got]
+    assert "module_writer" in got[0].msg
+
+
+def test_artifact_lock_ownership_attr_bindings_are_class_scoped(
+        tmp_path):
+    """Two classes reusing one attribute name: class A's per-process
+    prefix must not exempt class B's shared-prefix writer."""
+    _plant(tmp_path, "roc_tpu/ck.py",
+           "import os\n"
+           "from roc_tpu.resilience.recovery import "
+           "CheckpointRotation\n"
+           "class A:\n"
+           "    def __init__(self):\n"
+           "        self.rot = CheckpointRotation("
+           "f'ck.{os.getpid()}')\n"
+           "    def write(self, tr):\n"
+           "        self.rot.save(tr)\n"
+           "class B:\n"
+           "    def __init__(self):\n"
+           "        self.rot = CheckpointRotation('shared/ck')\n"
+           "    def write(self, tr):\n"
+           "        self.rot.save(tr)\n")                     # line 12
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["artifact-lock-ownership"])
+    assert [f.line for f in got] == [12], \
+        [(f.line, f.msg) for f in got]
+    assert "B.write" in got[0].msg
+
+
+def test_artifact_lock_ownership_gate_via_callee_chain(tmp_path):
+    """The real tree's shape: the write funnels through a helper that
+    carries the gate (checkpoint_trainer's process_index() != 0
+    return) — evidence travels the resolvable call chain, including
+    through a tree-local CheckpointRotation.save."""
+    _plant(tmp_path, "roc_tpu/ck.py",
+           "import jax\n"
+           "class CheckpointRotation:\n"
+           "    def __init__(self, prefix):\n"
+           "        self.prefix = prefix\n"
+           "    def save(self, tr):\n"
+           "        helper(tr, self.prefix)\n"
+           "def helper(tr, p):\n"
+           "    if jax.process_count() > 1 "
+           "and jax.process_index() != 0:\n"
+           "        return\n"
+           "    open(p, 'w').close()\n"
+           "def writer(tr):\n"
+           "    rot = CheckpointRotation('shared/ck')\n"
+           "    rot.save(tr)\n"
+           "def direct(tr):\n"
+           "    helper(tr, 'shared/ck')\n")
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["artifact-lock-ownership"])
+    assert got == [], [(f.line, f.msg) for f in got]
+
+
+def test_artifact_lock_ownership_pragma_and_writer_fns(tmp_path):
+    """Direct checkpoint_trainer()/save_checkpoint() call sites are
+    writers too, and the standard pragma documents a known-single-
+    writer site."""
+    _plant(tmp_path, "roc_tpu/ck.py",
+           "def checkpoint_trainer(tr, p):\n"
+           "    pass\n"
+           "def bad(tr):\n"
+           "    checkpoint_trainer(tr, 'ck')\n"               # line 4
+           "def vouched(tr):\n"
+           "    # one bench child per stage: "
+           "roc-lint: ok=artifact-lock-ownership\n"
+           "    checkpoint_trainer(tr, 'ck')\n")
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["artifact-lock-ownership"])
+    assert [f.line for f in got] == [4], \
+        [(f.line, f.msg) for f in got]
+
+
+def test_artifact_surface_inventories_real_tree():
+    """The surface documents which process-shared artifacts each
+    module touches and their ownership protocol: the tree's rotation
+    writers inherit the proc0 gate, the warm state publishes via
+    atomic replace, the compile cache is multi-writer-safe."""
+    surface = concurrency_surface(TreeModel(_REPO))
+    arts = {m["module"]: m["artifacts"]
+            for m in surface["artifacts"]}
+    assert any(a["kind"] == "rotation"
+               and a["owner"] == "proc0-gate"
+               for a in arts.get("bench.py", [])), arts
+    assert any(a["kind"] == "warm-state"
+               and a["owner"] == "atomic-replace"
+               for a in arts.get("roc_tpu/prewarm.py", []))
+    assert any(a["kind"] == "compile-cache"
+               for a in arts.get("roc_tpu/train/cli.py", []))
+    assert surface["totals"]["artifacts"] >= 5
+
+
 # ------------------------------------------------- registration + tree
 
 def test_rules_registered_and_not_trace():

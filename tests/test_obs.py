@@ -268,6 +268,11 @@ def test_bench_slow_stage_emits_heartbeat_before_timeout(
     ev = str(tmp_path / "events.jsonl")
     monkeypatch.setenv("ROC_TPU_BENCH_ARTIFACTS", str(tmp_path))
     monkeypatch.setenv("ROC_TPU_HEARTBEAT_S", "0.5")
+    # a FRESH compile-cache dir: a warm persistent cache (left by any
+    # earlier bench/test run in this container) lets the child finish
+    # inside the 2 s budget on a fast box, voiding the forced-slow
+    # premise — the stage must pay its cold compile here
+    monkeypatch.setenv("ROC_TPU_CACHE_DIR", str(tmp_path / "cache"))
     monkeypatch.setattr(bench, "_ART_DIR", str(tmp_path))
     monkeypatch.setattr(bench, "_STAGES_PATH",
                         str(tmp_path / "bench_stages.jsonl"))

@@ -57,6 +57,19 @@ def _config(**kw):
     return TrainConfig(**kw)
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _shed_native_jit_state():
+    """The in-process rig + versioned-table stress compile several
+    predictor/program variants into the pytest process; shed the
+    accumulated native JIT state when the module ends (the PR-7/8
+    mitigation for the known jaxlib-0.4.x XLA:CPU corruption flake
+    under per-process compile churn — test_flat_sum /
+    test_mixed_precision / test_drills carry the same fixture)."""
+    yield
+    import jax
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="module")
 def rig():
     """Predictor + full-table reference logits (fresh Glorot weights —

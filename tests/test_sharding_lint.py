@@ -1,0 +1,525 @@
+"""Sharding & replication auditor — roc-lint level seven (ISSUE 14):
+every rule fires on a synthetic violation, the propagation engine
+keeps/loses splits where GSPMD would, the REAL tree audits clean
+(findings baseline stays EMPTY), the replication budget ratchets
+shrink-only through the CLI, and the mesh-portability report pins the
+known full-width sites of both registered rigs."""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from roc_tpu.analysis.programspace import Candidate
+from roc_tpu.analysis.sharding_lint import (CANONICAL_SHAPE,
+                                            Propagator, RigDims,
+                                            SHARDING_RULES,
+                                            audit_sharding,
+                                            check_donation,
+                                            check_plan_excess,
+                                            check_replication_budget,
+                                            findings_from_sites,
+                                            ledger_entries,
+                                            replicated_bytes,
+                                            seed_leaf, union_ledger)
+from roc_tpu.parallel import MODEL_AXIS, PARTS_AXIS
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_AX = {PARTS_AXIS: 2, MODEL_AXIS: 4}
+
+
+def _prop(fn, in_specs, *args, scale=1):
+    """Propagate one traced fn with explicit input specs; returns
+    (out_specs, propagator)."""
+    p = Propagator(_AX, scale)
+    out = p.run(jax.make_jaxpr(fn)(*args), [tuple(s)
+                                            for s in in_specs])
+    return out, p
+
+
+# ------------------------------------------------ propagation engine
+
+def test_elementwise_and_dot_keep_model_split():
+    """The dense path is mesh-agnostic: elementwise ops join specs,
+    dot_general carries the rhs free-dim split to the output and
+    consumes contracted splits without a site."""
+    x = jnp.zeros((64, 48))
+    w = jnp.zeros((48, 24))
+
+    def fn(x, w):
+        return jnp.tanh(x) @ w + 1.0
+
+    out, p = _prop(fn, [(None, MODEL_AXIS), (None, MODEL_AXIS)],
+                   x, w)
+    # lhs contraction split consumed, rhs free dim keeps model
+    assert out[0] == (None, MODEL_AXIS)
+    assert p.sites == []
+
+
+def test_unconstrained_op_is_caught():
+    """THE acceptance fixture: a deliberately-unconstrained synthetic
+    op (one the propagation model has no transfer rule for) kills the
+    split — the exact GSPMD silent-re-gather failure mode — and the
+    full-width-materialization rule reports it with op and bytes."""
+    x = jnp.zeros((256, 48))
+
+    def fn(x):
+        return jnp.fft.fft(x).real.astype(jnp.float32)
+
+    out, p = _prop(fn, [(PARTS_AXIS, MODEL_AXIS)], x,
+                   scale=256 * 48 // 8)
+    kinds = {(s.kind, s.op) for s in p.sites}
+    assert ("unknown-op", "fft") in kinds, p.sites
+    findings = findings_from_sites("rig", "step", p.sites)
+    rules = {f.rule for f in findings}
+    assert "full-width-materialization" in rules
+    f = [x for x in findings
+         if x.rule == "full-width-materialization"][0]
+    assert "fft" in f.msg and f.unit == "sharding:rig:step"
+
+
+def test_below_scale_sites_not_reported():
+    x = jnp.zeros((8, 8))
+    _, p = _prop(lambda x: jnp.fft.fft(x).real,
+                 [(PARTS_AXIS, None)], x, scale=1 << 20)
+    assert p.sites == []
+
+
+def test_slice_and_gather_across_split_dim_fire():
+    """Slicing a window of a split dim (the streamed-head block
+    pattern) and gathering rows across a split dim both re-gather
+    the operand."""
+    x = jnp.zeros((256, 48))
+    _, p = _prop(lambda x: x[:100], [(PARTS_AXIS, None)], x)
+    assert any(s.kind == "full-width" and s.op == "slice"
+               for s in p.sites), p.sites
+    idx = jnp.zeros((7,), jnp.int32)
+    _, p = _prop(lambda x, i: jnp.take(x, i, axis=0),
+                 [(PARTS_AXIS, None), (None,)], x, idx)
+    assert any(s.kind == "full-width" and s.op == "gather"
+               for s in p.sites), p.sites
+    # gather along an UNsplit dim inherits the operand's other splits
+    out, p = _prop(lambda x, i: jnp.take(x, i, axis=0),
+                   [(None, MODEL_AXIS), (None,)], x, idx)
+    assert out[0] == (None, MODEL_AXIS)
+    assert p.sites == []
+
+
+def test_scatter_add_keeps_window_split():
+    """The aggregation pattern: scatter-add of [E, F]-shaped updates
+    into [V, F] zeros along V — the F split must survive (the window
+    dims join), or every aggregation would be a false positive."""
+    upd = jnp.zeros((512, 48))
+    idx = jnp.zeros((512,), jnp.int32)
+
+    def fn(upd, idx):
+        return jnp.zeros((256, 48)).at[idx].add(upd)
+
+    out, p = _prop(fn, [(None, MODEL_AXIS), (None,)], upd, idx)
+    assert out[0] == (None, MODEL_AXIS)
+    assert not any(s.kind == "full-width" for s in p.sites), p.sites
+
+
+def test_reduce_and_transpose_and_reshape():
+    x = jnp.zeros((256, 48))
+    out, _ = _prop(lambda x: x.sum(axis=0),
+                   [(PARTS_AXIS, MODEL_AXIS)], x)
+    assert out[0] == (MODEL_AXIS,)
+    out, _ = _prop(lambda x: x.T, [(PARTS_AXIS, MODEL_AXIS)], x)
+    assert out[0] == (MODEL_AXIS, PARTS_AXIS)
+    # merge keeps an outer-dim split on the merged dim; unmerging a
+    # split dim loses it (and reports)
+    y = jnp.zeros((2, 128, 48))
+    out, p = _prop(lambda y: y.reshape(256, 48),
+                   [(PARTS_AXIS, None, None)], y)
+    assert out[0] == (PARTS_AXIS, None)
+    assert p.sites == []
+
+
+def test_scan_carries_specs_through_fixpoint():
+    xs = jnp.zeros((8, 64, 48))
+
+    def fn(xs):
+        def body(c, x):
+            return c + x, x.sum()
+        return jax.lax.scan(body, jnp.zeros((64, 48)), xs)
+
+    out, p = _prop(fn, [(None, None, MODEL_AXIS)], xs)
+    assert out[0] == (None, MODEL_AXIS)     # carry keeps the split
+    assert not any(s.kind == "full-width" for s in p.sites)
+
+
+def test_sharding_constraint_seeds_and_conflict_fires():
+    """with_sharding_constraint introduces live specs mid-graph (how
+    the rules arm once the 2-D mesh work starts), and a constraint
+    that contradicts the propagated spec is a sharding-mismatch."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device rig")
+    mesh = Mesh(np.asarray(jax.devices()[:2]), (PARTS_AXIS,))
+    sh = NamedSharding(mesh, P(PARTS_AXIS, None))
+    sh2 = NamedSharding(mesh, P(None, PARTS_AXIS))
+    x = jnp.zeros((256, 48))
+
+    def fn(x):
+        a = jax.lax.with_sharding_constraint(x, sh)
+        return jax.lax.with_sharding_constraint(a, sh2)
+
+    out, p = _prop(fn, [(None, None)], x)
+    assert out[0] == (None, PARTS_AXIS)
+    assert any(s.kind == "reshard" for s in p.sites), p.sites
+    findings = findings_from_sites("rig", "s", p.sites)
+    assert any(f.rule == "sharding-mismatch" for f in findings)
+
+
+def test_shard_map_boundary_pins_are_sites():
+    """An outer split the shard_map in_names don't name is an
+    implicit all-gather at the boundary — the dist rigs' F-axis
+    story."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device rig")
+    mesh = Mesh(np.asarray(jax.devices()[:2]), (PARTS_AXIS,))
+    from roc_tpu.parallel.distributed import _shard_map
+    x = jnp.zeros((2, 128, 48))
+    fn = _shard_map(lambda x: x * 2.0, mesh, (P(PARTS_AXIS),),
+                    P(PARTS_AXIS))
+    # parts consumed by in_names: clean
+    _, p = _prop(fn, [(PARTS_AXIS, None, None)], x)
+    assert not p.sites
+    # a model split the in_names don't know: boundary site
+    _, p = _prop(fn, [(PARTS_AXIS, None, MODEL_AXIS)], x)
+    assert any(s.kind == "boundary" for s in p.sites), p.sites
+
+
+# -------------------------------------------------- rules (directly)
+
+def test_replication_budget_rule():
+    assert check_replication_budget("cfg", 100, None) == []
+    assert check_replication_budget("cfg", 100, 100) == []
+    got = check_replication_budget("cfg", 101, 100)
+    assert len(got) == 1 and got[0].rule == "replication-budget"
+    assert got[0].key == "over-budget"
+
+
+def test_plan_excess_rule():
+    assert check_plan_excess("cfg", 100, None) == []
+    assert check_plan_excess("cfg", 100, 50) == []      # 2x < 4x
+    got = check_plan_excess("cfg", 1000, 100)
+    assert len(got) == 1 and got[0].key == "plan-excess"
+
+
+def test_donation_under_sharding_fires_on_spec_mismatch():
+    """A donated buffer whose only aval-matching output carries a
+    different propagated sharding: the aliasing silently degrades to
+    a copy."""
+    x = jnp.zeros((256, 48))
+    cand = Candidate(slot="s", fn=lambda x: x * 1.0, args=(x,),
+                     donate=(0,), roles=("data",))
+    jaxpr = jax.make_jaxpr(cand.fn)(x)
+    got = check_donation("rig", cand, [(PARTS_AXIS, None)],
+                         [(None, None)], jaxpr)
+    assert len(got) == 1
+    assert got[0].rule == "donation-under-sharding"
+    # identical specs: clean
+    assert check_donation("rig", cand, [(PARTS_AXIS, None)],
+                          [(PARTS_AXIS, None)], jaxpr) == []
+    # undonated candidate: out of scope
+    cand2 = Candidate(slot="s", fn=lambda x: x * 1.0, args=(x,),
+                      donate=(), roles=("data",))
+    assert check_donation("rig", cand2, [(PARTS_AXIS, None)],
+                          [(None, None)], jaxpr) == []
+
+
+# ---------------------------------------------- seeding + the ledger
+
+def test_seed_leaf_live_vs_simulation():
+    dims = RigDims(vertex_sizes={256}, feat_sizes={48, 24},
+                   parts_traced=2)
+    # live: only the dist stacked dim carries parts
+    assert seed_leaf((2, 136, 48), "data", dims, False) == \
+        (PARTS_AXIS, None, None)
+    assert seed_leaf((48, 24), "params", dims, False) == (None, None)
+    # simulation: last feature dim gains model, one dim per axis
+    assert seed_leaf((48, 24), "params", dims, True) == \
+        (None, MODEL_AXIS)
+    assert seed_leaf((2, 136, 48), "data", dims, True) == \
+        (PARTS_AXIS, None, MODEL_AXIS)
+    # params never take the stacked seed
+    assert seed_leaf((2, 24), "params", dims, False) == (None, None)
+
+
+def test_ledger_and_replicated_bytes():
+    dims = RigDims(vertex_sizes={256}, feat_sizes={48},
+                   parts_traced=1)
+    x = jnp.zeros((256, 48), jnp.float32)     # vertex data
+    w = jnp.zeros((48, 48), jnp.float32)      # params
+    cand = Candidate(slot="s", fn=lambda a, b: a @ b, args=(x, w),
+                     roles=("data", "params"))
+    entries = ledger_entries(cand, dims, (2, 4))
+    by_role = {e["role"]: e for e in entries}
+    assert by_role["data"]["split"] == [PARTS_AXIS]
+    assert by_role["data"]["replicated"] == [MODEL_AXIS]
+    assert by_role["data"]["per_device_bytes"] == 256 * 48 * 4 // 2
+    assert by_role["params"]["replicated"] == [PARTS_AXIS,
+                                               MODEL_AXIS]
+    assert by_role["params"]["per_device_bytes"] == 48 * 48 * 4
+    # everything is model-replicated today -> all per-device bytes
+    assert replicated_bytes(entries) == sum(
+        e["per_device_bytes"] for e in entries)
+    # trivial mesh: nothing is "replicated" on one device
+    assert replicated_bytes(ledger_entries(cand, dims, (1, 1))) == 0
+    # union dedups the shared buffer across candidates
+    assert len(union_ledger([entries, entries])) == len(entries)
+
+
+# --------------------------------------- the real tree + portability
+
+@pytest.fixture(scope="module")
+def tree_audit():
+    extras = {}
+    findings = audit_sharding(extras=extras)
+    return findings, {r["config"]: r for r in extras["sharding"]}
+
+
+def test_tree_is_clean(tree_audit):
+    """The live-semantics audit of the real tree: ZERO findings — the
+    PR 3/6/12 convention, the baseline stays EMPTY."""
+    findings, _ = tree_audit
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_mesh_portability_golden_gin_flat8(tree_audit):
+    """The migration worklist for the dist rig is exactly the
+    shard_map boundary pinning params and features replicated over
+    model — the F axis dies at the 1-D mesh's in-specs, nowhere
+    inside the step body (the dense path is already mesh-agnostic)."""
+    _, reports = tree_audit
+    rep = reports["gin_flat8"]
+    assert rep["parts"] == 2
+    sites = [s for slot in rep["slots"] for s in slot["sites"]]
+    assert {(s["kind"], s["op"]) for s in sites} == \
+        {("boundary", "shard_map")}
+    assert {tuple(s["lost"]) for s in sites} == {("model",)}
+    shapes = {tuple(s["shape"]) for s in sites}
+    assert shapes == {(48, 48), (2, 136, 48)}, shapes
+    # modeled per-device bytes: the stacked feature block divides by
+    # parts, and the report covers the three candidate 2-D shapes
+    feat = [s for s in sites if tuple(s["shape"]) == (2, 136, 48)][0]
+    for mesh in ("1x8", "2x4", "4x2"):
+        assert mesh in feat["per_device_bytes"]
+    assert feat["per_device_bytes"]["2x4"] == \
+        feat["bytes"] // 2
+    # every op INSIDE the step body kept its splits
+    for slot in rep["slots"]:
+        assert slot["mesh_agnostic_ops"] == slot["ops"], slot
+
+
+def test_mesh_portability_golden_sgc_stream(tree_audit):
+    """The streamed-head rig's traced programs are mesh-agnostic (no
+    full-width sites — the [V, H] handoff is a ledger fact, not an op
+    defect), and the ledger carries the [V, H]/[V, F] buffers as
+    model-replicated: the 2-D mesh's reclaim target."""
+    _, reports = tree_audit
+    rep = reports["sgc_stream"]
+    assert [s for slot in rep["slots"] for s in slot["sites"]] == []
+    big = [e for e in rep["ledger"]
+           if e["shape"] and e["shape"][0] == 256]
+    assert big, rep["ledger"]
+    assert all(MODEL_AXIS in e["replicated"] for e in big)
+    # modeled per-device HBM shrinks as the model axis widens — the
+    # quantitative case for feature sharding
+    per_dev = {(m["parts"], m["model"]): m["per_device_bytes"]
+               for m in rep["mesh_shapes"]}
+    assert per_dev[(1, 8)] < per_dev[(2, 4)] < per_dev[(8, 1)]
+
+
+def test_reports_cover_all_rigs_and_budget(tree_audit):
+    _, reports = tree_audit
+    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve"}
+    from roc_tpu.analysis.findings import load_budget
+    budget = load_budget(os.path.join(_REPO, "scripts",
+                                      "lint_baseline.json"),
+                         "replication_budget")
+    for name, rep in reports.items():
+        assert rep["replicated_bytes"] > 0
+        assert rep["canonical_shape"] == list(CANONICAL_SHAPE)
+        # the checked-in ratchet matches the measurement exactly
+        # (delta 0): a drift here means replication grew (fix it) or
+        # shrank (commit the shrink via --update-baseline)
+        assert budget[name] == rep["replicated_bytes"], name
+
+
+def test_rules_registered():
+    from roc_tpu.analysis.driver import all_rule_names, is_trace_rule
+    names = set(all_rule_names())
+    for r in SHARDING_RULES:
+        assert r in names, r
+        assert is_trace_rule(r), r
+
+
+def test_sharding_events_emitted():
+    from roc_tpu.obs.events import CATEGORIES, get_bus
+
+    class _Cap:
+        def __init__(self):
+            self.recs = []
+
+        def write(self, rec):
+            self.recs.append(rec)
+
+        def close(self):
+            pass
+
+    assert "sharding" in CATEGORIES
+    cap = _Cap()
+    bus = get_bus()
+    bus.add_sink(cap)
+    try:
+        audit_sharding()
+    finally:
+        bus.sinks.remove(cap)
+    got = [r for r in cap.recs if r.get("cat") == "sharding"]
+    assert {r["config"] for r in got} == \
+        {"gin_flat8", "sgc_stream", "sgc_serve"}
+    for r in got:
+        assert "replicated_bytes" in r and "mesh_shapes" in r
+
+
+# ------------------------------------------------- CLI ratchet + JSON
+
+def _run_cli(args, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "roc_tpu.analysis"] + args,
+        cwd=_REPO, capture_output=True, text=True, timeout=timeout,
+        env=env)
+
+
+def test_cli_ratchet_bites_and_never_absorbs(tmp_path):
+    """A replication_budget below the measurement fires the rule
+    (exit 1), and --update-baseline does NOT absorb the finding —
+    min(stored, measured) can only shrink; clearing the finding means
+    fixing the replication or hand-editing the JSON."""
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps(
+        {"version": 1, "findings": [],
+         "replication_budget": {"gin_flat8": 1, "sgc_stream": 1,
+                                "sgc_serve": 1}}))
+    r = _run_cli(["--baseline", str(bp), "--select",
+                  "replication-budget"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "replication-budget" in r.stdout
+    assert "exceed the baselined bound 1" in r.stdout
+    # the ratchet can only shrink: --update-baseline keeps the bound
+    # at 1 and the findings stay un-absorbed (still exit 1)
+    r2 = _run_cli(["--baseline", str(bp), "--select",
+                   "replication-budget", "--update-baseline"])
+    assert r2.returncode == 1, r2.stdout + r2.stderr
+    data = json.loads(bp.read_text())
+    assert data["replication_budget"]["gin_flat8"] == 1
+    assert data["findings"] == []
+
+
+def test_cli_strict_fails_on_replication_slack_and_unbounded(tmp_path):
+    """Slack (measured < bound) and a missing bound both fail
+    --strict until --update-baseline commits the shrink /
+    initializes — the program_budget semantics, verbatim."""
+    bp = tmp_path / "baseline.json"
+    bp.write_text(json.dumps({"version": 1, "findings": []}))
+    args = ["--baseline", str(bp), "--select", "sharding"]
+    r = _run_cli(args + ["--strict"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "no replication_budget bound" in r.stdout
+    r2 = _run_cli(args)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    r3 = _run_cli(args + ["--strict", "--update-baseline"])
+    assert r3.returncode == 0, r3.stdout + r3.stderr
+    budget = json.loads(bp.read_text())["replication_budget"]
+    assert set(budget) == {"gin_flat8", "sgc_stream", "sgc_serve"}
+    # slack now: inflate one bound by hand
+    budget2 = dict(budget, gin_flat8=budget["gin_flat8"] + 5)
+    bp.write_text(json.dumps({"version": 1, "findings": [],
+                              "replication_budget": budget2}))
+    r4 = _run_cli(args + ["--strict"])
+    assert r4.returncode == 1, r4.stdout + r4.stderr
+    assert "above the measured bytes" in r4.stdout
+    # an orphan bound (renamed rig) fails strict and drops on update
+    budget3 = dict(budget, ghost_rig=123)
+    bp.write_text(json.dumps({"version": 1, "findings": [],
+                              "replication_budget": budget3}))
+    r5 = _run_cli(args + ["--strict"])
+    assert r5.returncode == 1, r5.stdout + r5.stderr
+    assert "unknown rig config" in r5.stdout
+    r6 = _run_cli(args + ["--strict", "--update-baseline"])
+    assert r6.returncode == 0, r6.stdout + r6.stderr
+    assert "ghost_rig" not in \
+        json.loads(bp.read_text())["replication_budget"]
+
+
+def test_cli_json_carries_ledger_and_sites():
+    """--json: the sharding reports ride the payload — findings,
+    ledger, sites, mesh shapes — so CI and the report renderer share
+    one machine-readable artifact."""
+    r = _run_cli(["--json", "--select", "sharding"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    reports = {p["config"]: p for p in payload["sharding"]}
+    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve"}
+    rep = reports["gin_flat8"]
+    assert rep["delta"] == 0
+    assert rep["ledger"] and rep["mesh_shapes"]
+    assert all("per_device_bytes" in e for e in rep["ledger"])
+    assert payload["summary"]["replication_unbounded"] == 0
+
+
+def test_report_sharding_renders():
+    """`python -m roc_tpu.report --sharding <file>` renders the
+    mesh-portability tables from the --json payload (the acceptance
+    path; the no-arg live mode runs the same renderer)."""
+    r = _run_cli(["--json", "--select", "sharding"])
+    assert r.returncode == 0, r.stderr
+    payload_path = os.path.join(_REPO, "benchmarks",
+                                "_test_shard_payload.json")
+    with open(payload_path, "w") as f:
+        f.write(r.stdout)
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (_REPO + os.pathsep
+                             + env.get("PYTHONPATH", ""))
+        r2 = subprocess.run(
+            [sys.executable, "-m", "roc_tpu.report", "--sharding",
+             payload_path],
+            cwd=_REPO, capture_output=True, text=True, timeout=120,
+            env=env)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        for needle in ("== sharding gin_flat8", "1x8", "2x4", "4x2",
+                       "full-width-materialization sites",
+                       "replication ledger", "shard_map"):
+            assert needle in r2.stdout, (needle, r2.stdout[-2000:])
+        # an explicitly-passed payload renders even when event files
+        # are ALSO given (after the event summary)
+        ev_path = os.path.join(_REPO, "benchmarks",
+                               "_test_shard_ev.jsonl")
+        with open(ev_path, "w") as f:
+            f.write(json.dumps({"t": 1.0, "cat": "run",
+                                "msg": "x"}) + "\n")
+        try:
+            r3 = subprocess.run(
+                [sys.executable, "-m", "roc_tpu.report", ev_path,
+                 "--sharding", payload_path],
+                cwd=_REPO, capture_output=True, text=True,
+                timeout=120, env=env)
+            assert r3.returncode == 0, r3.stdout + r3.stderr
+            assert "run manifest" in r3.stdout
+            assert "== sharding gin_flat8" in r3.stdout
+        finally:
+            os.remove(ev_path)
+    finally:
+        os.remove(payload_path)

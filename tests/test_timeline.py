@@ -19,6 +19,19 @@ from roc_tpu.obs.timeline import (clock_offsets, merge_timeline,
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _shed_native_jit_state():
+    """The flight-recorder / straggler tests below compile trainer
+    steps into the pytest process; shed the accumulated native JIT
+    state when the module ends (the PR-7/8 mitigation for the known
+    jaxlib-0.4.x XLA:CPU corruption flake under per-process compile
+    churn — test_flat_sum / test_mixed_precision / test_drills carry
+    the same fixture)."""
+    yield
+    import jax
+    jax.clear_caches()
+
+
 def _ev(cat, t, mono, proc, host="hostA", **fields):
     return {"t": t, "mono": mono, "host": host, "proc": proc,
             "cat": cat, "msg": f"{cat} event", **fields}
