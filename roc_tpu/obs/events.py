@@ -65,10 +65,15 @@ from typing import Any, Dict, List, Optional
 #             sharding auditor (analysis/sharding_lint.py): per-rig
 #             replicated bytes vs the ratcheted budget, full-width
 #             sites, modeled per-device HBM per (parts, model) shape
+#   checkpoint  checkpoint-v3 save lifecycle (utils/checkpoint.py +
+#             resilience/async_save.py): committed async saves with
+#             block/write/commit timings, superseded-snapshot drops,
+#             sync-fallback decisions — the ``ckpt_*`` timeline spans
+#             ride the ordinary timeline/spans batches
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
               "costmodel", "programspace", "resilience", "timeline",
-              "serve", "sharding")
+              "serve", "sharding", "checkpoint")
 
 
 # ---------------------------------------------------------- clock tuple

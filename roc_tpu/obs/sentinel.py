@@ -123,6 +123,8 @@ def load_bench_round(path: str) -> Dict[str, Any]:
                            "serve_qps": None, "serve_shed_rate": None,
                            "serve_error_rate": None,
                            "serve_availability": None,
+                           "ckpt_save_ms": None,
+                           "ckpt_block_ms": None,
                            "dtype": None, "stage": None}
     try:
         with open(path) as f:
@@ -144,8 +146,12 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     # The availability triple (PR 13) rides the same headline line:
     # shed/error rates and completed-over-submitted availability of
     # the serve stage's load run.
+    # checkpoint-cost columns (ISSUE 15): the async save's wall time
+    # and its step-path blocked time ride the headline exactly like
+    # the serve columns — both gated lower-better
     for k in ("serve_p50_ms", "serve_qps", "serve_shed_rate",
-              "serve_error_rate", "serve_availability"):
+              "serve_error_rate", "serve_availability",
+              "ckpt_save_ms", "ckpt_block_ms"):
         if isinstance(parsed.get(k), (int, float)):
             out[k] = float(parsed[k])
     out["dtype"] = parsed.get("dtype")
@@ -261,6 +267,14 @@ def check_run(rounds: List[Dict[str, Any]],
             current.get("serve_availability"),
             higher_is_better=True, allow_zero=True,
             abs_floor=RATE_ABS_FLOOR),
+        # checkpoint v3 (ISSUE 15): async save wall + step-path
+        # blocked time, lower-better — a PR that re-synchronizes the
+        # save path (or bloats the snapshot) regresses here first
+        "ckpt_save_ms": detect([r.get("ckpt_save_ms") for r in rounds],
+                               current.get("ckpt_save_ms")),
+        "ckpt_block_ms": detect(
+            [r.get("ckpt_block_ms") for r in rounds],
+            current.get("ckpt_block_ms")),
     }
     regressed = [name for name, v in checks.items()
                  if v["verdict"] == "regression"]
@@ -363,6 +377,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "serve_shed_rate": cur.get("serve_shed_rate"),
                    "serve_error_rate": cur.get("serve_error_rate"),
                    "serve_availability": cur.get("serve_availability"),
+                   "ckpt_save_ms": cur.get("ckpt_save_ms"),
+                   "ckpt_block_ms": cur.get("ckpt_block_ms"),
                    "dtype": args.dtype or cur.get("dtype"),
                    "round": cur["path"]}
         history = rounds[:cur_idx]

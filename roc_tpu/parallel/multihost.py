@@ -95,6 +95,28 @@ def init_distributed(coordinator_address: Optional[str] = None,
         local_device_ids=local_device_ids)
 
 
+def checkpoint_commit_barrier(tag: str) -> None:
+    """The checkpoint-v3 two-phase-commit rendezvous: every process
+    has renamed its shard files into place; after this barrier,
+    process 0 publishes MANIFEST.json (utils/checkpoint.
+    write_snapshot).  Only reached when MORE than one process owns
+    shards — today's fully replicated state (process 0 owns
+    everything) never needs it, so the degenerate path stays
+    barrier-free exactly like the v2 single-writer handshake.
+    Single-process is a no-op.  A dead peer wedges the survivors
+    here; the heartbeat dates the stall and — with
+    ``ROC_TPU_STALL_TIMEOUT_S`` armed — promotes it into a
+    StallFailure the recovery loop can checkpoint-restart
+    (obs/heartbeat.py), the same contract as the setup collectives
+    above."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    from ..obs.heartbeat import Heartbeat
+    with Heartbeat("ckpt_commit_barrier", op=tag):
+        multihost_utils.sync_global_devices(f"roc_tpu:ckpt:{tag}")
+
+
 def make_parts_mesh(num_parts: Optional[int] = None,
                     devices: Optional[List] = None) -> Mesh:
     """1-D ``'parts'`` mesh across all processes' devices — alias of

@@ -1,14 +1,24 @@
 """Preemption-safe elastic training: the resilience subsystem.
 
-Four coordinated pieces (ISSUE 8):
+Five coordinated pieces (ISSUE 8 + the ISSUE 15 checkpoint-v3
+rebuild):
 
-- **Checkpointing** — ``utils/checkpoint.py`` writes versioned,
-  CRC32-validated, config-fingerprinted atomic ``.npz`` checkpoints;
-  both trainers (and the multihost path: process 0 writes, every
-  process restores through ``put_replicated``) save/restore through
-  it, including *elastic* restores onto a different partition count.
+- **Checkpointing** — ``utils/checkpoint.py`` format v3: per-process
+  SHARD files under a crash-consistent two-phase commit (shards land
+  via tmp-fsync-rename, process 0 publishes ``MANIFEST.json`` last —
+  an uncommitted directory is invisible to restore), per-array CRC32s
+  + config fingerprints, and gather-on-restore that reassembles any
+  saved (P, mesh) layout onto any restore layout — including
+  *elastic* restores onto a different partition count.  v1/v2
+  single-file checkpoints load with a loud warning.
+- **Async saving** (:mod:`.async_save`) — a dedicated saver thread
+  (bounded queue depth 1, newer snapshot supersedes a queued one)
+  takes the host snapshot off the step path and runs CRC + write +
+  commit in the background; ``flush()`` is the emergency-save
+  barrier, ``drain()`` the watchdog-bounded shutdown path.
 - **Recovery** (:mod:`.recovery`) — keep-last-k rotation with
-  corrupt-checkpoint fallback + the bounded retry loop
+  corrupt-checkpoint fallback (every candidate's manifest + shard
+  CRCs validated BEFORE selection) + the bounded retry loop
   ``train_with_recovery`` covering numeric failures, watchdog stalls,
   and transient I/O.
 - **Preemption** (:mod:`.preempt`) — SIGTERM/SIGINT grace handling:
@@ -33,6 +43,9 @@ def __getattr__(name):
     if name in _LAZY:
         from . import recovery
         return getattr(recovery, name)
+    if name == "AsyncSaver":
+        from .async_save import AsyncSaver
+        return AsyncSaver
     if name == "StallFailure":
         from ..obs.heartbeat import StallFailure
         return StallFailure

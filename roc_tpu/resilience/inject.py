@@ -16,11 +16,26 @@ Sites (each fires AT MOST ONCE per process — ``FaultSpec.fired``):
 - ``sigkill``          SIGKILL this process mid-run at the armed epoch.
 - ``sigterm``          deliver SIGTERM to this process at the armed
                        epoch (drills the preemption grace path).
-- ``kill_in_save``     SIGKILL between the checkpoint tmp-file write
-                       and the atomic rename (atomicity drill).
-- ``bitflip_checkpoint``  flip one byte of the just-written checkpoint,
-                       then SIGKILL (integrity-validation drill: the
-                       restart must fall back to the previous one).
+- ``kill_in_save``     SIGKILL between the shard tmp-file write and
+                       its atomic rename (atomicity drill — the torn
+                       ``.npz.tmp`` must never be restorable).
+- ``kill_in_async_save``  SIGKILL inside the v3 two-phase-commit
+                       window: shards renamed into place, manifest
+                       NOT yet published — the restart must see only
+                       the previous committed checkpoint (fires on
+                       the saver thread in async mode, inline in
+                       sync mode; the window is the site).
+- ``bitflip_checkpoint``  corrupt the just-committed checkpoint's
+                       COMMIT RECORD (v3: first byte of
+                       MANIFEST.json; legacy file: mid-file byte),
+                       then SIGKILL — the restart must fall back.
+- ``shard_corrupt``    flip one byte of a committed checkpoint's
+                       shard file, then SIGKILL: the restore scan's
+                       manifest-vs-shard CRC validation must reject
+                       it and fall back to the previous checkpoint.
+- ``saver_stall``      wedge the async saver thread indefinitely —
+                       flush()/drain() deadlines must bound the
+                       damage (StallFailure, restartable exit).
 - ``staging_io``       raise OSError from the StagingPool's staging
                        call site at the armed epoch (streamed tier).
 - ``stall_compile``    hang the first-compile barrier (the watchdog
@@ -62,6 +77,7 @@ from ..obs.events import emit
 ENV_VAR = "ROC_TPU_FAULT"
 
 SITES = ("nan_grads", "sigkill", "sigterm", "kill_in_save",
+         "kill_in_async_save", "shard_corrupt", "saver_stall",
          "bitflip_checkpoint", "staging_io", "stall_compile",
          "replica_sigkill", "replica_stall", "table_swap_mid_query",
          "serve_io")
@@ -247,8 +263,8 @@ def epoch_hooks(trainer, epoch: int) -> None:
 
 
 def maybe_kill_in_save(epoch: int) -> None:
-    """Between the checkpoint tmp write and the atomic rename
-    (utils/checkpoint.save_checkpoint): die with the ``.npz.tmp`` on
+    """Between the shard tmp write and the atomic rename
+    (utils/checkpoint._write_shard): die with the ``.npz.tmp`` on
     disk — restore must never pick it up."""
     spec = _ready("kill_in_save", int(epoch))
     if spec is not None:
@@ -256,24 +272,81 @@ def maybe_kill_in_save(epoch: int) -> None:
         os.kill(os.getpid(), signal.SIGKILL)
 
 
-def maybe_corrupt_checkpoint(path: str, epoch: int) -> None:
-    """After a successful rotation save: flip one byte mid-file, then
-    SIGKILL — the restarted run must detect CheckpointCorrupt and fall
-    back to the previous checkpoint."""
-    spec = _ready("bitflip_checkpoint", int(epoch), mode="at_least")
-    if spec is None:
-        return
-    _fire(spec, f"bit-flipped {os.path.basename(path)}, then SIGKILL",
-          path=path)
+def maybe_kill_in_commit(epoch: int) -> None:
+    """The v3 two-phase-commit window (utils/checkpoint.
+    write_snapshot): shard files renamed into place, MANIFEST.json
+    not yet published.  Dying here must leave the new directory
+    INVISIBLE to restore_latest — only the previous committed
+    checkpoint exists."""
+    spec = _ready("kill_in_async_save", int(epoch))
+    if spec is not None:
+        _fire(spec, "SIGKILL between shard rename and manifest "
+                    "commit (shards on disk, no manifest)")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_saver_stall(epoch: int) -> None:
+    """Async-saver wedge site (resilience/async_save.AsyncSaver
+    _process): sleep far past any sane deadline ON the saver thread.
+    flush()/drain() deadlines must convert the wedge into a
+    StallFailure — an emergency save can be late, never unbounded."""
+    spec = _ready("saver_stall", int(epoch), mode="at_least")
+    if spec is not None:
+        _fire(spec, "stalling the async saver thread")
+        time.sleep(3600.0)
+
+
+def _flip_byte(path: str, offset: Optional[int] = None) -> None:
+    """Flip one byte in place (mid-file by default) + fsync."""
     with open(path, "r+b") as f:
         f.seek(0, os.SEEK_END)
-        mid = f.tell() // 2
-        f.seek(mid)
+        off = f.tell() // 2 if offset is None else offset
+        f.seek(off)
         b = f.read(1)
-        f.seek(mid)
+        f.seek(off)
         f.write(bytes([b[0] ^ 0xFF]))
         f.flush()
         os.fsync(f.fileno())
+
+
+def maybe_corrupt_checkpoint(path: str, epoch: int) -> None:
+    """After a committed rotation save: corrupt the COMMIT RECORD —
+    v3 directory: the manifest's first byte (unparseable JSON);
+    legacy file: one mid-file byte — then SIGKILL.  The restarted run
+    must detect CheckpointCorrupt and fall back to the previous
+    checkpoint."""
+    spec = _ready("bitflip_checkpoint", int(epoch), mode="at_least")
+    if spec is None:
+        return
+    target, off = path, None
+    if os.path.isdir(path):
+        target, off = os.path.join(path, "MANIFEST.json"), 0
+    _fire(spec, f"bit-flipped {os.path.basename(target)}, then "
+                f"SIGKILL", path=target)
+    _flip_byte(target, off)
+    os.kill(os.getpid(), signal.SIGKILL)
+
+
+def maybe_corrupt_shard(path: str, epoch: int) -> None:
+    """After a committed rotation save: flip one byte of a SHARD file
+    inside the v3 directory, then SIGKILL — the restore scan must
+    catch the manifest-vs-shard CRC mismatch and fall back (the
+    manifest itself stays intact, which is exactly what makes this a
+    different drill from bitflip_checkpoint)."""
+    spec = _ready("shard_corrupt", int(epoch), mode="at_least")
+    if spec is None:
+        return
+    target = path
+    if os.path.isdir(path):
+        shards = sorted(n for n in os.listdir(path)
+                        if n.startswith("shard_")
+                        and n.endswith(".npz"))
+        if not shards:
+            return
+        target = os.path.join(path, shards[0])
+    _fire(spec, f"bit-flipped shard {os.path.basename(target)}, then "
+                f"SIGKILL", path=target)
+    _flip_byte(target)
     os.kill(os.getpid(), signal.SIGKILL)
 
 

@@ -2,15 +2,16 @@
 
 Grown out of ``utils/resilience.py`` (which remains as a compat shim)
 into the resilience subsystem's driver: :func:`train_with_recovery`
-now serves BOTH trainers (the distributed path checkpoints replicated
-state once via utils/checkpoint.py and restores through the partition
-rebuild), retries every *recoverable* failure class — numeric
-poisoning (:class:`NumericFailure`), watchdog-detected stalls
+now serves BOTH trainers (the distributed path checkpoints through
+the sharded v3 format in utils/checkpoint.py and restores through the
+partition rebuild), retries every *recoverable* failure class —
+numeric poisoning (:class:`NumericFailure`), watchdog-detected stalls
 (:class:`StallFailure`, obs/heartbeat.py), and transient I/O errors
 (``OSError``, e.g. the streamed tier's staging path) — and cooperates
 with the preemption guard (:mod:`roc_tpu.resilience.preempt`): a
 Preempted raise writes an emergency checkpoint through the SAME
-rotation and propagates, so the CLI can exit restartable.
+rotation (flushed, when the rotation saves asynchronously) and
+propagates, so the CLI can exit restartable.
 
 Every decision leaves a dated ``resilience`` event; the drill matrix
 (tests/test_drills.py) proves each failure class end to end.
@@ -20,12 +21,14 @@ from __future__ import annotations
 
 import math
 import os
+import shutil
+import time
 from typing import Callable, Dict, List, Optional
 
 from ..obs.events import emit
 from ..obs.heartbeat import StallFailure
 from ..utils.checkpoint import (CheckpointCorrupt, checkpoint_trainer,
-                                restore_trainer)
+                                is_committed, restore_trainer)
 from .preempt import Preempted
 
 
@@ -35,8 +38,9 @@ class NumericFailure(RuntimeError):
 
 # the failure classes the retry loop may restore-and-retry: numeric
 # poisoning (restored state discards the poison), watchdog-detected
-# stalls, and transient I/O (staging/storage hiccups).  Anything else
-# is a bug and must propagate.
+# stalls (a wedged async saver included), and transient I/O
+# (staging/storage hiccups).  Anything else is a bug and must
+# propagate.
 RECOVERABLE = (NumericFailure, StallFailure, OSError)
 
 
@@ -86,60 +90,183 @@ def check_params_finite(params, opt_state=None) -> None:
 
 class CheckpointRotation:
     """Keep the most recent ``keep`` checkpoints of a trainer as
-    ``<prefix>.<epoch>.npz`` (saves are atomic via checkpoint.py).
+    ``<prefix>.<epoch>`` v3 directories (two-phase commit via
+    checkpoint.py; legacy ``<prefix>.<epoch>.npz`` files from older
+    rotations are still scanned, restored, and pruned).
 
     ``save`` finite-checks params AND optimizer state (one device
-    sync, :func:`check_params_finite` via ``checkpoint_trainer`` —
-    the guard covers EVERY trainer save, not just rotation rounds) so
-    a poisoned state is never persisted; ``restore_latest`` validates
-    integrity on the way
-    in and falls back to the next-newest checkpoint when the newest is
-    corrupt (:class:`~roc_tpu.utils.checkpoint.CheckpointCorrupt`),
-    with a dated resilience event either way."""
+    sync, :func:`check_params_finite` — the guard covers EVERY
+    trainer save) so a poisoned state is never persisted.  With
+    ``async_save=True`` the step path pays only the finite guard +
+    host snapshot; CRC + write + manifest commit (and the keep-window
+    prune, which must follow the commit) run on the
+    :class:`~roc_tpu.resilience.async_save.AsyncSaver` thread —
+    ``flush()`` is the emergency-save barrier and ``drain()`` the
+    shutdown path.  Async saving is single-writer by construction:
+    a snapshot sharded across processes falls back to the synchronous
+    barrier'd save with a dated event (coalescing decisions cannot be
+    assumed identical across SPMD processes).
 
-    def __init__(self, prefix: str, keep: int = 3):
+    ``restore_latest`` validates integrity on the way in — for a v3
+    candidate that means the committed manifest AND every listed
+    shard's bytes/CRC/coverage before anything touches the trainer —
+    and falls back to the next-newest checkpoint when the newest is
+    corrupt (:class:`~roc_tpu.utils.checkpoint.CheckpointCorrupt`),
+    with a dated resilience event either way.  An uncommitted save
+    (no manifest) is structurally invisible to the scan."""
+
+    def __init__(self, prefix: str, keep: int = 3,
+                 async_save: bool = False):
         self.prefix = prefix
         self.keep = keep
+        self.async_save = bool(async_save)
+        self._saver = None
+        self.last_block_ms: Optional[float] = None
 
     def path(self, epoch: int) -> str:
-        return f"{self.prefix}.{epoch}.npz"
+        return f"{self.prefix}.{epoch}"
+
+    def path_for(self, epoch: int) -> str:
+        """The on-disk artifact serving ``epoch``: the COMMITTED v3
+        directory when present, else the legacy single file (an
+        uncommitted/torn v3 directory must never shadow a legacy
+        checkpoint of the same epoch)."""
+        p = self.path(epoch)
+        if is_committed(p):
+            return p
+        legacy = p + ".npz"
+        if os.path.isfile(legacy):
+            return legacy
+        return p
 
     def existing(self) -> List[int]:
         d = os.path.dirname(self.prefix) or "."
         base = os.path.basename(self.prefix)
-        out = []
+        out = set()
         if not os.path.isdir(d):
-            return out
+            return []
         for name in os.listdir(d):
-            # in-flight ``.npz.tmp`` writers are structurally excluded
-            # (suffix + random mkstemp name): a save killed mid-write
-            # can never be restored (tests/test_drills.py kill_in_save)
-            if name.startswith(base + ".") and name.endswith(".npz"):
-                mid = name[len(base) + 1:-4]
-                if mid.isdigit():
-                    out.append(int(mid))
+            if not name.startswith(base + "."):
+                continue
+            mid = name[len(base) + 1:]
+            if mid.isdigit():
+                # v3 directory — only a COMMITTED one exists to the
+                # rotation; in-flight/torn saves (shards, tmp files,
+                # no manifest) are structurally excluded
+                if is_committed(os.path.join(d, name)):
+                    out.add(int(mid))
+            elif mid.endswith(".npz") and mid[:-4].isdigit():
+                # legacy v1/v2 single file; in-flight ``.npz.tmp``
+                # writers are excluded (suffix + random mkstemp name)
+                out.add(int(mid[:-4]))
         return sorted(out)
 
-    def save(self, trainer) -> str:
-        p = self.path(trainer.epoch)
-        # checkpoint_trainer runs the single-sync finite guard over
-        # params + opt state before anything touches disk
-        checkpoint_trainer(trainer, p)
+    # ------------------------------------------------------ async saver
+
+    def saver(self):
+        """The lazily spawned background saver (async mode only)."""
+        if self._saver is None:
+            from .async_save import AsyncSaver
+            self._saver = AsyncSaver()
+        return self._saver
+
+    def flush(self, timeout_s: Optional[float] = None) -> None:
+        """Barrier: all submitted saves committed (no-op when saving
+        synchronously).  The emergency/preemption save path calls
+        this so 'checkpoint saved' means ON DISK."""
+        if self._saver is not None:
+            self._saver.flush(timeout_s)
+
+    def drain(self, timeout_s: Optional[float] = None) -> None:
+        """Shutdown: flush + stop + join the saver thread."""
+        if self._saver is not None:
+            self._saver.drain(timeout_s)
+
+    def save_stats(self) -> Dict:
+        """Saver counters/records (empty when synchronous)."""
+        if self._saver is None:
+            return {"saved": 0, "superseded": 0, "saves": []}
+        return self._saver.stats()
+
+    # ------------------------------------------------------ save/prune
+
+    def _prune(self) -> None:
+        """Drop checkpoints beyond the keep window.  Runs AFTER a
+        commit (in async mode, on the saver thread post-commit): the
+        guarantee 'a complete checkpoint always exists' would not
+        survive pruning ahead of an uncommitted save.  Process 0 only
+        — under multi-process SPMD every process scans one shared
+        rotation."""
+        import jax
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return
         for old in self.existing()[:-self.keep]:
-            try:
-                os.remove(self.path(old))
-            # best-effort prune: a leftover old checkpoint wastes disk
-            # but harms nothing, and the next save retries the prune
-            except OSError:   # roc-lint: ok=swallowed-exception
-                pass
+            # both forms: a migrating rotation may hold a v3 dir AND
+            # a legacy file for one epoch
+            for p in (self.path(old), self.path(old) + ".npz"):
+                try:
+                    if os.path.isdir(p):
+                        shutil.rmtree(p)
+                    elif os.path.isfile(p):
+                        os.remove(p)
+                # best-effort prune: a leftover old checkpoint wastes
+                # disk but harms nothing; the next save retries it
+                except OSError:   # roc-lint: ok=swallowed-exception
+                    pass
+
+    def save(self, trainer) -> str:
+        """Persist the trainer's state as ``<prefix>.<epoch>``.  Sync
+        mode: the save is committed when this returns.  Async mode:
+        only the finite guard + host snapshot run here (the step-path
+        blocked time, recorded as ``last_block_ms``); the commit
+        happens in the background — ``flush()`` to wait for it."""
+        p = self.path(trainer.epoch)
+        if not self.async_save:
+            # checkpoint_trainer runs the single-sync finite guard
+            # over params + opt state before anything touches disk
+            checkpoint_trainer(trainer, p)
+            self._prune()
+            return p
+        from ..utils.checkpoint import snapshot_trainer
+        t0 = time.perf_counter()
+        check_params_finite(trainer.params, trainer.opt_state)
+        snap = snapshot_trainer(trainer)
+        self.last_block_ms = snap.block_ms = round(
+            (time.perf_counter() - t0) * 1e3, 3)
+        if len(snap.writer_procs) > 1:
+            # sharded across processes: coalescing decisions are
+            # timing-dependent and would diverge between processes —
+            # the commit barrier then deadlocks.  Save synchronously.
+            emit("checkpoint",
+                 f"async save: snapshot is sharded across "
+                 f"{len(snap.writer_procs)} processes — saving "
+                 f"synchronously (the commit barrier needs every "
+                 f"process in lockstep)", kind="sync_fallback",
+                 epoch=trainer.epoch)
+            from ..utils.checkpoint import write_snapshot
+            write_snapshot(p, snap)
+            self._prune()
+            return p
+        # the keep-window prune rides the saver thread, strictly
+        # AFTER this snapshot's commit — pruning ahead of an
+        # uncommitted save could leave zero complete checkpoints
+        self.saver().submit(snap, p, on_commit=self._prune)
         return p
 
     def restore_latest(self, trainer,
                        only_if_ahead: bool = False) -> Optional[int]:
         """Restore the newest intact checkpoint into ``trainer``;
-        returns its epoch or None if none restored.  ``only_if_ahead``
-        skips the restore when the trainer has already progressed past
-        the newest checkpoint (never rewind live progress)."""
+        returns its epoch or None if none restored.  Every candidate
+        is FULLY validated (v3: manifest + every listed shard CRC +
+        coverage) before it can be selected — a manifest whose shard
+        went missing falls through to the next-newest checkpoint like
+        any other corruption.  ``only_if_ahead`` skips the restore
+        when the trainer has already progressed past the newest
+        checkpoint (never rewind live progress)."""
+        # an in-flight async save must land (or fail loudly) before
+        # the scan: restoring around a half-written newest checkpoint
+        # would race its commit
+        self.flush()
         epochs = self.existing()
         if not epochs:
             return None
@@ -151,7 +278,7 @@ class CheckpointRotation:
                 # fallback is at/behind the live trainer — rewinding
                 # live progress is exactly what only_if_ahead forbids
                 return None
-            path = self.path(ep)
+            path = self.path_for(ep)
             try:
                 restore_trainer(trainer, path)
                 return ep
@@ -182,62 +309,100 @@ def train_with_recovery(trainer, target_epoch: int,
     deterministically replay the same failing trajectory (dropout
     masks included).  A :class:`~roc_tpu.resilience.preempt.Preempted`
     raise is NOT retried: it writes an emergency checkpoint through
-    the same rotation and propagates, so the caller exits with the
-    restartable code.
+    the same rotation (FLUSHED — 'emergency checkpoint saved' must
+    mean on disk) and propagates, so the caller exits with the
+    restartable code.  An async rotation is drained on the way out;
+    a wedged saver surfaces as StallFailure (exit 75), never a hang.
     """
     import jax
+    from . import inject
     history: List[Dict[str, float]] = []
     # resume a crashed run, but never rewind a live trainer that is
     # already past the newest checkpoint
     rotation.restore_latest(trainer, only_if_ahead=True)
     retries = 0
-    while trainer.epoch < target_epoch:
-        round_epochs = min(checkpoint_every, target_epoch - trainer.epoch)
-        try:
-            hist = trainer.train(epochs=round_epochs)
-            for m in hist:
-                check_finite(m)
-            # save() validates params+opt state finiteness (one sync)
-            # before persisting — a NaN that arose between the round's
-            # last eval and the boundary is caught here, BEFORE the
-            # round's records join the returned history (a refused
-            # round is retried, so keeping its metrics would duplicate
-            # the replayed epochs)
-            path = rotation.save(trainer)
-            history.extend(hist)
-            retries = 0
-            from . import inject
-            inject.maybe_corrupt_checkpoint(path, trainer.epoch)
-        except Preempted as e:
-            # emergency checkpoint through the SAME rotation; a
-            # poisoned state still refuses to persist (the previous
-            # good checkpoint then serves the restart)
-            saved: Optional[str]
+    try:
+        while trainer.epoch < target_epoch:
+            round_epochs = min(checkpoint_every,
+                               target_epoch - trainer.epoch)
             try:
-                saved = rotation.save(trainer)
-            except NumericFailure:
-                saved = None
-            emit("resilience",
-                 f"preempted at epoch {trainer.epoch}: "
-                 + (f"emergency checkpoint {os.path.basename(saved)}"
-                    if saved else "state non-finite, not persisted")
-                 + " — exiting restartable", kind="preempt",
-                 epoch=trainer.epoch, checkpoint=saved,
-                 reason=str(e))
-            raise
-        except RECOVERABLE as e:
-            if on_failure:
-                on_failure(e)
-            retries += 1
-            emit("resilience",
-                 f"recovering from {type(e).__name__} at epoch "
-                 f"{trainer.epoch} (retry {retries}/{max_retries}): "
-                 f"{e}", kind="recovery", error=type(e).__name__,
-                 epoch=trainer.epoch, retry=retries,
-                 max_retries=max_retries)
-            if retries > max_retries:
+                hist = trainer.train(epochs=round_epochs)
+                for m in hist:
+                    check_finite(m)
+                # save() validates params+opt state finiteness (one
+                # sync) before persisting — a NaN that arose between
+                # the round's last eval and the boundary is caught
+                # here, BEFORE the round's records join the returned
+                # history (a refused round is retried, so keeping its
+                # metrics would duplicate the replayed epochs)
+                path = rotation.save(trainer)
+                history.extend(hist)
+                retries = 0
+                spec = inject.current()
+                if spec is not None and not spec.fired:
+                    # drills that act on the just-saved artifact
+                    # (bitflip/shard corruption) need it COMMITTED;
+                    # an armed saver-side site fires inside this
+                    # flush, which is exactly the point
+                    rotation.flush()
+                inject.maybe_corrupt_checkpoint(path, trainer.epoch)
+                inject.maybe_corrupt_shard(path, trainer.epoch)
+            except Preempted as e:
+                # emergency checkpoint through the SAME rotation,
+                # flushed; a poisoned state still refuses to persist
+                # (the previous good checkpoint then serves the
+                # restart)
+                saved: Optional[str]
+                try:
+                    saved = rotation.save(trainer)
+                    rotation.flush()
+                except NumericFailure:
+                    saved = None
+                emit("resilience",
+                     f"preempted at epoch {trainer.epoch}: "
+                     + (f"emergency checkpoint "
+                        f"{os.path.basename(saved)}"
+                        if saved else "state non-finite, not persisted")
+                     + " — exiting restartable", kind="preempt",
+                     epoch=trainer.epoch, checkpoint=saved,
+                     reason=str(e))
                 raise
-            if rotation.restore_latest(trainer) is None:
+            except RECOVERABLE as e:
+                if on_failure:
+                    on_failure(e)
+                retries += 1
+                emit("resilience",
+                     f"recovering from {type(e).__name__} at epoch "
+                     f"{trainer.epoch} (retry {retries}/{max_retries}): "
+                     f"{e}", kind="recovery", error=type(e).__name__,
+                     epoch=trainer.epoch, retry=retries,
+                     max_retries=max_retries)
+                if retries > max_retries:
+                    raise
+                if rotation.restore_latest(trainer) is None:
+                    raise
+                trainer.key = jax.random.fold_in(trainer.key, retries)
+    finally:
+        # shutdown path for the async saver: every accepted save
+        # committed (or a loud StallFailure/IO error — the CLI maps
+        # those to the restartable exit).  While another exception is
+        # already propagating, a drain failure must not MASK it —
+        # report and let the original fly.  The propagation test is
+        # exc_info BEFORE the drain: a stored background error may
+        # carry its own pre-existing __context__ chain from the saver
+        # thread, which says nothing about THIS control flow.
+        import sys as _sys
+        propagating = _sys.exc_info()[0] is not None
+        try:
+            rotation.drain()
+        except Exception as de:  # noqa: BLE001 - see below
+            if not propagating:
+                # clean path: the drain failure IS the outcome (a
+                # wedged saver exits restartable via StallFailure, a
+                # failed final save via OSError)
                 raise
-            trainer.key = jax.random.fold_in(trainer.key, retries)
+            emit("resilience",
+                 f"saver drain failed during exception teardown: "
+                 f"{type(de).__name__}: {de}", kind="saver_error",
+                 error=type(de).__name__)
     return history

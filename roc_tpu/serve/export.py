@@ -359,7 +359,8 @@ def parse_args(argv: Optional[List[str]] = None):
     ap.add_argument("--out", required=True,
                     help="artifact directory (created)")
     ap.add_argument("--checkpoint", default=None,
-                    help="training checkpoint (.npz) to export; "
+                    help="training checkpoint (v3 directory or "
+                         "legacy .npz) to export; "
                          "omitted = fresh Glorot weights (latency "
                          "rehearsal only — the export says so loudly)")
     ap.add_argument("--backend", default="auto",

@@ -179,8 +179,11 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                     help="checkpoint-restart recovery "
                          "(roc_tpu/resilience): train in checkpointed "
                          "rounds under a keep-last-3 rotation at the "
-                         "--checkpoint PREFIX (files "
-                         "<prefix>.<epoch>.npz), resume from the "
+                         "--checkpoint PREFIX (v3 checkpoint "
+                         "directories <prefix>.<epoch>/ with "
+                         "per-process shard files and a committed "
+                         "MANIFEST.json; legacy .npz checkpoints "
+                         "still restore), resume from the "
                          "newest intact checkpoint on start — "
                          "re-invoking the identical command after ANY "
                          "crash continues the run, including onto a "
@@ -202,14 +205,28 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                          "writes an emergency checkpoint, and exits "
                          "75 (restartable); a second signal kills "
                          "immediately")
+    ap.add_argument("--async-save", default="auto",
+                    choices=["auto", "on", "off"],
+                    dest="async_save",
+                    help="asynchronous checkpointing (resilience/"
+                         "async_save.py): the recovery rotation's "
+                         "saves run CRC+write+commit on a background "
+                         "saver thread (bounded queue depth 1, newer "
+                         "snapshot supersedes a queued one) — the "
+                         "step path pays only the finite guard + "
+                         "host snapshot.  'auto' (default) = on when "
+                         "single-process, off under multi-process "
+                         "SPMD; emergency/preemption saves are "
+                         "always flushed before exit")
     ap.add_argument("--fault", type=str, default=None,
                     help="fault-injection drill (resilience/"
                          "inject.py): arm ONE fault as "
                          "site:epoch[:proc] — sites nan_grads, "
                          "sigkill, sigterm, kill_in_save, "
-                         "bitflip_checkpoint, staging_io, "
-                         "stall_compile.  Equivalent env: "
-                         "ROC_TPU_FAULT")
+                         "kill_in_async_save, shard_corrupt, "
+                         "saver_stall, bitflip_checkpoint, "
+                         "staging_io, stall_compile.  Equivalent "
+                         "env: ROC_TPU_FAULT")
     ap.add_argument("--eval-only", action="store_true",
                     help="run one inference pass (the reference's "
                          "every-5th-epoch infer, gnn.cc:107-110, as a "
@@ -313,8 +330,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if args.recovery and not args.checkpoint:
         print("error: --recovery needs --checkpoint PREFIX (the "
-              "rotation writes <prefix>.<epoch>.npz files there)",
-              file=sys.stderr)
+              "rotation writes <prefix>.<epoch>/ checkpoint "
+              "directories there)", file=sys.stderr)
         return 2
     if args.max_retries < 0:
         print("error: --max-retries must be >= 0", file=sys.stderr)
@@ -446,7 +463,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefetch=args.prefetch, partition=args.partition,
         rebalance=args.rebalance, head_chunk=args.head_chunk,
         cache_min_compile_secs=args.cache_min_secs,
-        fault=args.fault,
+        async_save=args.async_save, fault=args.fault,
         dtype=dt, compute_dtype=cdt, metrics_path=args.metrics)
 
     from ..obs.heartbeat import StallFailure
@@ -527,7 +544,10 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.recovery:
             from ..resilience.recovery import (CheckpointRotation,
                                                train_with_recovery)
-            rotation = CheckpointRotation(args.checkpoint, keep=3)
+            from .trainer import resolve_async_save
+            rotation = CheckpointRotation(
+                args.checkpoint, keep=3,
+                async_save=resolve_async_save(cfg))
             every = (args.checkpoint_every if args.checkpoint_every > 0
                      else max(args.eval_every, 1))
             train_with_recovery(trainer, args.epochs, rotation,

@@ -178,6 +178,16 @@ class TrainConfig:
     # (CLI/bench) that enable the cache — trainers never touch the
     # cache themselves.
     cache_min_compile_secs: Optional[float] = None
+    # Async checkpointing (resilience/async_save.py): 'auto' (default)
+    # saves asynchronously when this job is single-process — the step
+    # path pays only the finite guard + host snapshot while CRC +
+    # shard write + manifest commit overlap the next epochs on the
+    # saver thread; 'on'/'off' force it.  Multi-process 'auto'
+    # resolves OFF: async coalescing decisions are timing-dependent
+    # and cannot be assumed identical across SPMD processes (the
+    # sharded save's commit barrier needs lockstep), so shared
+    # rotations save synchronously unless forced.
+    async_save: Any = "auto"
     # Fault injection (resilience/inject.py): arm ONE drill fault for
     # this process as "site:epoch[:proc]" (sites: nan_grads, sigkill,
     # sigterm, kill_in_save, bitflip_checkpoint, staging_io,
@@ -251,6 +261,27 @@ def resolve_head_chunk(config: TrainConfig, num_rows: int) -> int:
     if block < 0:
         raise ValueError(f"head_chunk must be >= 0, got {block}")
     return 0 if block >= num_rows else block
+
+
+def resolve_async_save(config: TrainConfig) -> bool:
+    """``TrainConfig.async_save`` -> the concrete saver mode the
+    rotation is constructed with.  ONE validator — the CLI routes
+    --async-save through this same function.  'auto' enables the
+    async saver exactly when the job is single-process (see the
+    config field's comment for why multi-process resolves off);
+    'on'/'off' (or bools) are literal."""
+    v = config.async_save
+    if isinstance(v, bool):
+        return v
+    if v == "on":
+        return True
+    if v == "off":
+        return False
+    if v == "auto":
+        import jax
+        return jax.process_count() == 1
+    raise ValueError(f"unknown async_save {v!r}; expected 'auto', "
+                     "'on', or 'off'")
 
 
 def resolve_partition(config: TrainConfig) -> str:

@@ -269,9 +269,14 @@ def test_gcn_stage_checkpoint_resume(tmp_path):
         "    stage='small')\n"
         "r1 = bench.child_gcn(args, 256, 2048)\n"
         "assert r1['resumed_from_epoch'] is None, r1\n"
-        "# the post-warmup rotation checkpoint exists\n"
+        "# the async checkpoint-cost row landed\n"
+        "assert r1['ckpt_block_ms'] is not None, r1\n"
+        "assert r1['ckpt_save_ms'] is not None, r1\n"
+        "# the post-warmup rotation checkpoint exists (v3 committed\n"
+        "# directory)\n"
         "import glob\n"
-        "cks = glob.glob(bench._gcn_ck_prefix('small') + '.*.npz')\n"
+        "cks = glob.glob(bench._gcn_ck_prefix('small')\n"
+        "                + '.*/MANIFEST.json')\n"
         "assert cks, 'no rotation checkpoint written'\n"
         "# attempt 2 (same parent round): resumes from the rotation\n"
         "args2 = types.SimpleNamespace(cpu=True, layers='12-8-3',\n"
@@ -282,7 +287,7 @@ def test_gcn_stage_checkpoint_resume(tmp_path):
         "assert r2['resumed_from_epoch'] >= 2, r2\n"
         "# fresh ROUND: the parent clears the rotation first\n"
         "bench._clear_gcn_checkpoints('small')\n"
-        "assert not glob.glob(bench._gcn_ck_prefix('small') + '.*.npz')\n"
+        "assert not glob.glob(bench._gcn_ck_prefix('small') + '.*')\n"
         "# the resume evidence rides the progress file into partials\n"
         "prog = bench._read_probe_progress()\n"
         "assert bench._progress_resumed_epoch(prog) == "

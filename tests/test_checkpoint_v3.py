@@ -1,0 +1,382 @@
+"""Checkpoint format v3 + async saver tests (ISSUE 15): sharded
+saves with the two-phase commit protocol, gather-on-restore across
+mesh layouts, and the async saver's contract — coalescing queue,
+flush barrier, bounded stalls, bit-identical results, and the
+step-path-blocked-time acceptance pin.  The crash drills (SIGKILL in
+every commit window, corrupt shards, wedged saver, DCN variants)
+live in tests/test_drills.py."""
+
+import contextlib
+import os
+import time
+
+import numpy as np
+import pytest
+
+from roc_tpu.utils.checkpoint import (CheckpointCorrupt, _load_v3,
+                                      read_manifest, save_checkpoint,
+                                      snapshot_state, write_snapshot)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _shed_native_jit_state():
+    yield
+    import jax
+    jax.clear_caches()
+
+
+@contextlib.contextmanager
+def _capture_events():
+    from roc_tpu.obs.events import get_bus
+
+    class _Cap:
+        def __init__(self):
+            self.records = []
+
+        def write(self, rec):
+            self.records.append(dict(rec))
+
+        def close(self):
+            pass
+
+    bus = get_bus()
+    cap = _Cap()
+    bus.add_sink(cap)
+    try:
+        yield cap.records
+    finally:
+        bus.sinks.remove(cap)
+
+
+def _tree(scale=1, seed=0):
+    """A params-like host tree (flat name → array, the shape every
+    model's init_params produces)."""
+    rng = np.random.RandomState(seed)
+    return {f"w{i}": rng.rand(64 * scale, 32).astype(np.float32)
+            for i in range(3)}
+
+
+class _FakeTrainer:
+    """The minimal surface CheckpointRotation.save/restore touch —
+    lets the saver tests run without paying a model compile."""
+
+    def __init__(self, scale=1, seed=0, epoch=0):
+        import jax
+        import jax.numpy as jnp
+        from roc_tpu.train.optimizer import adam_init
+        self.params = {k: jnp.asarray(v)
+                       for k, v in _tree(scale, seed).items()}
+        self.opt_state = adam_init(self.params)
+        self.epoch = epoch
+        self.key = jax.random.PRNGKey(seed)
+
+
+# ------------------------------------------------ sharded save/restore
+
+def test_sharded_save_gathers_on_restore(tmp_path):
+    """A P('parts')-sharded tree saved at parts=2 reassembles to the
+    full host arrays on load (gather-on-restore), and re-places onto
+    a DIFFERENT parts=4 mesh bit-exactly — the elastic cross-P
+    restore at the array level, P in {2, 4}."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from roc_tpu.parallel import multihost as mh
+
+    x = np.arange(64 * 8, dtype=np.float32).reshape(64, 8)
+    y = np.arange(32, dtype=np.float32)
+    mesh2 = mh.make_parts_mesh(2)
+    sharded = {
+        "w": jax.device_put(jnp.asarray(x),
+                            NamedSharding(mesh2, P("parts"))),
+        "b": jax.device_put(jnp.asarray(y), NamedSharding(mesh2, P())),
+    }
+    snap = snapshot_state(sharded, {"m": sharded["w"]}, epoch=5)
+    p = str(tmp_path / "ck.5")
+    write_snapshot(p, snap)
+    data, doc = _load_v3(p)
+    assert doc["epoch"] == 5
+    np.testing.assert_array_equal(data["params['w']"], x)
+    np.testing.assert_array_equal(data["params['b']"], y)
+    np.testing.assert_array_equal(data["opt['m']"], x)
+    # elastic: the gathered array re-places onto a parts=4 layout
+    mesh4 = mh.make_parts_mesh(4)
+    w4 = jax.device_put(jnp.asarray(data["params['w']"]),
+                        NamedSharding(mesh4, P("parts")))
+    np.testing.assert_array_equal(np.asarray(w4), x)
+
+
+def test_sharded_shard_header_carries_spec_and_indices(tmp_path):
+    """Per-shard headers speak the PR-14 sharding-spec vocabulary:
+    the 'parts' axis name on the sharded dim, per-piece [lo, hi)
+    index ranges that tile the global shape."""
+    import json
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from roc_tpu.parallel import multihost as mh
+
+    mesh = mh.make_parts_mesh(4)
+    x = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
+    xs = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("parts")))
+    snap = snapshot_state({"w": xs}, {}, epoch=1)
+    p = str(tmp_path / "ck.1")
+    write_snapshot(p, snap)
+    with np.load(os.path.join(p, "shard_00000.npz")) as z:
+        header = json.loads(bytes(
+            np.asarray(z["__header__"], dtype=np.uint8)).decode())
+    meta = header["arrays"]["params['w']"]
+    assert meta["spec"] == ["parts", None]
+    assert meta["shape"] == [64, 4]
+    pieces = [pm for pm in header["pieces"].values()
+              if pm["key"] == "params['w']"]
+    assert len(pieces) == 4  # one canonical piece per mesh slot
+    rows = sorted(tuple(pm["index"][0]) for pm in pieces)
+    assert rows == [(0, 16), (16, 32), (32, 48), (48, 64)]
+    assert all(tuple(pm["index"][1]) == (0, 4) for pm in pieces)
+
+
+def test_incomplete_sharded_coverage_is_corrupt(tmp_path):
+    """A save whose pieces do not tile an array (a lost shard piece)
+    must fail the coverage proof, not silently zero-fill."""
+    snap = snapshot_state({"w": np.ones((8, 2), np.float32)}, {},
+                          epoch=0)
+    # drop rows [4, 8): simulate a piece that never landed
+    keep = snap.pieces[0]
+    keep.index = [[0, 4], [0, 2]]
+    keep.data = keep.data[:4]
+    keep.member = "params['w']@0"
+    p = str(tmp_path / "ck.0")
+    write_snapshot(p, snap)
+    with pytest.raises(CheckpointCorrupt, match="gathered"):
+        _load_v3(p)
+
+
+def test_recommit_uncommits_first(tmp_path):
+    """Re-saving an epoch (a replayed recovery round) removes the old
+    manifest BEFORE rewriting shards: a crash mid-rewrite leaves an
+    invisible directory, never a manifest pointing at half-replaced
+    shards."""
+    tree = _tree()
+    p = str(tmp_path / "ck.3")
+    save_checkpoint(p, tree, {"m": tree["w0"]}, epoch=3)
+    man1 = read_manifest(p)
+    save_checkpoint(p, {k: v + 1 for k, v in tree.items()},
+                    {"m": tree["w0"]}, epoch=3)
+    man2 = read_manifest(p)
+    assert man2["shards"][0]["crc32"] != man1["shards"][0]["crc32"]
+    data, _ = _load_v3(p)
+    np.testing.assert_array_equal(data["params['w0']"],
+                                  tree["w0"] + 1)
+
+
+# ------------------------------------------------------- async saver
+
+def test_async_vs_sync_bit_identical(tmp_path):
+    """The satellite pin: async save -> restore yields byte-identical
+    state to the synchronous save of the same trainer."""
+    from roc_tpu.resilience.recovery import CheckpointRotation
+    tr = _FakeTrainer(epoch=4)
+    sync_p = str(tmp_path / "sync" / "ck.4")
+    save_checkpoint(sync_p, tr.params, tr.opt_state, tr.epoch, tr.key)
+    rot = CheckpointRotation(str(tmp_path / "async" / "ck"), keep=2,
+                             async_save=True)
+    async_p = rot.save(tr)
+    rot.drain()
+    a, _ = _load_v3(sync_p)
+    b, _ = _load_v3(async_p)
+    assert sorted(a) == sorted(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_coalescing_drops_superseded_snapshot(tmp_path):
+    """Queue depth 1: with the saver wedged on save N, submitting
+    N+1 then N+2 drops N+1 (dated ``superseded`` event) and commits
+    N+2 — asserted via events, per the satellite."""
+    from roc_tpu.resilience.async_save import AsyncSaver
+    import threading
+    from roc_tpu.utils import checkpoint as ck
+
+    gate = threading.Event()
+    orig = ck.write_snapshot
+
+    def slow_write(path, snap):
+        if snap.epoch == 0:
+            gate.wait(timeout=30.0)
+        return orig(path, snap)
+
+    saver = AsyncSaver()
+    tree = _tree()
+    snaps = [snapshot_state(tree, {}, epoch=e) for e in range(3)]
+    # the saver imports write_snapshot lazily from utils.checkpoint
+    # per save — patching at the source module intercepts it
+    ck.write_snapshot = slow_write
+    try:
+        with _capture_events() as recs:
+            saver.submit(snaps[0], str(tmp_path / "ck.0"))
+            # wait until save 0 is actually in flight, so 1 and 2
+            # both land in the (depth-1) queue slot
+            deadline = time.monotonic() + 10.0
+            while not saver.stats()["busy"]:
+                assert time.monotonic() < deadline
+                time.sleep(0.005)
+            saver.submit(snaps[1], str(tmp_path / "ck.1"))
+            saver.submit(snaps[2], str(tmp_path / "ck.2"))
+            gate.set()
+            saver.drain()
+    finally:
+        ck.write_snapshot = orig
+    sup = [r for r in recs if r.get("cat") == "checkpoint"
+           and r.get("kind") == "superseded"]
+    assert len(sup) == 1 and sup[0]["epoch"] == 1 and sup[0]["by"] == 2
+    assert os.path.isdir(str(tmp_path / "ck.0"))
+    assert not os.path.exists(str(tmp_path / "ck.1"))
+    assert os.path.isdir(str(tmp_path / "ck.2"))
+    st = saver.stats()
+    assert st["saved"] == 2 and st["superseded"] == 1
+
+
+def test_flush_bounds_wedged_saver(tmp_path):
+    """flush() is deadline-bounded: a wedged saver surfaces as
+    StallFailure within the bound — the emergency-save latency
+    guarantee — and drain() abandons the daemon thread."""
+    from roc_tpu.obs.heartbeat import StallFailure
+    from roc_tpu.resilience.async_save import AsyncSaver
+    from roc_tpu.utils import checkpoint as ck
+    import threading
+
+    gate = threading.Event()
+    orig = ck.write_snapshot
+    ck.write_snapshot = lambda path, snap: gate.wait(timeout=60.0)
+    saver = AsyncSaver()
+    try:
+        saver.submit(snapshot_state(_tree(), {}, epoch=0),
+                     str(tmp_path / "ck.0"))
+        t0 = time.monotonic()
+        with pytest.raises(StallFailure):
+            saver.flush(timeout_s=0.5)
+        assert time.monotonic() - t0 < 5.0
+        with pytest.raises(StallFailure):
+            saver.drain(timeout_s=0.2)
+    finally:
+        gate.set()
+        ck.write_snapshot = orig
+
+
+def test_background_failure_surfaces_on_next_flush(tmp_path):
+    """An async save that fails in the background is stored and
+    re-raised on the next flush — never silent."""
+    from roc_tpu.resilience.async_save import AsyncSaver
+    from roc_tpu.utils import checkpoint as ck
+
+    orig = ck.write_snapshot
+
+    def boom(path, snap):
+        raise OSError("injected background write failure")
+
+    ck.write_snapshot = boom
+    saver = AsyncSaver()
+    try:
+        with _capture_events() as recs:
+            saver.submit(snapshot_state(_tree(), {}, epoch=0),
+                         str(tmp_path / "ck.0"))
+            with pytest.raises(OSError, match="injected"):
+                saver.flush(timeout_s=10.0)
+        assert any(r.get("kind") == "saver_error" for r in recs)
+    finally:
+        ck.write_snapshot = orig
+        saver.drain(timeout_s=5.0)
+
+
+def test_async_block_under_quarter_of_sync_wall(tmp_path):
+    """The acceptance pin: the async save's step-path blocked time
+    (finite guard + host snapshot, CheckpointRotation.last_block_ms)
+    measures < 25% of the synchronous save's wall on the CPU rig,
+    evidenced by the new ``checkpoint`` events' block/save timings."""
+    import shutil
+    from roc_tpu.resilience.recovery import CheckpointRotation
+    from roc_tpu.utils.checkpoint import checkpoint_trainer
+    tr = _FakeTrainer(scale=64, epoch=1)   # ~2.3 MB params, 3x opt
+    rot = CheckpointRotation(str(tmp_path / "a" / "ck"), keep=2,
+                             async_save=True)
+    best_ratio = np.inf
+    for attempt in range(3):   # best-of-3: CI hosts stall arbitrarily
+        sync_p = str(tmp_path / f"s{attempt}" / "ck.1")
+        t0 = time.perf_counter()
+        checkpoint_trainer(tr, sync_p)
+        sync_ms = (time.perf_counter() - t0) * 1e3
+        shutil.rmtree(os.path.dirname(sync_p), ignore_errors=True)
+        with _capture_events() as recs:
+            rot.save(tr)
+            rot.flush()
+        saved = [r for r in recs if r.get("cat") == "checkpoint"
+                 and r.get("kind") == "saved"]
+        assert saved, recs
+        block_ms = saved[-1]["block_ms"]
+        assert saved[-1]["save_ms"] >= saved[-1]["write_ms"]
+        best_ratio = min(best_ratio, block_ms / max(sync_ms, 1e-6))
+        if best_ratio < 0.25:
+            break
+    rot.drain()
+    assert best_ratio < 0.25, \
+        f"async save blocked the step path {best_ratio:.0%} of the " \
+        f"sync wall (acceptance: < 25%)"
+
+
+def test_ckpt_spans_render_in_timeline(tmp_path):
+    """The saver's ckpt_write/ckpt_commit span laps merge into the
+    Perfetto trace on the process lane — the save visibly overlaps
+    the training bursts."""
+    from roc_tpu.obs.timeline import merge_timeline
+    from roc_tpu.resilience.recovery import CheckpointRotation
+    tr = _FakeTrainer(epoch=2)
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2,
+                             async_save=True)
+    with _capture_events() as recs:
+        rot.save(tr)
+        rot.flush()
+    rot.drain()
+    spans = [r for r in recs if r.get("cat") == "timeline"
+             and r.get("kind") == "spans"]
+    names = {lap[0] for r in spans for lap in r.get("spans", [])}
+    assert {"ckpt_write", "ckpt_commit"} <= names
+    trace = merge_timeline(recs, [])
+    tnames = {ev.get("name") for ev in trace["traceEvents"]}
+    assert {"ckpt_write", "ckpt_commit"} <= tnames
+
+
+def test_async_rotation_prunes_after_commit(tmp_path):
+    """The keep window holds under async saves, and pruning runs on
+    the saver thread strictly after the commit (the newest save can
+    never orphan the rotation)."""
+    from roc_tpu.resilience.recovery import CheckpointRotation
+    tr = _FakeTrainer()
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2,
+                             async_save=True)
+    for ep in (1, 2, 3, 4):
+        tr.epoch = ep
+        rot.save(tr)
+        rot.flush()
+    rot.drain()
+    assert rot.existing() == [3, 4]
+
+
+def test_async_save_adds_zero_compile_events(tmp_path):
+    """The async path compiles nothing: a full save+flush cycle emits
+    zero compile-observer events (program budgets stay at delta +0 —
+    the programspace gate pins the budgets themselves)."""
+    from roc_tpu.resilience.recovery import CheckpointRotation
+    tr = _FakeTrainer(epoch=1)
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2,
+                             async_save=True)
+    rot.save(tr)
+    rot.flush()   # warm the (pre-existing) finite-guard jit
+    with _capture_events() as recs:
+        tr.epoch = 2
+        rot.save(tr)
+        rot.flush()
+    rot.drain()
+    compiles = [r for r in recs
+                if r.get("cat") == "compile" and "lower_s" in r]
+    assert not compiles, compiles

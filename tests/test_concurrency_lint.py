@@ -590,6 +590,32 @@ def test_artifact_lock_ownership_pragma_and_writer_fns(tmp_path):
         [(f.line, f.msg) for f in got]
 
 
+def test_commit_order_fires_on_manifest_before_shard_rename(tmp_path):
+    """Checkpoint-v3 two-phase-commit ORDER (ISSUE 15 satellite): a
+    writer that publishes the manifest BEFORE a shard rename
+    re-creates the torn-read window — the lint bites; the correct
+    rename-then-publish order (and a pragma'd site) pass."""
+    _plant(tmp_path, "roc_tpu/ck.py",
+           "import os\n"
+           "from roc_tpu.utils.checkpoint import commit_manifest\n"
+           "def bad_writer(d, snap, shards, tmp, shard):\n"
+           "    commit_manifest(d, snap, shards)\n"           # line 4
+           "    os.replace(tmp, shard)\n"
+           "def good_writer(d, snap, shards, tmp, shard):\n"
+           "    os.replace(tmp, shard)\n"
+           "    commit_manifest(d, snap, shards)\n"
+           "def waived_writer(d, snap, shards, tmp, shard):\n"
+           "    commit_manifest(d, snap, shards)  "
+           "# re-commit of a landed shard: roc-lint: "
+           "ok=artifact-lock-ownership\n"
+           "    os.replace(tmp, shard)\n")
+    got = run_concurrency_lint(str(tmp_path),
+                               select=["artifact-lock-ownership"])
+    assert [f.line for f in got] == [4], \
+        [(f.line, f.msg) for f in got]
+    assert "BEFORE a shard rename" in got[0].msg
+
+
 def test_artifact_surface_inventories_real_tree():
     """The surface documents which process-shared artifacts each
     module touches and their ownership protocol: the tree's rotation
@@ -606,6 +632,16 @@ def test_artifact_surface_inventories_real_tree():
                for a in arts.get("roc_tpu/prewarm.py", []))
     assert any(a["kind"] == "compile-cache"
                for a in arts.get("roc_tpu/train/cli.py", []))
+    # checkpoint-v3 writers (ISSUE 15): the per-shard writers (the
+    # async saver thread's included) and the proc-0 manifest commit
+    # are inventoried with their ownership protocol
+    assert any(a["kind"] == "ckpt-manifest"
+               and a["owner"] == "proc0-commit-after-shards"
+               for a in arts.get("roc_tpu/utils/checkpoint.py", []))
+    assert any(a["kind"] == "ckpt-shard"
+               and a["owner"] == "per-process-file"
+               for a in arts.get("roc_tpu/resilience/async_save.py",
+                                 []))
     assert surface["totals"]["artifacts"] >= 5
 
 
@@ -636,11 +672,16 @@ def test_surface_documents_the_runtime_thread_model():
     runtime actually has — the audit doubling as documentation."""
     surface = concurrency_surface(TreeModel(_REPO))
     by_mod = {m["module"]: m for m in surface["modules"]}
-    # the four known thread spawns
+    # the five known thread spawns
     assert "roc_tpu/core/streaming.py" in by_mod       # StagingPool
     assert "roc_tpu/serve/server.py" in by_mod         # Server._loop
     assert "roc_tpu/obs/heartbeat.py" in by_mod        # watchdog
     assert "bench.py" in by_mod                        # stderr reader
+    # the checkpoint saver thread (ISSUE 15) — the tree-clean pin
+    # above already proves all six rules model it
+    asv = by_mod["roc_tpu/resilience/async_save.py"]
+    assert any(t["target"] == "self._loop" for t in asv["threads"])
+    assert any(lk["kind"] == "condition" for lk in asv["locks"])
     srv = by_mod["roc_tpu/serve/server.py"]
     assert any(t["target"] == "self._loop" for t in srv["threads"])
     assert any(lk["kind"] == "condition" for lk in srv["locks"])

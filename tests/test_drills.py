@@ -9,10 +9,20 @@ the run completes to the target epoch with the *uninterrupted* run's
 final loss (relative 1e-5; the drills train with dropout 0 so the
 retry key perturbation cannot change the trajectory).
 
-Sites: nan_grads, sigkill, kill_in_save, bitflip_checkpoint, sigterm
-(preemption), staging_io (streamed tier), stall_compile (watchdog
-deadline); distributed variants at P in {2, 4} on the 8-virtual-
-device CPU rig, including one elastic restore onto a DIFFERENT P.
+Sites: nan_grads, sigkill, kill_in_save (shard tmp write), the
+checkpoint-v3 commit-protocol sites — kill_in_async_save (between
+shard rename and manifest publish), shard_corrupt (bitflipped shard
+under an intact manifest), saver_stall (wedged async saver thread) —
+bitflip_checkpoint (corrupted commit record), sigterm (preemption;
+the emergency save is FLUSHED before the restartable exit),
+staging_io (streamed tier), stall_compile (watchdog deadline);
+distributed variants at P in {2, 4} on the 8-virtual-device CPU rig,
+including one elastic restore onto a DIFFERENT P and the 2-process
+gloo DCN arms.  Kill-at-any-point coverage of the two-phase commit:
+before (kill_in_save), during (kill_in_async_save), and after
+(bitflip/shard_corrupt + SIGKILL) the manifest publish — every
+restart resumes from the last COMMITTED checkpoint, zero torn
+restores.
 
 References are computed in-process (same code, same platform — CPU
 runs are deterministic) and cached per config for the module.
@@ -76,6 +86,12 @@ def _resilience_events(tmp_path, kind=None):
             if kind is None or e.get("kind") == kind]
 
 
+def _committed(tmp_path, epoch) -> bool:
+    """A v3 checkpoint directory with a published MANIFEST.json —
+    the ONLY thing restore_latest will look at."""
+    return (tmp_path / f"ck.{epoch}" / "MANIFEST.json").exists()
+
+
 def _assert_parity(got: float, want: float) -> None:
     assert abs(got - want) <= 1e-5 * max(1.0, abs(want)), \
         f"final loss {got} != uninterrupted {want}"
@@ -130,11 +146,12 @@ def test_drill_nan_grads(tmp_path, ref):
 
 def test_drill_sigkill_mid_epoch(tmp_path, ref):
     """SIGKILL at epoch 3; re-invoking the identical command resumes
-    from ck.2 and finishes with the uninterrupted loss."""
+    from the committed ck.2 and finishes with the uninterrupted
+    loss."""
     base = _recovery_args(tmp_path, ELL)
     r1 = _run(tmp_path, base + ["--fault", "sigkill:3"])
     assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
-    assert (tmp_path / "ck.2.npz").exists()
+    assert _committed(tmp_path, 2)
     r2 = _run(tmp_path, base)
     assert r2.returncode == 0, r2.stderr[-2000:]
     _assert_parity(_final_loss(tmp_path / "m.jsonl"),
@@ -142,32 +159,53 @@ def test_drill_sigkill_mid_epoch(tmp_path, ref):
 
 
 def test_drill_kill_mid_checkpoint_write(tmp_path, ref):
-    """kill -9 INSIDE save_checkpoint (after the tmp write, before the
+    """kill -9 INSIDE the shard write (after the tmp write, before the
     atomic rename): the ``.npz.tmp`` must never be picked up by
-    restore_latest and the previous checkpoint restores cleanly."""
+    restore_latest, the directory stays uncommitted (no manifest),
+    and the previous checkpoint restores cleanly — the 'before the
+    commit' arm of kill-at-any-point."""
     base = _recovery_args(tmp_path, ELL)
     r1 = _run(tmp_path, base + ["--fault", "kill_in_save:4"])
     assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
-    tmps = list(tmp_path.glob("*.npz.tmp"))
+    tmps = list(tmp_path.glob("ck.4/*.npz.tmp"))
     assert tmps, "the killed writer should leave its .npz.tmp behind"
-    assert not (tmp_path / "ck.4.npz").exists()
-    assert (tmp_path / "ck.2.npz").exists()
+    assert not _committed(tmp_path, 4)
+    assert _committed(tmp_path, 2)
     r2 = _run(tmp_path, base)
     assert r2.returncode == 0, r2.stderr[-2000:]
-    # the torn file was never consumed or cleaned into the rotation
-    assert all(t.exists() for t in tmps)
+    _assert_parity(_final_loss(tmp_path / "m.jsonl"),
+                   ref("ell", ELL))
+
+
+def test_drill_kill_in_async_save(tmp_path, ref):
+    """kill -9 in the two-phase-commit WINDOW (shard renamed into
+    place, manifest not yet published) — the 'during the commit' arm:
+    the shard-complete-but-uncommitted ck.4 must stay invisible and
+    the restart resumes from the committed ck.2."""
+    base = _recovery_args(tmp_path, ELL)
+    r1 = _run(tmp_path, base + ["--fault", "kill_in_async_save:4"])
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
+    # the shard landed; the commit record did not
+    assert list(tmp_path.glob("ck.4/shard_*.npz"))
+    assert not _committed(tmp_path, 4)
+    assert _committed(tmp_path, 2)
+    r2 = _run(tmp_path, base)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert not _resilience_events(tmp_path, "corrupt_fallback"), \
+        "an uncommitted save must be invisible, not a corrupt restore"
     _assert_parity(_final_loss(tmp_path / "m.jsonl"),
                    ref("ell", ELL))
 
 
 def test_drill_bitflip_checkpoint(tmp_path, ref):
-    """One byte of the newest checkpoint flipped (then SIGKILL): the
-    restart must detect CheckpointCorrupt via the CRC header and fall
-    back to the previous checkpoint instead of training on garbage."""
+    """The newest checkpoint's COMMIT RECORD corrupted (manifest
+    bitflip, then SIGKILL): the restart must detect CheckpointCorrupt
+    and fall back to the previous checkpoint instead of training on
+    garbage — the 'after the commit' arm."""
     base = _recovery_args(tmp_path, ELL)
     r1 = _run(tmp_path, base + ["--fault", "bitflip_checkpoint:4"])
     assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
-    assert (tmp_path / "ck.4.npz").exists()  # corrupt on disk
+    assert _committed(tmp_path, 4)  # committed, but corrupt on disk
     r2 = _run(tmp_path, base)
     assert r2.returncode == 0, r2.stderr[-2000:]
     assert _resilience_events(tmp_path, "corrupt_fallback")
@@ -175,18 +213,58 @@ def test_drill_bitflip_checkpoint(tmp_path, ref):
                    ref("ell", ELL))
 
 
+def test_drill_shard_corrupt(tmp_path, ref):
+    """One byte of a committed checkpoint's SHARD file flipped (the
+    manifest stays intact, then SIGKILL): the restore scan's
+    manifest-vs-shard CRC validation must reject the candidate before
+    selection and fall back to the previous checkpoint."""
+    base = _recovery_args(tmp_path, ELL)
+    r1 = _run(tmp_path, base + ["--fault", "shard_corrupt:4"])
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
+    assert _committed(tmp_path, 4)
+    r2 = _run(tmp_path, base)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    falls = _resilience_events(tmp_path, "corrupt_fallback")
+    assert falls and any("CRC32" in e["msg"] or "manifest" in e["msg"]
+                         for e in falls)
+    _assert_parity(_final_loss(tmp_path / "m.jsonl"),
+                   ref("ell", ELL))
+
+
+@pytest.mark.slow
+def test_drill_saver_stall(tmp_path, ref):
+    """A wedged async saver thread: the flush deadline converts the
+    silent hang into StallFailure and the process exits restartable
+    (75) with the last COMMITTED checkpoint intact; the restart
+    completes at the uninterrupted loss."""
+    base = _recovery_args(tmp_path, ELL)
+    r1 = _run(tmp_path, base + ["--fault", "saver_stall:4"],
+              env_extra={"ROC_TPU_CKPT_FLUSH_TIMEOUT_S": "3"})
+    assert r1.returncode == 75, (r1.returncode, r1.stderr[-2000:])
+    assert _resilience_events(tmp_path, "fault")
+    assert _resilience_events(tmp_path, "restartable_exit")
+    assert _committed(tmp_path, 2)
+    assert not _committed(tmp_path, 4)  # the wedged save never landed
+    r2 = _run(tmp_path, base)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    _assert_parity(_final_loss(tmp_path / "m.jsonl"),
+                   ref("ell", ELL))
+
+
 def test_drill_sigterm_preemption(tmp_path, ref):
     """SIGTERM mid-run: the grace handler finishes the in-flight
-    epoch step, writes an emergency checkpoint through the rotation,
-    and exits the distinct restartable code; the re-invoked command
-    resumes from it."""
+    epoch step, writes an emergency checkpoint through the rotation
+    (FLUSHED — committed before the exit code), and exits the
+    distinct restartable code; the re-invoked command resumes from
+    it."""
     base = _recovery_args(tmp_path, ELL)
     r1 = _run(tmp_path, base + ["--fault", "sigterm:3",
                                 "--preempt-grace", "30"])
     assert r1.returncode == 75, (r1.returncode, r1.stderr[-2000:])
     assert _resilience_events(tmp_path, "preempt")
     # the emergency checkpoint covers the in-flight epoch (3 done -> 4)
-    assert (tmp_path / "ck.4.npz").exists()
+    # and is COMMITTED (the preemption path flushes the async saver)
+    assert _committed(tmp_path, 4)
     r2 = _run(tmp_path, base)
     assert r2.returncode == 0, r2.stderr[-2000:]
     _assert_parity(_final_loss(tmp_path / "m.jsonl"),
@@ -241,6 +319,24 @@ def test_drill_distributed_sigkill_p2(tmp_path, ref):
                    ref("p2", ELL + ["--parts", "2"]))
 
 
+def test_drill_kill_in_async_save_p2(tmp_path, ref):
+    """The commit-window kill at P=2: SIGKILL between shard rename
+    and manifest publish on the distributed trainer — the restart
+    resumes from the committed ck.2 and matches the uninterrupted
+    distributed run (with nan_grads_p4 and the DCN arms this covers
+    kill-at-any-point at P in {2, 4})."""
+    base = _recovery_args(tmp_path, ELL + ["--parts", "2"])
+    r1 = _run(tmp_path, base + ["--fault", "kill_in_async_save:4"])
+    assert r1.returncode == -signal.SIGKILL, (r1.returncode, r1.stderr)
+    assert list(tmp_path.glob("ck.4/shard_*.npz"))
+    assert not _committed(tmp_path, 4)
+    assert _committed(tmp_path, 2)
+    r2 = _run(tmp_path, base)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    _assert_parity(_final_loss(tmp_path / "m.jsonl"),
+                   ref("p2", ELL + ["--parts", "2"]))
+
+
 def test_drill_nan_grads_p4(tmp_path, ref):
     """NaN poisoning at P=4 recovers in process.  Full-batch training
     is partition-count-invariant to fp roundoff, so the P=2 reference
@@ -287,15 +383,48 @@ def _spawn_dcn_workers(tmp_path, fault=None, timeout=240):
     return procs, outs
 
 
-def test_drill_dcn_two_process_sigkill_recovery(tmp_path, ref):
+@pytest.fixture(scope="module")
+def dcn_ref():
+    """Uninterrupted reference for the DCN drills: the IDENTICAL P=4
+    workload in-process on the 8-virtual-device rig (the worker's
+    exact dataset / partition / config, minus the fault and the
+    process boundary).  Computed once for the module."""
+    cache = {}
+
+    def get():
+        if "loss" not in cache:
+            from roc_tpu.core.graph import synthetic_dataset
+            from roc_tpu.core.partition import partition_graph
+            from roc_tpu.models.gcn import build_gcn
+            from roc_tpu.parallel import multihost as mh
+            from roc_tpu.parallel.distributed import DistributedTrainer
+            from roc_tpu.train.trainer import TrainConfig
+            ds = synthetic_dataset(32 * 4, 6, in_dim=12, num_classes=3,
+                                   seed=0)
+            cfg = TrainConfig(epochs=6, verbose=False, aggr_impl="ell",
+                              symmetric=True, dropout_rate=0.0,
+                              eval_every=2)
+            pg = partition_graph(ds.graph, 4, node_multiple=8,
+                                 edge_multiple=cfg.chunk)
+            tr = DistributedTrainer(
+                build_gcn([12, 8, 3], dropout_rate=0.0),
+                ds, 4, cfg, mesh=mh.make_parts_mesh(4), pg=pg)
+            tr.train(6)
+            cache["loss"] = float(tr.evaluate()["train_loss"])
+        return cache["loss"]
+
+    return get
+
+
+def test_drill_dcn_two_process_sigkill_recovery(tmp_path, dcn_ref):
     """The drill matrix's REAL multi-process DCN arm (advertised since
     PR 8): 2 gloo-loopback processes x 2 devices (P=4), a
     ``sigkill:3:1`` fault killing ONLY process 1 mid-run — the
     ``site:epoch:proc`` arm finally drilled across real process
     boundaries.  Re-spawning the pair resumes both processes from the
-    shared rotation's newest checkpoint (process 0 wrote it, both
-    restore) and the run finishes at the uninterrupted P=2 reference
-    loss — recovery parity across a real DCN restart."""
+    shared rotation's newest committed checkpoint (process 0 wrote
+    it, both restore) and the run finishes at the uninterrupted
+    reference loss — recovery parity across a real DCN restart."""
     procs, outs = _spawn_dcn_workers(tmp_path, fault="sigkill:3:1")
     assert procs[1].returncode == -signal.SIGKILL, \
         (procs[1].returncode, outs[1][-2000:])
@@ -304,34 +433,37 @@ def test_drill_dcn_two_process_sigkill_recovery(tmp_path, ref):
     # drill only requires that it did NOT claim completion
     assert "WORKER_OK" not in outs[0], outs[0][-2000:]
     # the checkpoint round before the fault landed on shared storage
-    assert (tmp_path / "ck.2.npz").exists(), \
-        sorted(os.listdir(tmp_path))
+    assert _committed(tmp_path, 2), sorted(os.listdir(tmp_path))
     # supervisor restart: identical command, no fault
     procs2, outs2 = _spawn_dcn_workers(tmp_path)
     for p, out in zip(procs2, outs2):
         assert p.returncode == 0, out[-3000:]
         assert "WORKER_OK" in out
-    # uninterrupted reference: the IDENTICAL P=4 workload in-process
-    # on the 8-virtual-device rig (the worker's exact dataset /
-    # partition / config, minus the fault and the process boundary)
-    from roc_tpu.core.graph import synthetic_dataset
-    from roc_tpu.core.partition import partition_graph
-    from roc_tpu.models.gcn import build_gcn
-    from roc_tpu.parallel import multihost as mh
-    from roc_tpu.parallel.distributed import DistributedTrainer
-    from roc_tpu.train.trainer import TrainConfig
-    ds = synthetic_dataset(32 * 4, 6, in_dim=12, num_classes=3,
-                           seed=0)
-    cfg = TrainConfig(epochs=6, verbose=False, aggr_impl="ell",
-                      symmetric=True, dropout_rate=0.0, eval_every=2)
-    pg = partition_graph(ds.graph, 4, node_multiple=8,
-                         edge_multiple=cfg.chunk)
-    tr = DistributedTrainer(build_gcn([12, 8, 3], dropout_rate=0.0),
-                            ds, 4, cfg, mesh=mh.make_parts_mesh(4),
-                            pg=pg)
-    tr.train(6)
-    _assert_parity(_final_loss(tmp_path / "m_p0.jsonl"),
-                   float(tr.evaluate()["train_loss"]))
+    _assert_parity(_final_loss(tmp_path / "m_p0.jsonl"), dcn_ref())
+
+
+@pytest.mark.slow
+def test_drill_dcn_kill_in_commit(tmp_path, dcn_ref):
+    """The 2-process gloo DCN variant of the commit-window kill
+    (ISSUE 15 satellite): ``kill_in_async_save:4:0`` SIGKILLs ONLY
+    process 0 — the manifest WRITER — after its shard rename and
+    before the manifest publish.  ck.4 is left shard-complete but
+    uncommitted on the shared rotation; the re-spawned pair must
+    resume from the committed ck.2 (zero torn restores) and finish at
+    the uninterrupted reference loss."""
+    procs, outs = _spawn_dcn_workers(tmp_path,
+                                     fault="kill_in_async_save:4:0")
+    assert procs[0].returncode == -signal.SIGKILL, \
+        (procs[0].returncode, outs[0][-2000:])
+    assert "WORKER_OK" not in outs[1], outs[1][-2000:]
+    assert list(tmp_path.glob("ck.4/shard_*.npz"))
+    assert not _committed(tmp_path, 4)
+    assert _committed(tmp_path, 2), sorted(os.listdir(tmp_path))
+    procs2, outs2 = _spawn_dcn_workers(tmp_path)
+    for p, out in zip(procs2, outs2):
+        assert p.returncode == 0, out[-3000:]
+        assert "WORKER_OK" in out
+    _assert_parity(_final_loss(tmp_path / "m_p0.jsonl"), dcn_ref())
 
 
 def test_drill_elastic_restart_p2_to_p4(tmp_path, ref):

@@ -150,7 +150,7 @@ def test_recovery_retries_transient_io_error(trainer, tmp_path):
     assert trainer.epoch == 6
 
 
-# ---- checkpoint integrity: v2 header, CRC32, fingerprint ----
+# ---- checkpoint integrity: v3 manifest/shards, CRC32, fingerprint ----
 
 def _fresh_trainer(num_nodes=64, seed=0):
     ds = synthetic_dataset(num_nodes, 6, in_dim=8, num_classes=3,
@@ -160,7 +160,18 @@ def _fresh_trainer(num_nodes=64, seed=0):
     return Trainer(build_gcn([8, 8, 3]), ds, cfg)
 
 
+def _ckpt_file(path):
+    """The byte-flippable artifact of a checkpoint: a v3 directory's
+    first shard file, or the legacy single file itself."""
+    if os.path.isdir(path):
+        shard = sorted(n for n in os.listdir(path)
+                       if n.startswith("shard_"))[0]
+        return os.path.join(path, shard)
+    return path
+
+
 def _flip_byte(path, offset=None):
+    path = _ckpt_file(str(path))
     size = os.path.getsize(path)
     off = size // 2 if offset is None else offset
     with open(path, "r+b") as f:
@@ -170,22 +181,52 @@ def _flip_byte(path, offset=None):
         f.write(bytes([b[0] ^ 0xFF]))
 
 
-def test_checkpoint_v2_header_and_roundtrip(trainer, tmp_path):
+def _legacy_arrays(trainer):
+    """The v1/v2-style flat array dict of a trainer's state (the
+    migration tests build legacy files from it by hand — the writers
+    are gone, the loaders must stay)."""
+    import jax
+    from roc_tpu.utils.checkpoint import _flatten
+    data = _flatten(jax.device_get(trainer.params), "params")
+    data.update(_flatten(jax.device_get(trainer.opt_state), "opt"))
+    data["__epoch__"] = np.asarray(trainer.epoch, dtype=np.int64)
+    data["__key__"] = np.asarray(jax.device_get(trainer.key))
+    return data
+
+
+def test_checkpoint_v3_manifest_and_roundtrip(trainer, tmp_path):
     import json
     from roc_tpu.utils.checkpoint import (checkpoint_trainer,
+                                          read_manifest,
                                           restore_trainer)
     trainer.train(epochs=2)
-    p = str(tmp_path / "ck.npz")
+    p = str(tmp_path / "ck")
     checkpoint_trainer(trainer, p)
-    with np.load(p) as z:
-        header = json.loads(bytes(
-            np.asarray(z["__header__"], dtype=np.uint8)).decode())
-    assert header["version"] == 2
-    assert header["crc32"]  # every array covered
-    fp = header["fingerprint"]
+    # the v3 directory layout: per-process shard + committed manifest
+    assert sorted(os.listdir(p)) == ["MANIFEST.json",
+                                     "shard_00000.npz"]
+    man = read_manifest(p)
+    assert man["version"] == 3
+    assert man["epoch"] == 2
+    sh = man["shards"][0]
+    assert sh["file"] == "shard_00000.npz" and sh["crc32"]
+    assert sh["bytes"] == os.path.getsize(
+        os.path.join(p, "shard_00000.npz"))
+    fp = man["fingerprint"]
     assert fp["strict"]["params_sig"]
     assert fp["strict"]["dataset"] == {"V": 64, "E": trainer._obs_edges}
     assert fp["elastic"]["num_parts"] == 1
+    # the shard header carries per-array CRCs + the sharding-spec
+    # vocabulary (global shape / per-dim axis spec / piece index)
+    with np.load(os.path.join(p, "shard_00000.npz")) as z:
+        header = json.loads(bytes(
+            np.asarray(z["__header__"], dtype=np.uint8)).decode())
+    assert header["version"] == 3 and header["process"] == 0
+    assert header["crc32"]
+    some = next(k for k in header["arrays"] if k.startswith("params"))
+    meta = header["arrays"][some]
+    assert meta["shape"] and meta["dtype"]
+    assert all(s is None for s in meta["spec"])  # replicated today
     t2 = _fresh_trainer()
     restore_trainer(t2, p)
     assert t2.epoch == 2
@@ -196,37 +237,130 @@ def test_checkpoint_v2_header_and_roundtrip(trainer, tmp_path):
 
 
 def test_corrupt_checkpoint_raises_distinct_error(trainer, tmp_path):
-    """The PR-7 denormal-garbage corruption class: a flipped byte must
-    surface as CheckpointCorrupt, never as silently-wrong params."""
+    """The PR-7 denormal-garbage corruption class: a flipped shard
+    byte must surface as CheckpointCorrupt (manifest-vs-shard CRC),
+    never as silently-wrong params."""
     from roc_tpu.utils.checkpoint import (CheckpointCorrupt,
                                           checkpoint_trainer,
                                           restore_trainer)
     trainer.train(epochs=1)
-    p = str(tmp_path / "ck.npz")
+    p = str(tmp_path / "ck")
     checkpoint_trainer(trainer, p)
     _flip_byte(p)
     with pytest.raises(CheckpointCorrupt):
         restore_trainer(trainer, p)
 
 
-def test_v1_checkpoint_loads_with_warning(trainer, tmp_path):
-    """Pre-header checkpoints still restore — with a loud resilience
-    event instead of validation."""
-    from roc_tpu.utils.checkpoint import (checkpoint_trainer,
+def test_uncommitted_checkpoint_is_invisible(trainer, tmp_path):
+    """A v3 directory without MANIFEST.json (a save that died before
+    the commit) must raise CheckpointCorrupt on a direct load and be
+    invisible to the rotation scan."""
+    from roc_tpu.utils.checkpoint import (CheckpointCorrupt,
+                                          checkpoint_trainer,
                                           restore_trainer)
     trainer.train(epochs=1)
-    p2 = str(tmp_path / "v2.npz")
-    checkpoint_trainer(trainer, p2)
-    with np.load(p2) as z:
-        data = {k: z[k] for k in z.files if k != "__header__"}
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=3)
+    rot.save(trainer)
+    p = rot.path(trainer.epoch)
+    os.remove(os.path.join(p, "MANIFEST.json"))
+    assert rot.existing() == []
+    with pytest.raises(CheckpointCorrupt, match="manifest"):
+        restore_trainer(trainer, p)
+
+
+def test_rotation_falls_back_on_deleted_shard(trainer, tmp_path):
+    """ISSUE 15 satellite regression: the corrupt-fallback scan must
+    validate the manifest AND every listed shard before selecting a
+    candidate — a committed manifest whose shard file went missing
+    must fall through to the previous checkpoint, not be accepted."""
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=3)
+    trainer.train(epochs=1)
+    rot.save(trainer)
+    trainer.train(epochs=1)
+    rot.save(trainer)
+    assert rot.existing() == [1, 2]
+    newest = rot.path(2)
+    os.remove(_ckpt_file(newest))
+    # the manifest is still committed, so the scan SEES the epoch...
+    assert rot.existing() == [1, 2]
+    t2 = _fresh_trainer()
+    with _capture_events() as recs:
+        # ...but full validation rejects it before selection
+        assert rot.restore_latest(t2) == 1
+    assert t2.epoch == 1
+    falls = [r for r in recs if r.get("kind") == "corrupt_fallback"]
+    assert falls and "missing" in falls[0]["msg"]
+
+
+def test_rotation_migrates_legacy_files(trainer, tmp_path):
+    """A rotation holding a legacy v2 .npz restores it, a torn v3
+    directory at the SAME epoch never shadows it, and the next saves
+    write v3 directories — the in-place migration path."""
+    import json
+    import zlib
+    rot = CheckpointRotation(str(tmp_path / "ck"), keep=2)
+    trainer.train(epochs=1)
+    data = _legacy_arrays(trainer)
+    crc = {k: zlib.crc32(np.ascontiguousarray(v).tobytes())
+           & 0xFFFFFFFF for k, v in data.items()}
+    data["__header__"] = np.frombuffer(json.dumps(
+        {"version": 2, "crc32": crc, "fingerprint": {}}).encode(),
+        dtype=np.uint8)
+    np.savez(str(tmp_path / "ck.1.npz"), **data)
+    # a torn (uncommitted) v3 dir at the same epoch: must not shadow
+    os.makedirs(tmp_path / "ck.1")
+    assert rot.existing() == [1]
+    t2 = _fresh_trainer()
+    assert rot.restore_latest(t2) == 1
+    assert t2.epoch == 1
+    # saves continue in v3; prune clears BOTH legacy forms
+    for _ in range(2):
+        t2.train(epochs=1)
+        rot.save(t2)
+    assert rot.existing() == [2, 3]
+    assert not (tmp_path / "ck.1.npz").exists()
+    assert (tmp_path / "ck.3" / "MANIFEST.json").exists()
+
+
+def test_v1_checkpoint_loads_with_warning(trainer, tmp_path):
+    """Pre-header single-file checkpoints still restore — with a loud
+    resilience event instead of validation."""
+    from roc_tpu.utils.checkpoint import restore_trainer
+    trainer.train(epochs=1)
     p1 = str(tmp_path / "v1.npz")
-    np.savez(p1, **data)
+    np.savez(p1, **_legacy_arrays(trainer))
     t2 = _fresh_trainer()
     with _capture_events() as recs:
         restore_trainer(t2, p1)
     assert t2.epoch == trainer.epoch
     assert any(r.get("cat") == "resilience"
                and r.get("kind") == "v1_checkpoint" for r in recs)
+
+
+def test_v2_checkpoint_loads_with_warning(trainer, tmp_path):
+    """Legacy v2 single-file checkpoints (header + per-array CRCs)
+    still restore, fully validated, with the loud migration event."""
+    import json
+    import zlib
+    from roc_tpu.utils.checkpoint import restore_trainer
+    trainer.train(epochs=1)
+    data = _legacy_arrays(trainer)
+    crc = {k: zlib.crc32(np.ascontiguousarray(v).tobytes())
+           & 0xFFFFFFFF for k, v in data.items()}
+    header = {"version": 2, "crc32": crc, "fingerprint": {}}
+    data["__header__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    p2 = str(tmp_path / "v2.npz")
+    np.savez(p2, **data)
+    t2 = _fresh_trainer()
+    with _capture_events() as recs:
+        restore_trainer(t2, p2)
+    assert t2.epoch == trainer.epoch
+    import jax
+    for a, b in zip(jax.tree_util.tree_leaves(trainer.params),
+                    jax.tree_util.tree_leaves(t2.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert any(r.get("kind") == "legacy_checkpoint" for r in recs)
 
 
 def test_fingerprint_mismatch_raises(trainer, tmp_path):

@@ -133,6 +133,28 @@ def test_serve_availability_loaded_from_round(tmp_path):
     assert bad["regressed"] == ["serve_availability"]
 
 
+def test_ckpt_columns_gate_and_load(tmp_path):
+    """ISSUE-15 satellite: the checkpoint-cost pair rides the headline
+    and gates lower-better — a synthetic 10x re-synchronized save
+    regresses ckpt_block_ms/ckpt_save_ms, normal jitter passes, and
+    load_bench_round reads the columns back like serve_p50_ms."""
+    from roc_tpu.obs.sentinel import load_bench_round
+    doc = {"parsed": {"value": 100.0, "unit": "ms",
+                      "ckpt_save_ms": 40.0, "ckpt_block_ms": 2.0}}
+    p = tmp_path / "BENCH_r21.json"
+    p.write_text(json.dumps(doc))
+    r = load_bench_round(str(p))
+    assert r["ckpt_save_ms"] == 40.0
+    assert r["ckpt_block_ms"] == 2.0
+    rounds = [dict(r, path=f"r{i}") for i in range(4)]
+    bad = check_run(rounds, {"ckpt_save_ms": 400.0,
+                             "ckpt_block_ms": 20.0})
+    assert set(bad["regressed"]) == {"ckpt_save_ms", "ckpt_block_ms"}
+    ok = check_run(rounds, {"ckpt_save_ms": 42.0,
+                            "ckpt_block_ms": 2.1})
+    assert ok["ok"], ok
+
+
 def test_check_run_filters_step_history_by_dtype():
     rounds = [{"path": "a", "step_ms": 7920.0, "compile_s": None,
                "overlap_frac": None, "dtype": "float32"},
