@@ -787,6 +787,38 @@ def child_micro(args) -> dict:
             rows[f"partition:{method}"] = row
     except Exception as e:  # noqa: BLE001 - report and continue
         rows["partition"] = {"error": _errstr(e)}
+
+    # micro_mesh rows: the 1-D all-parts mesh vs the best (parts,
+    # model) 2-D shape of the same device set — wide-model epoch +
+    # at-rest state bytes per device (benchmarks/micro_mesh.py is the
+    # full probe; the sentinel gates mesh_epoch_ratio over the BENCH
+    # trajectory like overlap_frac).  Needs a factorable device count
+    # with a model axis > 1 to say anything.
+    try:
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "benchmarks"))
+        import micro_mesh as mm
+        from roc_tpu.parallel import candidate_mesh_shapes
+        nd = len(jax.devices())
+        if nd < 2 or len(candidate_mesh_shapes(nd)) < 2:
+            rows["mesh"] = {"skipped": f"{nd} device(s)"}
+        else:
+            # the CPU rehearsal (no ICI, serial compiles for every
+            # shape) runs a narrower race so the whole micro stage
+            # fits its child budget; the chip gets the full width
+            cpu = dev.platform == "cpu"
+            nodes, dim, hid, eps = ((2048, 128, 128, 2) if cpu
+                                    else (4096, 256, 256, 3))
+            ds_m = mm.make_wide_dataset(nodes, 8, dim, 16)
+            shapes, win = mm.mesh_race(ds_m, nd, hid, epochs=eps)
+            rows["mesh:1d"] = dict(shapes[win["one_d"]],
+                                   shape=win["one_d"])
+            rows["mesh:2d"] = dict(
+                shapes[win["best_2d"]], shape=win["best_2d"],
+                mesh_epoch_ratio=win["mesh_epoch_ratio"],
+                state_bytes_ratio=win["state_bytes_ratio"])
+    except Exception as e:  # noqa: BLE001 - report and continue
+        rows["mesh"] = {"error": _errstr(e)}
     return {"platform": dev.platform, "device_kind": dev.device_kind,
             "V": V, "E": E, "F": F, "iters": iters, "impls": rows}
 

@@ -195,6 +195,21 @@ def _rig_specs() -> Dict[str, RigSpec]:
             config=lambda: TrainConfig(
                 verbose=False, symmetric=True, dtype=jnp.float32),
             parts=1, serve="precomputed"),
+        # the (parts, model) 2-D mesh rig: gin_flat8's exact program
+        # set widened to mesh=2x4 — params/Adam moments model-sharded
+        # at rest, the partial-auto steps take the extra partition-
+        # index arg, and every param/opt leaf's rendered sharding spec
+        # lands in the program keys.  Needs 8 devices (parts * model
+        # — rig_required_devices), so single-device CI skips it the
+        # same way it skips parts > 1.
+        "gin_mesh2d": RigSpec(
+            name="gin_mesh2d",
+            model=lambda: build_gin([_F, _H, _C], dropout_rate=0.5),
+            config=lambda: TrainConfig(
+                verbose=False, symmetric=True, aggr_impl="flat_sum",
+                mesh="2x4",
+                dtype=jnp.float32, compute_dtype=jnp.bfloat16),
+            parts=2),
     }
 
 
@@ -206,6 +221,19 @@ def rig_configs() -> Dict[str, RigSpec]:
     if not RIG_CONFIGS:
         RIG_CONFIGS.update(_rig_specs())
     return RIG_CONFIGS
+
+
+def rig_required_devices(spec: RigSpec) -> int:
+    """Total devices this spec's mesh occupies: ``parts * model``
+    (``train/trainer.resolve_mesh`` on the spec's own config).  THE
+    device guard every rig walker shares — the audit loop here,
+    sharding_lint's rig sweep, and the prewarm driver — so a 2-D rig
+    is skipped (not crashed) on hosts with too few devices, by the
+    same rule everywhere."""
+    from ..train.trainer import resolve_mesh
+    parts = max(spec.parts, 1)
+    _, model = resolve_mesh(spec.config(), num_parts=parts)
+    return parts * model
 
 
 def build_rig_dataset():
@@ -333,14 +361,23 @@ def candidate_programs(tr) -> List["Candidate"]:
                       d.ell_row_pos, d.ell_row_id, d.ring_idx,
                       d.sect_idx, d.sect_sub_dst, d.bd_tabs, fuse)
         graph_roles = ("tables",) * len(graph_args)
+        # 2-D partial-auto steps take the trailing parts-sharded
+        # partition-index vector (distributed._build_steps); the
+        # enumerated args must carry it or the keys (and make_jaxpr
+        # arity) diverge from the live programs
+        pids = (() if getattr(tr, "_pids", None) is None
+                else (tr._pids,))
+        pid_roles = ("data",) * len(pids)
         add("dist_train_step", tr._train_step._jit,
             (tr.params, tr.opt_state, d.feats, d.labels, d.mask)
-            + graph_args + (tr.key, lr), donate=(0, 1),
+            + graph_args + (tr.key, lr) + pids, donate=(0, 1),
             roles=("params", "opt_state", "data", "data", "data")
-            + graph_roles + ("other", "other"))
+            + graph_roles + ("other", "other") + pid_roles)
         add("dist_eval_step", tr._eval_step._jit,
-            (tr.params, d.feats, d.labels, d.mask) + graph_args,
-            roles=("params", "data", "data", "data") + graph_roles)
+            (tr.params, d.feats, d.labels, d.mask) + graph_args
+            + pids,
+            roles=("params", "data", "data", "data") + graph_roles
+            + pid_roles)
     elif tr._head is None:                        # plain single-device
         add("train_step", tr._train_step._jit,
             (tr.params, tr.opt_state, tr.key, lr, tr.feats,
@@ -365,14 +402,19 @@ def candidate_programs(tr) -> List["Candidate"]:
         grads = jax.tree_util.tree_map(
             lambda p: jax.ShapeDtypeStruct(p.shape, p.dtype),
             tr.params)
+        # y is the streamed-head [V, H] handoff — role "stream", not
+        # "data": it carries the FEATURE axis, so the sharding ledger
+        # treats it as parts-split AND model-shardable (the 2-D mesh's
+        # block path, train/trainer._pin_stream), unlike node-axis
+        # data rows
         add("tail_grad", tr._tail_grad._jit,
             (tr.params, y, tr.key, tr.labels, tr.mask, tr.gctx),
             donate=(1,),
-            roles=("params", "data", "other", "data", "data",
+            roles=("params", "stream", "other", "data", "data",
                    "tables"))
         add("tail_eval", tr._tail_eval._jit,
             (tr.params, y, tr.labels, tr.mask, tr.gctx),
-            roles=("params", "data", "data", "data", "tables"))
+            roles=("params", "stream", "data", "data", "tables"))
         add("apply_update", tr._apply_update._jit,
             (tr.params, tr.opt_state, grads, lr),
             donate=(0, 1, 2),
@@ -574,7 +616,7 @@ def audit_program_space(select: Optional[List[str]] = None,
     findings: List[Finding] = []
     ds = None
     for name, spec in rig_configs().items():
-        if spec.parts > len(jax.devices()):
+        if rig_required_devices(spec) > len(jax.devices()):
             continue
         if ds is None:   # one synthetic rig dataset for every config
             ds = build_rig_dataset()

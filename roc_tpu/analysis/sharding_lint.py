@@ -68,7 +68,7 @@ from typing import (Any, Callable, Dict, Iterable, List, Optional,
 
 from ..obs.events import emit
 from ..parallel import (MODEL_AXIS, PARTS_AXIS, candidate_mesh_shapes,
-                        mesh_axes)
+                        mesh_axes, model_shard_spec)
 from .findings import Finding
 
 SHARDING_RULES = ("replication-budget", "full-width-materialization",
@@ -926,9 +926,11 @@ def ledger_entries(cand, dims: RigDims,
     """The replication ledger of one candidate program on one
     ``(parts, model)`` mesh shape, as it stands TODAY: the vertex
     axis is genuinely sharded (the partitioner/shard_map machinery
-    exists), the model axis shards nothing yet — so every buffer is
-    replicated over ``model``, and feature-less tables are the
-    permanent residents of that column.  Sorted largest-first."""
+    exists), and params/opt-state/stream buffers with a
+    ``model``-divisible dim are F-sharded at rest (the
+    ``put_replicated``/jit-shardings path).  Graph data and the
+    feature-less dispatch tables remain replicated over ``model`` —
+    the permanent residents of that column.  Sorted largest-first."""
     parts, model = int(shape[0]), int(shape[1])
     out: List[Dict[str, Any]] = []
     for leaf, role in _leaf_roles(cand):
@@ -948,7 +950,17 @@ def ledger_entries(cand, dims: RigDims,
             else:
                 replicated.append(PARTS_AXIS)
         if model > 1:
-            replicated.append(MODEL_AXIS)     # nothing F-shards today
+            # params / opt moments / the streamed-head handoff are
+            # model-sharded at rest when a dim divides; everything
+            # else (graph data, dispatch tables) stays replicated
+            mspec = (model_shard_spec(lshape, model)
+                     if role in ("params", "opt_state", "stream")
+                     else None)
+            if mspec is not None:
+                split.append(MODEL_AXIS)
+                div *= model
+            else:
+                replicated.append(MODEL_AXIS)
         out.append({
             "role": role,
             "shape": list(lshape),
@@ -1301,9 +1313,9 @@ def audit_sharding(select: Optional[List[str]] = None,
     findings: List[Finding] = []
     ds = None
     from .programspace import build_rig_dataset, build_rig_trainer, \
-        rig_configs
+        rig_configs, rig_required_devices
     for name, spec in rig_configs().items():
-        if spec.parts > len(jax.devices()):
+        if rig_required_devices(spec) > len(jax.devices()):
             continue
         if ds is None:
             ds = build_rig_dataset()

@@ -46,15 +46,26 @@ import numpy as np
 # Feature order of every φ vector in this module.  ``stream_blocks``
 # is the streamed-tier block count (features='host'); the distributed
 # trainer never streams, so it carries 0 there — kept so the single-
-# device planner can reuse the same vector shape.
+# device planner can reuse the same vector shape.  ``attn_edges`` is
+# the padded edge count AGAIN, but only for attention models: the
+# per-edge softmax (exp + segment-max + normalize) is a second O(E)
+# pass the plain sum path never pays, and folding it into the shared
+# edge weight under-balanced attention workloads.  ``flat8_chunks``
+# is the flat8 layout's scan length (8-wide sub-row count) — the
+# attn_flat8/flat_sum consolidation walks chunks, not raw edges, so
+# its cost quantizes on sub-rows.  Both are 0 for workloads that
+# don't run that code, which keeps their fitted weights pinned to
+# the prior (zero) there.
 PHI = ("intercept", "padded_nodes", "padded_edges", "halo_in",
-       "halo_out", "deg_p95", "bd_blocks", "stream_blocks")
+       "halo_out", "deg_p95", "bd_blocks", "stream_blocks",
+       "attn_edges", "flat8_chunks")
 
 # Per-feature scales for ridge conditioning: raw counts span ~6 orders
 # of magnitude (intercept 1 vs 1e8 edges) and an unscaled normal
 # matrix is numerically useless.  Fixed, documented constants — NOT
 # data-derived, so two processes always build the identical model.
-_SCALE = np.array([1.0, 1e4, 1e5, 1e3, 1e3, 1e2, 1e2, 1e2])
+_SCALE = np.array([1.0, 1e4, 1e5, 1e3, 1e3, 1e2, 1e2, 1e2, 1e5,
+                   1e4])
 
 # Cold-start prior (raw-unit weights): pure padded-edge balance with a
 # small padded-node tiebreak — the greedy sweep's objective, solved to
@@ -68,6 +79,14 @@ _SCALE = np.array([1.0, 1e4, 1e5, 1e3, 1e3, 1e2, 1e2, 1e2])
 _PRIOR_RAW = np.zeros(len(PHI))
 _PRIOR_RAW[PHI.index("padded_nodes")] = 2.5e-6
 _PRIOR_RAW[PHI.index("padded_edges")] = 1e-5
+# attention's per-edge softmax pass costs about half the base
+# gather-multiply rate; a flat8 chunk (8 sub-row slots) carries a
+# fixed decode+accumulate overhead on top of its edges.  Nonzero
+# priors because the cold-start split must already see the extra
+# work — the ROADMAP's "--partition cost under-balances attention
+# workloads" was exactly the zero-prior cold start.
+_PRIOR_RAW[PHI.index("attn_edges")] = 5e-6
+_PRIOR_RAW[PHI.index("flat8_chunks")] = 2e-5
 
 
 def _ceil_mult(x, m: int):
@@ -117,18 +136,31 @@ class PartitionCostModel:
         return np.asarray(phi_mat_raw, dtype=np.float64) @ \
             self.weights_raw()
 
-    def search_weights(self) -> Tuple[float, float]:
+    def search_weights(self, attn_edges: bool = False,
+                       flat8: bool = False) -> Tuple[float, float]:
         """(w_nodes, w_edges) for the split search: the fitted weights
-        on the two prefix-summable features, clamped >= 0 (the packing
-        argument needs monotone range costs).  Degenerate fits (both
-        ~0, e.g. measurements that anti-correlate with size) fall back
-        to the prior rather than producing a constant-cost search."""
+        on the prefix-summable features, clamped >= 0 (the packing
+        argument needs monotone range costs).  The attention and flat8
+        columns are edge-proportional, so for workloads that run that
+        code their weights fold into the effective edge rate
+        (``flat8_chunks`` is per 8-wide sub-row — /8 per edge).
+        Degenerate fits (all ~0, e.g. measurements that
+        anti-correlate with size) fall back to the prior rather than
+        producing a constant-cost search."""
         w = self.weights_raw()
         wn = max(float(w[PHI.index("padded_nodes")]), 0.0)
         we = max(float(w[PHI.index("padded_edges")]), 0.0)
+        if attn_edges:
+            we += max(float(w[PHI.index("attn_edges")]), 0.0)
+        if flat8:
+            we += max(float(w[PHI.index("flat8_chunks")]), 0.0) / 8.0
         if wn + we <= 0.0:
             wn = _PRIOR_RAW[PHI.index("padded_nodes")]
             we = _PRIOR_RAW[PHI.index("padded_edges")]
+            if attn_edges:
+                we += _PRIOR_RAW[PHI.index("attn_edges")]
+            if flat8:
+                we += _PRIOR_RAW[PHI.index("flat8_chunks")] / 8.0
         return wn, we
 
 
@@ -285,11 +317,16 @@ def partition_halo_stats(pg) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def phi_matrix(pg, bd_occupancy: Sequence[dict] = (),
-               stream_blocks: int = 0) -> np.ndarray:
+               stream_blocks: int = 0, attn_edges: bool = False,
+               flat8: bool = False) -> np.ndarray:
     """[P, len(PHI)] raw per-partition feature matrix for a built
     :class:`~roc_tpu.core.partition.PartitionedGraph`.
     ``bd_occupancy`` is ``ShardedData.bd_occupancy`` when the bdense
-    planner ran (live dense-block count per part), else zeros."""
+    planner ran (live dense-block count per part), else zeros.
+    ``attn_edges=True`` (the model attends — GAT's per-edge softmax)
+    charges the padded edge count a second time in its own column;
+    ``flat8=True`` (aggr_impl is the flat8 family) fills the scan-
+    length column with the per-part 8-wide sub-row count."""
     P = pg.num_parts
     nm = getattr(pg, "node_multiple", 8)
     em = getattr(pg, "edge_multiple", 128)
@@ -306,15 +343,19 @@ def phi_matrix(pg, bd_occupancy: Sequence[dict] = (),
     for p, occ in enumerate(bd_occupancy):
         if p < P:
             bd[p] = float(occ.get("n_blocks", 0))
+    padded_e = _ceil_mult(real_e, em).astype(np.float64)
     out = np.stack([
         np.ones(P),
         _ceil_mult(real_n, nm).astype(np.float64),
-        _ceil_mult(real_e, em).astype(np.float64),
+        padded_e,
         halo_in.astype(np.float64),
         halo_out.astype(np.float64),
         p95,
         bd,
         np.full(P, float(stream_blocks)),
+        padded_e if attn_edges else np.zeros(P),
+        (_ceil_mult(real_e, 8) // 8).astype(np.float64)
+        if flat8 else np.zeros(P),
     ], axis=1)
     return out
 

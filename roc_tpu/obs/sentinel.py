@@ -125,6 +125,7 @@ def load_bench_round(path: str) -> Dict[str, Any]:
                            "serve_availability": None,
                            "ckpt_save_ms": None,
                            "ckpt_block_ms": None,
+                           "mesh_epoch_ratio": None,
                            "dtype": None, "stage": None}
     try:
         with open(path) as f:
@@ -179,6 +180,16 @@ def load_bench_round(path: str) -> Dict[str, Any]:
                                    (int, float)):
                     out["overlap_frac"] = float(row["overlap_frac"])
                     break
+            # 2-D mesh race (ISSUE 16): best-2-D / 1-D epoch ratio
+            # from the micro stage's mesh:2d row, gated lower-better
+            # — a PR that slows the model-sharded step relative to
+            # the 1-D mesh regresses here first
+            mesh = impls.get("mesh:2d")
+            if isinstance(mesh, dict) and \
+                    isinstance(mesh.get("mesh_epoch_ratio"),
+                               (int, float)):
+                out["mesh_epoch_ratio"] = float(
+                    mesh["mesh_epoch_ratio"])
     return out
 
 
@@ -275,6 +286,13 @@ def check_run(rounds: List[Dict[str, Any]],
         "ckpt_block_ms": detect(
             [r.get("ckpt_block_ms") for r in rounds],
             current.get("ckpt_block_ms")),
+        # 2-D mesh (ISSUE 16): the best-2-D-over-1-D epoch ratio,
+        # lower-better — ratios sit near 1.0, so the absolute floor
+        # keeps run-to-run noise from tripping the gate
+        "mesh_epoch_ratio": detect(
+            [r.get("mesh_epoch_ratio") for r in rounds],
+            current.get("mesh_epoch_ratio"), allow_zero=True,
+            abs_floor=RATE_ABS_FLOOR),
     }
     regressed = [name for name, v in checks.items()
                  if v["verdict"] == "regression"]

@@ -16,13 +16,15 @@ every submodule (and ``models/builder.py``) needs without a cycle.
 # cycle-free; distributed.py re-exports it).
 PARTS_AXIS = "parts"
 
-# THE name of the feature/model mesh axis of the planned
-# ``(parts, model)`` 2-D mesh (ROADMAP: vertex shards x feature
-# shards).  No trainer builds a 2-D mesh yet — the name exists so the
-# sharding auditor (analysis/sharding_lint.py), the memory model's
-# per-axis attribution (core/memory.py), and the eventual pjit'd
-# dense ops all agree on ONE spelling before the refactor lands,
-# exactly like PARTS_AXIS predating multihost.
+# THE name of the feature/model mesh axis of the ``(parts, model)``
+# 2-D mesh (ROADMAP: vertex shards x feature shards).  Both trainers
+# build it when ``TrainConfig.mesh`` names a model dimension > 1:
+# params and Adam moments live model-sharded at rest
+# (:func:`model_shard_spec` picks the dim), the step bodies stay
+# 1-D shard_map programs (the model axis rides through as a GSPMD
+# ``auto`` axis), and the sharding auditor (analysis/sharding_lint.py)
+# + the memory model's per-axis attribution (core/memory.py) check
+# the same ONE spelling.
 MODEL_AXIS = "model"
 
 
@@ -42,3 +44,26 @@ def mesh_axes(shape) -> dict:
     the one place the positional shape meets the axis names."""
     parts, model = shape
     return {PARTS_AXIS: int(parts), MODEL_AXIS: int(model)}
+
+
+def model_shard_spec(shape, model: int):
+    """Per-dim mesh-axis names (None | MODEL_AXIS) for ONE buffer of
+    the given shape on a mesh with ``model``-wide feature axis, or
+    None when no dim divides.
+
+    THE single derivation of "which dim of this leaf carries features"
+    — scanned LAST dim first (features are trailing in every param /
+    moment / activation layout here), first dim whose size is a
+    positive multiple of ``model`` wins.  ``put_replicated``, the
+    step in/out shardings, the auditor's ledger, and checkpoint
+    restore all consume this one function so they cannot drift.
+    Pure shape arithmetic — importable without jax."""
+    model = int(model)
+    if model <= 1:
+        return None
+    for ax in range(len(shape) - 1, -1, -1):
+        d = int(shape[ax])
+        if d >= model and d % model == 0:
+            return tuple([None] * ax + [MODEL_AXIS]
+                         + [None] * (len(shape) - ax - 1))
+    return None

@@ -50,45 +50,64 @@ from ..train.trainer import (TrainConfig, cast_floats, compute_dtype_of,
                              remat_policy, resolve_symmetric)
 
 
-# THE name of the partition mesh axis — defined in parallel/__init__
-# (the cycle-free home ring.py / multihost.py / models/builder.py can
-# also import) and re-exported here because every collective in the
-# step bodies below reduces/gathers/permutes over it and the SPMD
+# THE names of the mesh axes — defined in parallel/__init__ (the
+# cycle-free home ring.py / multihost.py / models/builder.py can also
+# import) and re-exported here because every collective in the step
+# bodies below reduces/gathers/permutes over PARTS_AXIS and the SPMD
 # collective verifier (analysis/collective_lint.py) checks the traced
-# eqns' axis names against the mesh built here.
-from . import PARTS_AXIS
+# eqns' axis names against the mesh built here.  MODEL_AXIS never
+# appears in a step-body collective: on a 2-D mesh it is a GSPMD
+# ``auto`` axis — the partitioner propagates the model sharding of
+# params/opt state through the unchanged 1-D step programs.
+from . import MODEL_AXIS, PARTS_AXIS, model_shard_spec
 
 
-def _shard_map(f, mesh: Mesh, in_specs, out_specs):
+def _shard_map(f, mesh: Mesh, in_specs, out_specs,
+               auto: frozenset = frozenset()):
     """``jax.shard_map`` across jax versions: the stable API (with
     ``check_vma``) when present, else the ``jax.experimental``
     form (jax <= 0.4.x, whose flag spells ``check_rep``).  Replica
     checking stays off either way — the step functions psum
-    explicitly."""
+    explicitly.  ``auto`` names mesh axes left to GSPMD (the 2-D
+    mesh's MODEL_AXIS: the body stays a 1-D parts program while the
+    partitioner threads the model sharding through it)."""
+    kw = {"auto": auto} if auto else {}
     if hasattr(jax, "shard_map"):
         return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=False)
+                             out_specs=out_specs, check_vma=False,
+                             **kw)
     from jax.experimental.shard_map import shard_map as sm
     return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-              check_rep=False)
+              check_rep=False, **kw)
 
 
 def make_mesh(num_parts: Optional[int] = None,
-              devices: Optional[List] = None) -> Mesh:
-    """1-D mesh over graph partitions.  One partition per device — the
-    reference sets numParts = numMachines * numGPUs the same way
-    (``gnn.cc:62,754``).  ``num_parts=None`` uses every device.
+              devices: Optional[List] = None,
+              model: int = 1) -> Mesh:
+    """Device mesh over graph partitions.  ``model=1`` (default) is
+    the 1-D parts mesh — one partition per device, the reference sets
+    numParts = numMachines * numGPUs the same way (``gnn.cc:62,754``);
+    ``num_parts=None`` uses every device.  ``model > 1`` builds the
+    ``(parts, model)`` 2-D mesh: ``num_parts * model`` devices
+    reshaped parts-major, so the model replicas of one partition are
+    ICI neighbors (``num_parts=None`` then uses
+    ``len(devices) // model`` partitions).
 
     ``jax.devices()`` orders devices process-major, so consecutive
     partitions land on the same host — ring-halo hops cross DCN once
     per host (parallel/multihost.py relies on this layout)."""
     if devices is None:
         devices = jax.devices()
+    model = int(model)
     if num_parts is None:
-        num_parts = len(devices)
-    assert len(devices) >= num_parts, (
-        f"need {num_parts} devices, have {len(devices)}")
-    return Mesh(np.asarray(devices[:num_parts]), (PARTS_AXIS,))
+        num_parts = len(devices) // model if model > 1 else len(devices)
+    n = num_parts * model
+    assert len(devices) >= n, (
+        f"need {n} devices ({num_parts}x{model}), have {len(devices)}")
+    if model == 1:
+        return Mesh(np.asarray(devices[:num_parts]), (PARTS_AXIS,))
+    return Mesh(np.asarray(devices[:n]).reshape(num_parts, model),
+                (PARTS_AXIS, MODEL_AXIS))
 
 
 def remap_col_to_padded(plan, col: np.ndarray) -> np.ndarray:
@@ -458,7 +477,14 @@ def shard_dataset(dataset: Dataset, pg: PartitionedGraph,
 
 
 def put_replicated(tree, mesh: Mesh):
-    """Replicate a host pytree across every device of ``mesh``.
+    """Place a host pytree across every device of ``mesh``: fully
+    replicated on a 1-D parts mesh (the reference reads weights whole
+    in every task, ``linear.cc:95-99``), and model-SHARDED on a 2-D
+    ``(parts, model)`` mesh — each leaf whose shape carries a
+    model-divisible dim (``parallel.model_shard_spec``, trailing dim
+    first: the feature dim of every weight matrix / Adam moment here)
+    splits it over MODEL_AXIS and stays replicated over parts;
+    indivisible leaves (small biases) stay fully replicated.
 
     Single-process this is a plain ``device_put``; multi-process it
     assembles each global array from this process's addressable shards
@@ -466,13 +492,19 @@ def put_replicated(tree, mesh: Mesh):
     bootstrap analog of the reference broadcasting initial weights to
     every GPU (``gnn.cc:78-91`` model build + Legion region mapping).
     """
-    sh = NamedSharding(mesh, P())
+    model = int(dict(mesh.shape).get(MODEL_AXIS, 1))
+
+    def sharding_of(x):
+        spec = model_shard_spec(np.shape(x), model)
+        return NamedSharding(mesh, P(*spec) if spec else P())
+
     if jax.process_count() == 1:
-        return jax.device_put(tree, sh)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding_of(x)), tree)
 
     def put(x):
         x = np.asarray(x)
-        return jax.make_array_from_callback(x.shape, sh,
+        return jax.make_array_from_callback(x.shape, sharding_of(x),
                                             lambda idx: x[idx])
     return jax.tree_util.tree_map(put, tree)
 
@@ -519,7 +551,19 @@ class DistributedTrainer:
         self.compute = compute_dtype_of(config)
         self.epoch = 0
         self.symmetric = resolve_symmetric(dataset, config.symmetric)
-        self.mesh = mesh if mesh is not None else make_mesh(num_parts)
+        # (parts, model) mesh knob: resolve_mesh validates the config
+        # against the positional parts count (they must agree — the
+        # parts axis IS the partition count); an injected mesh wins,
+        # and the model width is always read back off the mesh actually
+        # trained on so the sharding/step construction below cannot
+        # disagree with it
+        from ..train.trainer import resolve_mesh
+        _, mesh_model = resolve_mesh(
+            config, num_parts=num_parts,
+            num_devices=len(jax.devices()) if mesh is None else None)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            num_parts, model=mesh_model)
+        self._mesh_model = int(dict(self.mesh.shape).get(MODEL_AXIS, 1))
         if pg is not None and pg.num_parts != num_parts:
             raise ValueError(f"injected pg has {pg.num_parts} parts, "
                              f"trainer was asked for {num_parts}")
@@ -537,6 +581,12 @@ class DistributedTrainer:
         from ..core.costmodel import PartitionCostModel
         self._dataset = dataset
         self._partition_method = resolve_partition(config)
+        # workload flags for the φ features only this config pays:
+        # the per-edge softmax column (attention models) and the
+        # flat8 scan-length column (the flat layout family)
+        self._phi_flags = dict(
+            attn_edges=bool(self.model.uses_attention()),
+            flat8=config.aggr_impl in ("attn_flat8", "flat_sum"))
         self._costmodel = PartitionCostModel(
             node_multiple=8, edge_multiple=config.chunk)
         self._rebalances = 0
@@ -558,7 +608,8 @@ class DistributedTrainer:
             dataset.graph, num_parts,
             node_multiple=8, edge_multiple=config.chunk,
             method=self._partition_method,
-            cost_weights=self._costmodel.search_weights())
+            cost_weights=self._costmodel.search_weights(
+                **self._phi_flags))
         self.data = data if data is not None else self._build_data(
             self.pg)
         if config.aggr_impl == "bdense" and config.halo != "ring" \
@@ -737,6 +788,59 @@ class DistributedTrainer:
             bdense_group=config.bdense_group,
             aggr_fuse=self.model.num_fused_aggregates() > 0)
 
+    def _step_auto(self) -> frozenset:
+        """Mesh axes the shard_map steps leave to GSPMD: the model
+        axis of a 2-D mesh (the step bodies stay 1-D parts programs —
+        no in/out spec names MODEL_AXIS, and the partitioner threads
+        the params' model sharding through them); empty on the 1-D
+        mesh so the traced programs there are byte-identical to
+        before.
+
+        Empty for halo='ring' even on a 2-D mesh: under a partial-auto
+        shard_map this jax/XLA only supports ``psum`` over the manual
+        axes — ``all_gather``/``ppermute`` abort the SPMD partitioner
+        (IsManualSubgroup check) and ``axis_index`` lowers to an
+        unsupported PartitionId.  The gather/table paths route around
+        it (a psum-based gather + the part index as a sharded
+        argument, below), but the ring schedule is a ppermute loop by
+        construction — so ring steps run fully manual over BOTH axes
+        instead: every model replica runs the identical 1-D ring
+        program and params/opt state stay model-sharded AT REST only
+        (the jit in/out shardings still apply)."""
+        return (frozenset({MODEL_AXIS})
+                if self._mesh_model > 1 and self.config.halo != "ring"
+                else frozenset())
+
+    def _step_shardings(self):
+        """Explicit per-arg jit shardings for the 2-D-mesh steps, or
+        None on the 1-D mesh (where today's exact jit construction —
+        and hence the rigs' program keys — must stay byte-identical).
+        params/opt-state leaves pin their at-rest model sharding on
+        BOTH sides of the step, which is what keeps donation legal
+        under sharding (the donated input and the matching output
+        must agree on layout); data/table args pin the parts split
+        (a pytree-prefix sharding covers each nested table tuple);
+        key/lr/metrics stay replicated."""
+        if self._mesh_model <= 1:
+            return None
+        mesh, model = self.mesh, self._mesh_model
+
+        def of(x):
+            spec = model_shard_spec(np.shape(x), model)
+            return NamedSharding(mesh, P(*spec) if spec else P())
+        params_sh = jax.tree_util.tree_map(of, self.params)
+        opt_sh = jax.tree_util.tree_map(of, self.opt_state)
+        psh = NamedSharding(mesh, P(PARTS_AXIS))
+        rep = NamedSharding(mesh, P())
+        # the partial-auto steps take one extra trailing arg: the
+        # parts-sharded partition-index vector (_step_auto explains
+        # why axis_index cannot be used there)
+        extra = (psh,) if self._step_auto() else ()
+        return ((params_sh, opt_sh) + (psh,) * 14 + (rep, rep) + extra,
+                (params_sh, opt_sh, rep),
+                (params_sh,) + (psh,) * 14 + extra,
+                (rep, psh))
+
     def _build_steps(self) -> None:
         """(Re)build the observed step functions.  Called at init and
         after a shape-changing repartition; a shape-preserving
@@ -744,23 +848,55 @@ class DistributedTrainer:
         steady-state AOT executables are reused (no recompile)."""
         from ..obs.compile_watch import ObservedJit
         config = self.config
+        sharded = self._step_shardings()
+        # partial-auto steps read their partition index from this
+        # parts-sharded vector (one extra trailing arg) because
+        # lax.axis_index is not lowerable under a GSPMD auto axis
+        self._pids = None
+        if self._step_auto():
+            self._pids = jax.device_put(
+                np.arange(self.pg.num_parts, dtype=np.int32),
+                NamedSharding(self.mesh, P(PARTS_AXIS)))
         # the jax.jit calls sit lexically inside ObservedJit(jitfn=...)
         # — the sanctioned form roc-lint's bare-jit rule recognizes:
         # every step compiles through the observer
-        self._train_step = ObservedJit(
-            jitfn=jax.jit(self._build_train_step(),
-                          donate_argnums=(0, 1)),
-            name="dist_train_step", donate_argnums=(0, 1),
-            modeled_bytes=self._modeled_bytes, verbose=config.verbose)
+        if sharded is None:
+            self._train_step = ObservedJit(
+                jitfn=jax.jit(self._build_train_step(),
+                              donate_argnums=(0, 1)),
+                name="dist_train_step", donate_argnums=(0, 1),
+                modeled_bytes=self._modeled_bytes,
+                verbose=config.verbose)
+        else:
+            # 2-D mesh: pin the at-rest model sharding of params/opt
+            # state on both sides of the step (the pjit per-arg
+            # partition-spec + donation-vector pattern) so donation
+            # stays legal under sharding — the PR-14
+            # donation-under-sharding rule is the tripwire
+            t_in, t_out, _, _ = sharded
+            self._train_step = ObservedJit(
+                jitfn=jax.jit(self._build_train_step(),
+                              in_shardings=t_in, out_shardings=t_out,
+                              donate_argnums=(0, 1)),
+                name="dist_train_step", donate_argnums=(0, 1),
+                modeled_bytes=self._modeled_bytes,
+                verbose=config.verbose)
         # eval and predict share ONE compiled program: the eval step
         # returns (replicated metrics, SHARDED per-part logits) — the
         # logits already exist inside the step, so the extra output is
         # one [part_nodes, C] device buffer per eval, no collective,
         # and the program space loses a whole compiled program per
         # config (ISSUE 7).  evaluate() fetches only the metrics.
-        self._eval_step = ObservedJit(
-            jitfn=jax.jit(self._build_eval_step()),
-            name="dist_eval_step", verbose=config.verbose)
+        if sharded is None:
+            self._eval_step = ObservedJit(
+                jitfn=jax.jit(self._build_eval_step()),
+                name="dist_eval_step", verbose=config.verbose)
+        else:
+            _, _, e_in, e_out = sharded
+            self._eval_step = ObservedJit(
+                jitfn=jax.jit(self._build_eval_step(),
+                              in_shardings=e_in, out_shardings=e_out),
+                name="dist_eval_step", verbose=config.verbose)
         # multi-process predict needs the sharded logits replicated
         # before the host fetch; built lazily, never on rigs/tests
         self._predict_gather = None
@@ -773,7 +909,8 @@ class DistributedTrainer:
         from ..core.costmodel import (partition_static_stats,
                                       phi_matrix)
         self._phi_cache = phi_matrix(
-            self.pg, bd_occupancy=self.data.bd_occupancy)
+            self.pg, bd_occupancy=self.data.bd_occupancy,
+            **self._phi_flags)
         stats = partition_static_stats(
             self.pg, bd_occupancy=self.data.bd_occupancy,
             phi=self._phi_cache)
@@ -825,7 +962,8 @@ class DistributedTrainer:
         if self._phi_cache is None:
             from ..core.costmodel import phi_matrix
             self._phi_cache = phi_matrix(
-                self.pg, bd_occupancy=self.data.bd_occupancy)
+                self.pg, bd_occupancy=self.data.bd_occupancy,
+                **self._phi_flags)
         return self._phi_cache
 
     def straggler_fields(self, m: Dict[str, float]) -> Dict[str, float]:
@@ -894,7 +1032,7 @@ class DistributedTrainer:
                  f"attributed to part {p_star}", console=False,
                  part=p_star, epoch_ms=float(t),
                  n_obs=self._costmodel.n_obs)
-        wn, we = self._costmodel.search_weights()
+        wn, we = self._costmodel.search_weights(**self._phi_flags)
         row_ptr = self._dataset.graph.row_ptr
         nm = self.pg.node_multiple
         em = self.pg.edge_multiple
@@ -958,6 +1096,15 @@ class DistributedTrainer:
 
     # ---- step builders ----
 
+    def _psum_parts(self, t):
+        """``lax.psum`` over PARTS_AXIS, elided on a single-part mesh:
+        a size-1 manual axis still emits a cross-partition allreduce,
+        which the partial-auto partitioner rejects (1xM meshes) — and
+        the sum over one part is the identity anyway."""
+        if self.pg.num_parts == 1:
+            return t
+        return lax.psum(t, PARTS_AXIS)
+
     def _gctx(self) -> GraphContext:
         """GraphContext for *inside* the shard_map body (local blocks)."""
         from ..train.trainer import resolve_head_chunk
@@ -969,7 +1116,7 @@ class DistributedTrainer:
             gathered_rows=pgr.num_parts * pgr.part_nodes,
             gather_features=lambda x: lax.all_gather(
                 x, PARTS_AXIS, axis=0, tiled=True),
-            psum=lambda t: lax.psum(t, PARTS_AXIS),
+            psum=self._psum_parts,
             aggr_impl=self.config.aggr_impl,
             chunk=self.config.chunk,
             symmetric=self.symmetric,
@@ -986,7 +1133,8 @@ class DistributedTrainer:
     def _local_gctx(self, edge_src, edge_dst, in_degree, ell_idx,
                     ell_row_pos, ell_row_id, ring_idx, sect_idx,
                     sect_sub_dst, bd_tabs=(),
-                    fuse_tabs=((), (), (), ())) -> GraphContext:
+                    fuse_tabs=((), (), (), ()),
+                    pid=None) -> GraphContext:
         """Local-block GraphContext for a shard_map body: slice the
         parts axis off every table.  attn_flat8 and flat_sum carry
         their single-section uniform tables in the sect slots
@@ -995,9 +1143,31 @@ class DistributedTrainer:
         sect_w slot -> flat8_w); bdense carries its residual there
         and its dense tiles in bd_tabs.  ``fuse_tabs`` = (ell_w,
         sect_w, ring_w, bd_scale) — the baked fused-normalization
-        weights (empty tuples when unfused)."""
+        weights (empty tuples when unfused).
+
+        ``pid`` (partial-auto 2-D steps only) is this block's traced
+        partition index; it swaps ``gather_features`` for the
+        psum-based halo gather — ``lax.all_gather`` over a manual
+        axis aborts the SPMD partitioner when a GSPMD auto axis is
+        present (_step_auto), but a psum of disjointly-placed local
+        blocks is the same gathered matrix, and psum IS supported
+        there.  ~2x the all-gather bytes on ICI; only the 2-D path
+        pays it."""
         flat = self.config.aggr_impl in ("attn_flat8", "flat_sum")
         ell_w, sect_w, ring_w, bd_scale = fuse_tabs
+        extra = {}
+        if pid is not None:
+            num_parts = self.pg.num_parts
+
+            def gather_psum(x):
+                if num_parts == 1:
+                    return x        # single part: gather is identity
+                buf = jnp.zeros((num_parts,) + x.shape, x.dtype)
+                buf = lax.dynamic_update_index_in_dim(buf, x, pid, 0)
+                buf = lax.psum(buf, PARTS_AXIS)
+                return buf.reshape((num_parts * x.shape[0],)
+                                   + x.shape[1:])
+            extra["gather_features"] = gather_psum
         return dc_replace(
             self._gctx(), edge_src=edge_src, edge_dst=edge_dst,
             in_degree=in_degree,
@@ -1022,24 +1192,33 @@ class DistributedTrainer:
             ell_w=tuple(a[0] for a in ell_w),
             sect_w=() if flat else tuple(a[0] for a in sect_w),
             ring_w=ring_w[0][0] if ring_w else None,
-            bd_scale=tuple(a[0] for a in bd_scale))
+            bd_scale=tuple(a[0] for a in bd_scale),
+            **extra)
 
     def _build_train_step(self):
         mesh = self.mesh
         spec_p = P(PARTS_AXIS)
         spec_r = P()
+        auto = self._step_auto()
 
+        # the partial-auto variant takes one extra trailing arg: the
+        # parts-sharded partition-index vector (``*pids``), standing
+        # in for lax.axis_index which has no lowering under a GSPMD
+        # auto axis (_step_auto).  The 1-D signature — and hence the
+        # rigs' program keys — is untouched.
         def step(params, opt_state, feats, labels, mask, edge_src,
                  edge_dst, in_degree, ell_idx, ell_row_pos, ell_row_id,
                  ring_idx, sect_idx, sect_sub_dst, bd_tabs, fuse_tabs,
-                 key, lr):
+                 key, lr, *pids):
             # local blocks arrive with the parts axis collapsed to 1
             feats, labels, mask = feats[0], labels[0], mask[0]
+            pid = pids[0][0] if pids else None
             gctx = self._local_gctx(
                 edge_src[0], edge_dst[0], in_degree[0], ell_idx,
                 ell_row_pos, ell_row_id, ring_idx, sect_idx,
-                sect_sub_dst, bd_tabs, fuse_tabs)
-            part_key = jax.random.fold_in(key, lax.axis_index(PARTS_AXIS))
+                sect_sub_dst, bd_tabs, fuse_tabs, pid=pid)
+            part_key = jax.random.fold_in(
+                key, lax.axis_index(PARTS_AXIS) if pid is None else pid)
 
             def local_loss(p):
                 # mixed precision: fp32 master params cast per step;
@@ -1055,8 +1234,8 @@ class DistributedTrainer:
             local_l, grads = jax.value_and_grad(local_loss)(params)
             # the reference's replica-sum gradient allreduce
             # (optimizer_kernel.cu:88-94) as an ICI psum
-            grads = lax.psum(grads, PARTS_AXIS)
-            loss = lax.psum(local_l, PARTS_AXIS)
+            grads = self._psum_parts(grads)
+            loss = self._psum_parts(local_l)
             params, opt_state = adam_update(params, grads, opt_state, lr,
                                             self.adam_cfg)
             return params, opt_state, loss
@@ -1065,23 +1244,26 @@ class DistributedTrainer:
             step, mesh=mesh,
             in_specs=(spec_r, spec_r, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p, spec_p, spec_r, spec_r),
-            out_specs=(spec_r, spec_r, spec_r))
+                      spec_p, spec_p, spec_p, spec_p, spec_r, spec_r)
+            + ((spec_p,) if auto else ()),
+            out_specs=(spec_r, spec_r, spec_r),
+            auto=auto)
 
     def _local_forward(self, params, feats, edge_src, edge_dst,
                        in_degree, ell_idx, ell_row_pos, ell_row_id,
                        ring_idx, sect_idx, sect_sub_dst, bd_tabs,
-                       fuse_tabs=((), (), (), ())):
+                       fuse_tabs=((), (), (), ()), pid=None):
         """Shared shard_map body: slice the parts axis off the local
         blocks, assemble the local GraphContext, run the inference
         forward — eval (adds metrics+psum) and predict (adds
         all_gather) both build on this, so the gctx wiring exists in
-        ONE place."""
+        ONE place.  ``pid`` threads the partial-auto partition index
+        through to :meth:`_local_gctx`."""
         feats = feats[0]
         gctx = self._local_gctx(
             edge_src[0], edge_dst[0], in_degree[0], ell_idx,
             ell_row_pos, ell_row_id, ring_idx, sect_idx, sect_sub_dst,
-            bd_tabs, fuse_tabs)
+            bd_tabs, fuse_tabs, pid=pid)
         return self.model.apply(cast_floats(params, self.compute),
                                 feats, gctx, key=None, train=False)
 
@@ -1089,22 +1271,32 @@ class DistributedTrainer:
         mesh = self.mesh
         spec_p = P(PARTS_AXIS)
         spec_r = P()
+        auto = self._step_auto()
 
         def step(params, feats, labels, mask, *graph_args):
-            logits = self._local_forward(params, feats, *graph_args)
+            pid = None
+            if auto:
+                # trailing parts-sharded partition-index vector, same
+                # contract as the train step
+                *graph_args, pids = graph_args
+                pid = pids[0]
+            logits = self._local_forward(params, feats, *graph_args,
+                                         pid=pid)
             m = perf_metrics(logits, labels[0], mask[0])
             # (replicated metrics, sharded logits): predict() reuses
             # this program's logits output — no second compile, no
             # collective added to the eval path
-            return jax.tree_util.tree_map(
-                lambda t: lax.psum(t, PARTS_AXIS), m), logits
+            return jax.tree_util.tree_map(self._psum_parts,
+                                          m), logits
 
         return _shard_map(
             step, mesh=mesh,
             in_specs=(spec_r, spec_p, spec_p, spec_p, spec_p, spec_p,
                       spec_p, spec_p, spec_p, spec_p, spec_p, spec_p,
-                      spec_p, spec_p, spec_p),
-            out_specs=(spec_r, spec_p))
+                      spec_p, spec_p, spec_p)
+            + ((spec_p,) if auto else ()),
+            out_specs=(spec_r, spec_p),
+            auto=auto)
 
     # ---- loop ----
 
@@ -1116,13 +1308,14 @@ class DistributedTrainer:
             # epoch-boundary repartition swaps the sharded tables
             # mid-run and the next step must train on the new split
             d = self.data
+            extra = () if self._pids is None else (self._pids,)
             self.params, self.opt_state, _ = self._train_step(
                 self.params, self.opt_state, d.feats, d.labels,
                 d.mask, d.edge_src, d.edge_dst, d.in_degree,
                 d.ell_idx, d.ell_row_pos, d.ell_row_id, d.ring_idx,
                 d.sect_idx, d.sect_sub_dst, d.bd_tabs,
                 (d.ell_w, d.sect_w, d.ring_w, d.bd_scale),
-                step_key, lr)
+                step_key, lr, *extra)
 
         return run_epoch_loop(self, epochs, do_step, self.evaluate)
 
@@ -1135,11 +1328,13 @@ class DistributedTrainer:
 
     def _run_eval_step(self):
         d = self.data
+        extra = () if self._pids is None else (self._pids,)
         return self._eval_step(
             self.params, d.feats, d.labels, d.mask, d.edge_src,
             d.edge_dst, d.in_degree, d.ell_idx, d.ell_row_pos,
             d.ell_row_id, d.ring_idx, d.sect_idx, d.sect_sub_dst,
-            d.bd_tabs, (d.ell_w, d.sect_w, d.ring_w, d.bd_scale))
+            d.bd_tabs, (d.ell_w, d.sect_w, d.ring_w, d.bd_scale),
+            *extra)
 
     def _eval(self, epoch: int) -> Dict[str, float]:
         # fetch ONLY the metrics: the shared eval/predict program also
@@ -1255,5 +1450,10 @@ class DistributedTrainer:
             # local [part_nodes, C] -> replicated [P, part_nodes, C]
             return lax.all_gather(logits, PARTS_AXIS, axis=0)
 
+        # fully manual even on a 2-D mesh (NO auto axis): the logits
+        # carry no model sharding, and all_gather over a manual axis
+        # aborts the partitioner when an auto axis is present
+        # (_step_auto) — manual over both axes just replicates the
+        # gather across model replicas
         return _shard_map(step, mesh=mesh, in_specs=spec_p,
                           out_specs=spec_r)

@@ -118,23 +118,36 @@ def checkpoint_commit_barrier(tag: str) -> None:
 
 
 def make_parts_mesh(num_parts: Optional[int] = None,
-                    devices: Optional[List] = None) -> Mesh:
-    """1-D ``'parts'`` mesh across all processes' devices — alias of
+                    devices: Optional[List] = None,
+                    model: int = 1) -> Mesh:
+    """``'parts'`` (or 2-D ``('parts', 'model')`` when ``model > 1``)
+    mesh across all processes' devices — alias of
     :func:`roc_tpu.parallel.distributed.make_mesh` (one constructor,
     one partition->device layout; see its docstring for the DCN
-    locality invariant)."""
+    locality invariant).  The model axis is the FAST axis of the
+    device order, so a partition's model group stays within one
+    host's ICI domain whenever the host owns ``model`` consecutive
+    devices."""
     from .distributed import make_mesh
-    return make_mesh(num_parts, devices)
+    return make_mesh(num_parts, devices, model=model)
+
+
+def _part_device_rows(mesh: Mesh) -> np.ndarray:
+    """Mesh devices as a ``[parts, model]`` grid (model = 1 for the
+    1-D mesh) — row ``p`` holds every device that carries partition
+    ``p``'s ``P('parts')`` shard (replicated over the model axis)."""
+    return mesh.devices.reshape(mesh.devices.shape[0], -1)
 
 
 def process_local_parts(mesh: Mesh) -> List[int]:
-    """Partition indices whose device lives on this process — the set
-    of shards this host must load (the reference's per-node loader
-    tasks, ``load_task.cu:201-269``, selected by the mapper; here
-    selected by mesh placement)."""
+    """Partition indices with at least one device on this process —
+    the set of shards this host must load (the reference's per-node
+    loader tasks, ``load_task.cu:201-269``, selected by the mapper;
+    here selected by mesh placement).  On a 2-D mesh a partition is
+    local when ANY of its model-axis devices is."""
     pid = jax.process_index()
-    return [i for i, d in enumerate(mesh.devices.reshape(-1))
-            if d.process_index == pid]
+    return [i for i, row in enumerate(_part_device_rows(mesh))
+            if any(d.process_index == pid for d in row)]
 
 
 def make_sharded_array(mesh: Mesh, local_parts: List[int],
@@ -145,14 +158,19 @@ def make_sharded_array(mesh: Mesh, local_parts: List[int],
 
     local_shards[i] is the [1, ...] slice for partition
     ``local_parts[i]``.  On a single process this reduces to a plain
-    ``device_put`` of the stacked array.
+    ``device_put`` of the stacked array.  On a 2-D mesh each
+    partition's shard is replicated onto every addressable device of
+    its model row — the data axes never shard over ``model``.
     """
     sharding = NamedSharding(mesh, P(PARTS_AXIS))
-    devices = mesh.devices.reshape(-1)
-    singles = [
-        jax.device_put(np.ascontiguousarray(shard), devices[part])
-        for part, shard in zip(local_parts, local_shards)
-    ]
+    rows = _part_device_rows(mesh)
+    pid = jax.process_index()
+    singles = []
+    for part, shard in zip(local_parts, local_shards):
+        arr = np.ascontiguousarray(shard)
+        for d in rows[part]:
+            if d.process_index == pid:
+                singles.append(jax.device_put(arr, d))
     return jax.make_array_from_single_device_arrays(
         global_shape, sharding, singles)
 
@@ -165,7 +183,7 @@ def _allreduce_part_vec_max(mesh: Mesh, local: List[int],
     if jax.process_count() == 1:
         return np.max(np.stack([vecs[p] for p in local]), axis=0)
     import jax.numpy as jnp
-    num_parts = int(mesh.devices.size)
+    num_parts = int(mesh.devices.shape[0])
     width = len(next(iter(vecs.values())))
     arr = make_sharded_array(
         mesh, local,
@@ -195,7 +213,7 @@ def _allreduce_part_stats(mesh: Mesh, local: List[int],
         return (max(v[0] for v in stats.values()),
                 sum(v[1] for v in stats.values()))
     import jax.numpy as jnp
-    num_parts = int(mesh.devices.size)
+    num_parts = int(mesh.devices.shape[0])
     arr = make_sharded_array(
         mesh, local,
         [np.asarray([[stats[p][0], stats[p][1]]], dtype=np.int64)

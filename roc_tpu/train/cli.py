@@ -73,6 +73,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     ap.add_argument("--parts", type=int, default=1,
                     help="graph partitions == mesh devices (the "
                          "reference's numMachines*numGPUs)")
+    ap.add_argument("--mesh", type=str, default="auto",
+                    help="device mesh shape PxM (parts x model), "
+                         "e.g. 2x4: P must equal --parts and M > 1 "
+                         "feature-shards the params and Adam moments "
+                         "over the model axis of the (parts, model) "
+                         "2-D mesh (needs P*M devices); 'auto' "
+                         "(default) = every device on the parts axis "
+                         "— today's exact 1-D behavior")
     ap.add_argument("--impl", default="auto",
                     choices=["auto", "segment", "blocked", "scan", "ell",
                              "sectioned", "pallas", "bdense",
@@ -323,6 +331,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     except ValueError as e:
         print(f"error: --head-chunk: {e}", file=sys.stderr)
         return 2
+    # ONE validator (train/trainer.py resolve_mesh) again: the CLI,
+    # both trainers, multihost, and the rigs share the PxM vocabulary
+    from .trainer import resolve_mesh
+    try:
+        resolve_mesh(TrainConfig(mesh=args.mesh),
+                     num_parts=max(args.parts, 1))
+    except ValueError as e:
+        print(f"error: --mesh: {e}", file=sys.stderr)
+        return 2
     if args.rebalance and args.parts <= 1:
         print("error: --rebalance requires --parts > 1 (rebalancing "
               "moves partition boundaries over a device mesh)",
@@ -432,7 +449,7 @@ def main(argv: Optional[List[str]] = None) -> int:
          f"E={ds.graph.num_edges} layers={layers} model={args.model} "
          f"lr={args.lr} wd={args.weight_decay} dropout={args.dropout} "
          f"decay={args.decay_rate}/{args.decay_steps} parts={args.parts} "
-         f"impl={args.impl}")
+         f"mesh={args.mesh} impl={args.impl}")
 
     from ..models import model_builders
     build = model_builders()
@@ -463,7 +480,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         prefetch=args.prefetch, partition=args.partition,
         rebalance=args.rebalance, head_chunk=args.head_chunk,
         cache_min_compile_secs=args.cache_min_secs,
-        async_save=args.async_save, fault=args.fault,
+        async_save=args.async_save, fault=args.fault, mesh=args.mesh,
         dtype=dt, compute_dtype=cdt, metrics_path=args.metrics)
 
     from ..obs.heartbeat import StallFailure

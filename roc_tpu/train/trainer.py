@@ -196,6 +196,16 @@ class TrainConfig:
     # once per process — the drill harness (tests/test_drills.py)
     # injects, restarts, and asserts the run still finishes.
     fault: Optional[str] = None
+    # Device mesh shape "PxM" (parts x model) or "auto" (= all
+    # devices on the parts axis — today's exact 1-D behavior; a
+    # single-device Trainer resolves to 1x1).  model > 1 builds the
+    # (parts, model) 2-D mesh: params + Adam moments live
+    # model-sharded at rest (parallel.model_shard_spec picks the
+    # feature dim), the streamed-head [V, H] handoff is pinned
+    # model-sharded, and the 1-D shard_map step bodies are reused
+    # unchanged with MODEL_AXIS as a GSPMD auto axis.  Validated by
+    # resolve_mesh (the CLI's --mesh routes through it too).
+    mesh: Any = "auto"
 
 
 def resolve_dtypes(name: str):
@@ -298,6 +308,52 @@ def resolve_partition(config: TrainConfig) -> str:
         return p
     raise ValueError(f"unknown partition {p!r}; expected 'greedy', "
                      "'cost', or 'auto'")
+
+
+def resolve_mesh(config: TrainConfig,
+                 num_parts: Optional[int] = None,
+                 num_devices: Optional[int] = None):
+    """``TrainConfig.mesh`` -> the concrete ``(parts, model)`` shape.
+
+    'auto' = ``(num_parts or 1, 1)`` — exactly today's 1-D layout (the
+    degenerate all-parts shape of ``parallel.candidate_mesh_shapes``).
+    A "PxM" string names both axes explicitly; a (p, m) tuple is taken
+    literally.  ONE validator — the CLI routes --mesh through this
+    same function, and both trainer constructors resolve through it,
+    so the vocabularies can never diverge.  When ``num_parts`` is
+    given (the DistributedTrainer's positional parts count), an
+    explicit P must match it; when ``num_devices`` is given, p*m must
+    fit."""
+    v = config.mesh
+    if v in (None, "auto"):
+        p, m = (int(num_parts) if num_parts else 1), 1
+    else:
+        if isinstance(v, str):
+            try:
+                ps, ms = v.lower().split("x")
+                p, m = int(ps), int(ms)
+            except ValueError:
+                raise ValueError(
+                    f"unknown mesh {v!r}; expected 'auto' or 'PxM' "
+                    "(e.g. '2x4')") from None
+        else:
+            try:
+                p, m = (int(v[0]), int(v[1]))
+            except (TypeError, ValueError, IndexError):
+                raise ValueError(
+                    f"unknown mesh {v!r}; expected 'auto', 'PxM', or "
+                    "a (parts, model) pair") from None
+        if p < 1 or m < 1:
+            raise ValueError(f"mesh axes must be >= 1, got {p}x{m}")
+        if num_parts is not None and p != int(num_parts):
+            raise ValueError(
+                f"mesh {p}x{m} names {p} parts but the trainer was "
+                f"built with {num_parts} partitions — the parts axis "
+                "IS the partition count")
+    if num_devices is not None and p * m > int(num_devices):
+        raise ValueError(
+            f"mesh {p}x{m} needs {p * m} devices, have {num_devices}")
+    return p, m
 
 
 def compute_dtype_of(config: TrainConfig):
@@ -865,6 +921,22 @@ class Trainer:
         self.params = model.init_params(init_key, dtype=config.dtype)
         self.opt_state = adam_init(self.params)
         self.adam_cfg = AdamConfig(weight_decay=config.weight_decay)
+        # (parts, model) mesh knob: a single-device Trainer hosts only
+        # the model axis (parts is always 1 here — partitioning is the
+        # DistributedTrainer's job).  model > 1 places params + Adam
+        # moments model-sharded at rest (put_replicated picks the dim
+        # via parallel.model_shard_spec); the plain jitted steps then
+        # inherit the layout through GSPMD (computation follows data),
+        # and the streamed-head [V, H] handoff is pinned via
+        # _pin_stream.
+        _, self._mesh_model = resolve_mesh(
+            config, num_parts=1, num_devices=len(jax.devices()))
+        self.mesh = None
+        if self._mesh_model > 1:
+            from ..parallel.distributed import make_mesh, put_replicated
+            self.mesh = make_mesh(1, model=self._mesh_model)
+            self.params = put_replicated(self.params, self.mesh)
+            self.opt_state = put_replicated(self.opt_state, self.mesh)
         self._head = None
         self._head_chunk = resolve_head_chunk(
             config, dataset.graph.num_nodes)
@@ -1058,6 +1130,24 @@ class Trainer:
     def _apply_update_impl(self, params, opt_state, grads, lr):
         return adam_update(params, grads, opt_state, lr, self.adam_cfg)
 
+    def _pin_stream(self, y):
+        """Model-shard the streamed-head [V, H] handoff: under a
+        model mesh the block-assembled Y would otherwise land fully
+        replicated (it is built by per-block device_puts outside any
+        jit) and sit at the top of the replication ledger.  One
+        device_put re-lays it out H-sharded; the tail programs then
+        consume it sharded (GSPMD).  No-op on the 1-D mesh or when H
+        does not divide."""
+        if self.mesh is None:
+            return y
+        from jax.sharding import NamedSharding, PartitionSpec
+        from ..parallel import model_shard_spec
+        spec = model_shard_spec(y.shape, self._mesh_model)
+        if spec is None:
+            return y
+        return jax.device_put(
+            y, NamedSharding(self.mesh, PartitionSpec(*spec)))
+
     def _streamed_step(self, step_key, lr):
         head_key, tail_key = jax.random.split(step_key)
         # cast the master weight to the compute dtype so the streamed
@@ -1069,7 +1159,8 @@ class Trainer:
         timer = self.timer
         w0 = self.params[self._head_param].astype(self.compute)
         with timer.span("head_forward"):
-            y = self._head.forward(w0, self.feats_host, head_key, True)
+            y = self._pin_stream(
+                self._head.forward(w0, self.feats_host, head_key, True))
         with timer.span("tail_grad"):
             _, grads, gy = self._tail_grad(self.params, y, tail_key,
                                            self.labels, self.mask,
@@ -1160,7 +1251,8 @@ class Trainer:
         ops)."""
         if self._head is not None:
             w0 = self.params[self._head_param].astype(self.compute)
-            y = self._head.forward(w0, self.feats_host, None, False)
+            y = self._pin_stream(
+                self._head.forward(w0, self.feats_host, None, False))
             _, logits = self._tail_eval(self.params, y, self.labels,
                                         self.mask, self.gctx)
         else:
@@ -1184,7 +1276,8 @@ class Trainer:
         # during training evals
         if self._head is not None:
             w0 = self.params[self._head_param].astype(self.compute)
-            y = self._head.forward(w0, self.feats_host, None, False)
+            y = self._pin_stream(
+                self._head.forward(w0, self.feats_host, None, False))
             m, _ = self._tail_eval(self.params, y, self.labels,
                                    self.mask, self.gctx)
             return summarize_metrics(jax.device_get(m))

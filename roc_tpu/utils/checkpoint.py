@@ -175,7 +175,8 @@ def trainer_fingerprint(trainer) -> Dict[str, Any]:
         "part_edges": int(pg.part_edges) if pg is not None else None}
     if cfg is not None:
         elastic.update(aggr_impl=cfg.aggr_impl, halo=cfg.halo,
-                       features=cfg.features)
+                       features=cfg.features,
+                       mesh=getattr(cfg, "mesh", "auto"))
     return {"strict": strict, "elastic": elastic}
 
 

@@ -204,11 +204,13 @@ def prewarm_config(name: str, dataset=None,
     from ..analysis.programspace import (build_rig_dataset,
                                          build_rig_trainer,
                                          candidate_programs,
-                                         rig_configs)
+                                         rig_configs,
+                                         rig_required_devices)
     spec = rig_configs()[name]
-    if spec.parts > len(jax.devices()):
+    needed = rig_required_devices(spec)
+    if needed > len(jax.devices()):
         emit("compile", f"prewarm {name}: skipped (needs "
-             f"{spec.parts} devices, have {len(jax.devices())})",
+             f"{needed} devices, have {len(jax.devices())})",
              console=verbose, prewarm=name, skipped=True)
         return None
     d = enable_compile_cache(cache_dir, min_compile_secs=0.0)

@@ -416,6 +416,7 @@ def dcn_ref():
     return get
 
 
+@pytest.mark.slow
 def test_drill_dcn_two_process_sigkill_recovery(tmp_path, dcn_ref):
     """The drill matrix's REAL multi-process DCN arm (advertised since
     PR 8): 2 gloo-loopback processes x 2 devices (P=4), a

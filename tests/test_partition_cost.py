@@ -226,6 +226,103 @@ def test_search_weights_never_degenerate():
     assert wn + we > 0
 
 
+def test_phi_attention_and_flat8_columns():
+    """The attention/flat8 φ columns fill only for workloads that run
+    that code: attn_edges mirrors the padded edge count, flat8_chunks
+    is the 8-wide sub-row count; both are 0 otherwise (keeping their
+    fitted weights anchored to the prior for other workloads)."""
+    g = synthetic_graph(120, 6, seed=7, power_law=True)
+    pg = partition_graph(g, 4, node_multiple=8, edge_multiple=32)
+    base = CM.phi_matrix(pg)
+    ia = CM.PHI.index("attn_edges")
+    ic = CM.PHI.index("flat8_chunks")
+    assert (base[:, ia] == 0).all() and (base[:, ic] == 0).all()
+    phi = CM.phi_matrix(pg, attn_edges=True, flat8=True)
+    np.testing.assert_array_equal(
+        phi[:, ia], phi[:, CM.PHI.index("padded_edges")])
+    real_e = np.asarray(pg.real_edges, dtype=np.int64)
+    np.testing.assert_array_equal(phi[:, ic], -(-real_e // 8))
+    # the other columns are untouched by the flags
+    np.testing.assert_array_equal(np.delete(base, (ia, ic), axis=1),
+                                  np.delete(phi, (ia, ic), axis=1))
+
+
+def test_attention_features_fit_path():
+    """The ridge fit separates the per-edge softmax cost from the base
+    edge rate when both columns vary, and search_weights folds the
+    attention/flat8 weights into the effective edge rate only for
+    workloads carrying those flags."""
+    m = CM.PartitionCostModel()
+    # cold start: the prior already charges attention/flat8 work, so
+    # `--partition cost` stops under-balancing them before the first
+    # measurement arrives
+    wn0, we0 = m.search_weights()
+    _, we0a = m.search_weights(attn_edges=True)
+    _, we0f = m.search_weights(flat8=True)
+    assert we0a == pytest.approx(
+        we0 + CM._PRIOR_RAW[CM.PHI.index("attn_edges")])
+    assert we0f == pytest.approx(
+        we0 + CM._PRIOR_RAW[CM.PHI.index("flat8_chunks")] / 8.0)
+    # synthetic truth: 3e-3 ms/k-edge base + 2e-3 ms/k-edge softmax
+    # on attention workloads, mixed observations from both kinds
+    rng = np.random.RandomState(3)
+    for i in range(400):
+        phi = np.zeros(len(CM.PHI))
+        phi[CM.PHI.index("intercept")] = 1.0
+        e = float(rng.randint(128, 1 << 20))
+        phi[CM.PHI.index("padded_edges")] = e
+        t = 3e-3 * e
+        if i % 2:                       # attention workload
+            phi[CM.PHI.index("attn_edges")] = e
+            t += 2e-3 * e
+        m.observe(phi, t)
+    w = m.weights_raw()
+    assert w[CM.PHI.index("padded_edges")] == pytest.approx(3e-3,
+                                                            rel=0.05)
+    assert w[CM.PHI.index("attn_edges")] == pytest.approx(2e-3,
+                                                          rel=0.05)
+    wn, we = m.search_weights()
+    _, we_attn = m.search_weights(attn_edges=True)
+    assert we == pytest.approx(3e-3, rel=0.05)
+    assert we_attn == pytest.approx(5e-3, rel=0.05)
+    # flat8: the chunk weight lands per 8-wide sub-row and folds /8
+    m2 = CM.PartitionCostModel()
+    for _ in range(200):
+        phi = np.zeros(len(CM.PHI))
+        phi[CM.PHI.index("intercept")] = 1.0
+        e = float(rng.randint(1024, 1 << 20))
+        phi[CM.PHI.index("padded_edges")] = e
+        phi[CM.PHI.index("flat8_chunks")] = e / 8.0
+        m2.observe(phi, 3e-3 * e + 8e-3 * (e / 8.0))
+    _, we_f = m2.search_weights(flat8=True)
+    assert we_f == pytest.approx(3e-3 + 8e-3 / 8.0, rel=0.05)
+
+
+def test_trainer_phi_flags_follow_workload(dataset):
+    """DistributedTrainer threads the workload flags: a GAT on the
+    flat8 attention layout charges both columns; a plain GCN charges
+    neither."""
+    from roc_tpu.models.gat import build_gat
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the 8-virtual-device rig")
+    cfg = TrainConfig(verbose=False, dropout_rate=0.0,
+                      aggr_impl="attn_flat8", eval_every=1 << 30)
+    tr = DistributedTrainer(
+        build_gat([dataset.in_dim, 8, dataset.num_classes], heads=2,
+                  dropout_rate=0.0), dataset, 2, cfg)
+    assert tr._phi_flags == {"attn_edges": True, "flat8": True}
+    phi = tr._phi()
+    assert (phi[:, CM.PHI.index("attn_edges")] > 0).all()
+    assert (phi[:, CM.PHI.index("flat8_chunks")] > 0).all()
+    tr2 = DistributedTrainer(
+        build_gcn([dataset.in_dim, 8, dataset.num_classes],
+                  dropout_rate=0.0), dataset, 2,
+        TrainConfig(verbose=False, dropout_rate=0.0,
+                    aggr_impl="segment", eval_every=1 << 30))
+    assert tr2._phi_flags == {"attn_edges": False, "flat8": False}
+    assert (tr2._phi()[:, CM.PHI.index("attn_edges")] == 0).all()
+
+
 def test_phi_matrix_and_halo_stats():
     g = synthetic_graph(120, 6, seed=7, power_law=True)
     pg = partition_graph(g, 4, node_multiple=8, edge_multiple=32)

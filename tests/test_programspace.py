@@ -339,15 +339,20 @@ class _Recorder:
         pass
 
 
-@pytest.mark.parametrize("name", ["gin_flat8", "sgc_stream"])
+@pytest.mark.parametrize("name", ["gin_flat8", "sgc_stream",
+                                  "gin_mesh2d"])
 def test_program_key_parity_static_vs_live(rig_dataset, name):
-    """THE acceptance criterion: for both rig configs the auditor's
+    """THE acceptance criterion: for these rig configs the auditor's
     statically enumerated program-key set exactly matches the set of
     programs ObservedJit records compiling in a live
-    train+eval+predict run — no under- or over-enumeration."""
+    train+eval+predict run — no under- or over-enumeration.  The 2-D
+    rig bounds the mesh PR's program growth to exactly its declared
+    new step variants (sharded-in/out train + eval keys)."""
+    from roc_tpu.analysis.programspace import rig_required_devices
     spec = rig_configs()[name]
-    if spec.parts > len(jax.devices()):
-        pytest.skip(f"needs {spec.parts} devices")
+    need = rig_required_devices(spec)
+    if need > len(jax.devices()):
+        pytest.skip(f"needs {need} devices")
     space = enumerate_programs(spec, dataset=rig_dataset)
     static = space.observed_keys()
     rec = _Recorder()
@@ -619,7 +624,8 @@ def test_cli_strict_fails_on_budget_slack(tmp_path):
                         timeout=180, env=env)
     assert r3.returncode == 0, r3.stdout + r3.stderr
     assert json.loads(bp.read_text())["program_budget"] == \
-        {"gin_flat8": 2, "sgc_stream": 6, "sgc_serve": 4}
+        {"gin_flat8": 2, "sgc_stream": 6, "sgc_serve": 4,
+         "gin_mesh2d": 2}
 
 
 def test_cli_json_reports_program_space():
@@ -638,7 +644,8 @@ def test_cli_json_reports_program_space():
     payload = json.loads(r.stdout)
     assert payload["summary"]["new"] == 0
     reports = {p["config"]: p for p in payload["program_space"]}
-    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve"}
+    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve",
+                            "gin_mesh2d"}
     for rep in reports.values():
         assert rep["programs"] == len(rep["keys"])
         assert rep["budget"] is not None

@@ -92,6 +92,27 @@ def test_bench_round_extracts_overlap_frac(tmp_path):
     assert check_run(rounds, {"overlap_frac": 0.6})["ok"]
 
 
+def test_bench_round_extracts_mesh_ratio(tmp_path):
+    """ISSUE-16 satellite: the micro stage's mesh:2d row carries the
+    best-2-D-over-1-D epoch ratio; load_bench_round mines it and the
+    gate bites when the model-sharded step slows relative to 1-D."""
+    from roc_tpu.obs.sentinel import load_bench_round
+    doc = {"parsed": {"value": 100.0, "unit": "ms", "stages": {
+        "micro": {"impls": {
+            "mesh:1d": {"epoch_ms": 50.0, "shape": "8x1"},
+            "mesh:2d": {"epoch_ms": 46.0, "shape": "2x4",
+                        "mesh_epoch_ratio": 0.92},
+        }}}}}
+    p = tmp_path / "BENCH_r10.json"
+    p.write_text(json.dumps(doc))
+    r = load_bench_round(str(p))
+    assert r["mesh_epoch_ratio"] == 0.92
+    rounds = [dict(r, path=f"r{i}") for i in range(3)]
+    res = check_run(rounds, {"mesh_epoch_ratio": 1.9})
+    assert "mesh_epoch_ratio" in res["regressed"]
+    assert check_run(rounds, {"mesh_epoch_ratio": 0.95})["ok"]
+
+
 def test_serve_availability_checks_bite():
     """ISSUE-13 satellite: the availability triple gates the serve
     trajectory — a healthy all-zero shed history still bites on a

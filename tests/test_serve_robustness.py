@@ -343,6 +343,22 @@ def test_router_failover_replica_sigkill(artifact, tmp_path):
                     env=_router_env("replica_sigkill:2:1"),
                     default_deadline_ms=20_000.0,
                     replica_args=["--drain-timeout", "3"]) as router:
+            # Warm the query path on BOTH replicas before the burst: a
+            # replica still wedged in its first dispatch never reaches
+            # the armed microbatch index — every hedge quietly lands on
+            # the other replica and the kill site never fires.  Probes
+            # go in pairs (least-loaded dispatch breaks an idle tie
+            # toward replica 0, so singles warm only one side); a
+            # replica the site already killed counts as warmed-enough.
+            t_warm = time.monotonic() + 120.0
+            while time.monotonic() < t_warm:
+                for p in [router.submit([0, 1]) for _ in range(2)]:
+                    p.result(timeout=60)
+                reps = router.stats()["replicas"]
+                if (any(not r["alive"] for r in reps)
+                        or all(r["served"] > 0 for r in reps)):
+                    break
+                time.sleep(0.05)
             futs = []
             for i in range(60):
                 futs.append((i, router.submit([i % ds.graph.num_nodes,

@@ -266,10 +266,13 @@ def test_ledger_and_replicated_bytes():
     assert by_role["data"]["split"] == [PARTS_AXIS]
     assert by_role["data"]["replicated"] == [MODEL_AXIS]
     assert by_role["data"]["per_device_bytes"] == 256 * 48 * 4 // 2
-    assert by_role["params"]["replicated"] == [PARTS_AXIS,
-                                               MODEL_AXIS]
-    assert by_role["params"]["per_device_bytes"] == 48 * 48 * 4
-    # everything is model-replicated today -> all per-device bytes
+    # params F-shard over model at rest (put_replicated); still
+    # replicated over parts
+    assert by_role["params"]["split"] == [MODEL_AXIS]
+    assert by_role["params"]["replicated"] == [PARTS_AXIS]
+    assert by_role["params"]["per_device_bytes"] == 48 * 48 * 4 // 4
+    # every row is still replicated over SOME >1 axis here (data over
+    # model, params over parts) -> all per-device bytes count
     assert replicated_bytes(entries) == sum(
         e["per_device_bytes"] for e in entries)
     # trivial mesh: nothing is "replicated" on one device
@@ -323,15 +326,21 @@ def test_mesh_portability_golden_gin_flat8(tree_audit):
 def test_mesh_portability_golden_sgc_stream(tree_audit):
     """The streamed-head rig's traced programs are mesh-agnostic (no
     full-width sites — the [V, H] handoff is a ledger fact, not an op
-    defect), and the ledger carries the [V, H]/[V, F] buffers as
-    model-replicated: the 2-D mesh's reclaim target."""
+    defect).  The [V, H] handoff (role ``stream``) now F-shards over
+    model — the top reclaimed ledger row — while the [V, F] graph
+    data stays model-replicated."""
     _, reports = tree_audit
     rep = reports["sgc_stream"]
     assert [s for slot in rep["slots"] for s in slot["sites"]] == []
     big = [e for e in rep["ledger"]
            if e["shape"] and e["shape"][0] == 256]
     assert big, rep["ledger"]
-    assert all(MODEL_AXIS in e["replicated"] for e in big)
+    stream = [e for e in big if e["role"] == "stream"]
+    assert stream, big
+    assert all(MODEL_AXIS in e["split"] and
+               MODEL_AXIS not in e["replicated"] for e in stream)
+    rest = [e for e in big if e["role"] != "stream"]
+    assert all(MODEL_AXIS in e["replicated"] for e in rest)
     # modeled per-device HBM shrinks as the model axis widens — the
     # quantitative case for feature sharding
     per_dev = {(m["parts"], m["model"]): m["per_device_bytes"]
@@ -341,7 +350,8 @@ def test_mesh_portability_golden_sgc_stream(tree_audit):
 
 def test_reports_cover_all_rigs_and_budget(tree_audit):
     _, reports = tree_audit
-    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve"}
+    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve",
+                            "gin_mesh2d"}
     from roc_tpu.analysis.findings import load_budget
     budget = load_budget(os.path.join(_REPO, "scripts",
                                       "lint_baseline.json"),
@@ -386,7 +396,7 @@ def test_sharding_events_emitted():
         bus.sinks.remove(cap)
     got = [r for r in cap.recs if r.get("cat") == "sharding"]
     assert {r["config"] for r in got} == \
-        {"gin_flat8", "sgc_stream", "sgc_serve"}
+        {"gin_flat8", "sgc_stream", "sgc_serve", "gin_mesh2d"}
     for r in got:
         assert "replicated_bytes" in r and "mesh_shapes" in r
 
@@ -442,7 +452,8 @@ def test_cli_strict_fails_on_replication_slack_and_unbounded(tmp_path):
     r3 = _run_cli(args + ["--strict", "--update-baseline"])
     assert r3.returncode == 0, r3.stdout + r3.stderr
     budget = json.loads(bp.read_text())["replication_budget"]
-    assert set(budget) == {"gin_flat8", "sgc_stream", "sgc_serve"}
+    assert set(budget) == {"gin_flat8", "sgc_stream", "sgc_serve",
+                           "gin_mesh2d"}
     # slack now: inflate one bound by hand
     budget2 = dict(budget, gin_flat8=budget["gin_flat8"] + 5)
     bp.write_text(json.dumps({"version": 1, "findings": [],
@@ -471,7 +482,8 @@ def test_cli_json_carries_ledger_and_sites():
     assert r.returncode == 0, r.stdout + r.stderr
     payload = json.loads(r.stdout)
     reports = {p["config"]: p for p in payload["sharding"]}
-    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve"}
+    assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve",
+                            "gin_mesh2d"}
     rep = reports["gin_flat8"]
     assert rep["delta"] == 0
     assert rep["ledger"] and rep["mesh_shapes"]
@@ -499,10 +511,25 @@ def test_report_sharding_renders():
             cwd=_REPO, capture_output=True, text=True, timeout=120,
             env=env)
         assert r2.returncode == 0, r2.stdout + r2.stderr
-        for needle in ("== sharding gin_flat8", "1x8", "2x4", "4x2",
+        for needle in ("== sharding gin_flat8", "== sharding gin_mesh2d",
+                       "1x8", "2x4", "4x2",
                        "full-width-materialization sites",
                        "replication ledger", "shard_map"):
             assert needle in r2.stdout, (needle, r2.stdout[-2000:])
+        # the 2-D-mesh golden: params / opt-state / the streamed-head
+        # handoff have LEFT the model-replicated ledger — split over
+        # 'model', replicated only over 'parts' — in the payload, and
+        # the stream row renders that way in the ledger table
+        payload = json.loads(r.stdout)
+        stream_rep = next(p for p in payload["sharding"]
+                          if p["config"] == "sgc_stream")
+        moved = {e["role"] for e in stream_rep["ledger"]
+                 if "model" in e["split"]
+                 and "model" not in e["replicated"]}
+        assert {"params", "opt_state", "stream"} <= moved, moved
+        assert any(ln.strip().startswith("stream ") and "model" in ln
+                   for ln in r2.stdout.splitlines()), \
+            r2.stdout[-2000:]
         # an explicitly-passed payload renders even when event files
         # are ALSO given (after the event summary)
         ev_path = os.path.join(_REPO, "benchmarks",
