@@ -1057,10 +1057,20 @@ def child_serve(args) -> dict:
                 queries=120, batch=4)
         except Exception as e:  # noqa: BLE001 - latency rows survive
             drill = {"error": _errstr(e)}
+        # the quiet SLO smoke (PR 17): 2-replica Router with declared
+        # objectives, 100-query load-gen, health() must be green —
+        # the serve_slo_ok headline column the sentinel gates
+        try:
+            from roc_tpu.models.builder import Model
+            slo_smoke = ms.run_slo_smoke(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                queries=100, batch=4)
+        except Exception as e:  # noqa: BLE001 - latency rows survive
+            slo_smoke = {"error": _errstr(e)}
     out = {"platform": dev.platform, "device_kind": dev.device_kind,
            "V": int(ds.graph.num_nodes), "E": int(ds.graph.num_edges),
            "queries": 200, "batch": 4, "backends": rows,
-           "router_drill": drill}
+           "router_drill": drill, "slo_smoke": slo_smoke}
     pre, full = rows.get("precomputed"), rows.get("full")
     if pre and full:
         out["speedup_p50"] = round(
@@ -1569,8 +1579,20 @@ def parent(args, argv) -> int:
             serve_fields = {"serve_p50_ms": closed.get("p50_ms"),
                             "serve_p99_ms": closed.get("p99_ms"),
                             "serve_qps": closed.get("qps"),
+                            # PR 17: server-side latency decomposition
+                            # (queue delay vs device wall)
+                            "serve_queue_p50_ms":
+                                closed.get("queue_p50_ms"),
+                            "serve_device_p50_ms":
+                                closed.get("device_p50_ms"),
                             "serve_speedup_p50":
                                 sv["result"].get("speedup_p50")}
+        # the SLO smoke verdict: 1.0 = Router.health() green on a
+        # quiet 100-query load-gen (sentinel-gated higher-better)
+        smoke = sv["result"].get("slo_smoke") or {}
+        if smoke.get("ok") is not None:
+            serve_fields["serve_slo_ok"] = (1.0 if smoke.get("ok")
+                                            else 0.0)
         # availability columns from the kill-a-replica router drill —
         # the sentinel gates these over the BENCH trajectory exactly
         # like serve_p50_ms (obs/sentinel.py serve_shed_rate /

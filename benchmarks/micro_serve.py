@@ -23,6 +23,16 @@ Reported per backend: p50/p99 request latency (submit→result), QPS
 speedup row divides full-graph p50 by precomputed p50 — the measured
 form of "the fixed-propagation family collapses at serving time".
 
+Closed-loop rows also decompose server-side latency into
+``queue_p50_ms`` (admission → dispatch) vs ``device_p50_ms`` (the
+microbatch's device wall) from the PR-17 ``ServeResult`` stamps, and
+the ``precomputed_noobs`` row re-runs the same load with
+``instrument=False`` — the observability-overhead A/B the "registry +
+tracing within 5% of instrumentation-off" acceptance reads
+(``obs_overhead_pct``).  ``--slo-smoke`` runs ONLY the CI serving
+gate: export → cold-load behind a 2-replica Router with declared
+SLOs → quiet load-gen → exit 0 iff ``Router.health()`` is green.
+
 Usage: python benchmarks/micro_serve.py [--cpu] [--queries N]
        [--rate QPS|auto] [--out out.json]
 The CPU rehearsal artifact lives at benchmarks/micro_serve_cpu.json;
@@ -66,14 +76,25 @@ def _pcts(lat_ms):
 
 
 def closed_loop(server, ids_seq):
-    """One outstanding query at a time; returns latency list + wall."""
-    lat = []
+    """One outstanding query at a time; returns latency list + wall
+    + the server-side queue/device decomposition (``ServeResult``
+    stamps ``queue_ms``/``device_ms`` per request — queue-depth
+    pressure vs device wall, the PR-17 latency breakdown;
+    ``instrument=False`` servers stamp None and the lists come back
+    empty)."""
+    lat, queue_ms, device_ms = [], [], []
     t_start = time.perf_counter()
     for ids in ids_seq:
         t0 = time.perf_counter()
-        server.query(ids)
+        res = server.query(ids)
         lat.append((time.perf_counter() - t0) * 1e3)
-    return lat, time.perf_counter() - t_start
+        q = getattr(res, "queue_ms", None)
+        d = getattr(res, "device_ms", None)
+        if q is not None:
+            queue_ms.append(q)
+        if d is not None:
+            device_ms.append(d)
+    return lat, time.perf_counter() - t_start, queue_ms, device_ms
 
 
 def open_loop(server, ids_seq, rate_qps, seed=0):
@@ -121,9 +142,13 @@ def open_loop(server, ids_seq, rate_qps, seed=0):
 
 
 def run_backend(backend, ds, model, cfg, queries, batch, rate,
-                art_root, seed=0, max_wait_ms=0.2):
+                art_root, seed=0, max_wait_ms=0.2, instrument=True):
     """Export one backend through the real artifact path, then drive
-    closed- and open-loop traffic against a cold-loaded server."""
+    closed- and open-loop traffic against a cold-loaded server.
+    ``instrument=False`` runs the same load with registry recording
+    and trace stamping disarmed — the A/B row the observability-
+    overhead acceptance (steady-state p50 within 5%) is measured
+    on."""
     from roc_tpu.serve.export import (build_predictor, export_predictor,
                                       load_predictor)
     from roc_tpu.serve.server import Server
@@ -147,15 +172,23 @@ def run_backend(backend, ds, model, cfg, queries, batch, rate,
                            size=batch).astype(np.int32)
                for _ in range(queries)]
     row = {"backend": backend, "flavor": manifest["flavor"],
+           "instrument": bool(instrument),
            "export_s": round(export_s, 2),
            "cold_load_s": round(load_s, 3),
            "warm_hits": warm.get("compile_warm_hits"),
            "cold_compiles": warm.get("compile_cold")}
-    with Server(pred, max_wait_ms=max_wait_ms) as srv:
+    with Server(pred, max_wait_ms=max_wait_ms,
+                instrument=instrument) as srv:
         # closed loop first — its throughput calibrates 'auto' rate
-        lat, wall = closed_loop(srv, ids_seq)
+        lat, wall, qms, dms = closed_loop(srv, ids_seq)
         closed = _pcts(lat)
         closed["qps"] = round(len(lat) / max(wall, 1e-9), 1)
+        # queue-delay vs device-time decomposition: where a request's
+        # server-side milliseconds actually went
+        if qms:
+            closed["queue_p50_ms"] = _pcts(qms)["p50_ms"]
+        if dms:
+            closed["device_p50_ms"] = _pcts(dms)["p50_ms"]
         row["closed"] = closed
         eff_rate = (0.5 * closed["qps"] if rate == "auto"
                     else float(rate))
@@ -166,6 +199,92 @@ def run_backend(backend, ds, model, cfg, queries, batch, rate,
         row["open"] = opened
         row["server"] = srv.stats()
     return row
+
+
+def run_obs_ab(pred, ds, queries, batch, max_wait_ms,
+               trials=3, seed=0):
+    """Observability-overhead A/B (the 'steady-state p50 within 5%'
+    acceptance): alternate instrumented / disarmed closed-loop passes
+    over the SAME loaded predictor and compare median-of-trials p50s.
+    A single pair is dominated by scheduler jitter at sub-ms request
+    latencies (observed ±30% between identical runs); interleaving
+    the arms and taking medians cancels the machine drift that a
+    sequential pair bakes into one arm."""
+    from roc_tpu.serve.server import Server
+    rng = np.random.RandomState(seed)
+    ids_seq = [rng.randint(0, ds.graph.num_nodes,
+                           size=batch).astype(np.int32)
+               for _ in range(queries)]
+    p50s = {True: [], False: []}
+    for trial in range(trials):
+        order = (True, False) if trial % 2 == 0 else (False, True)
+        for inst in order:
+            with Server(pred, max_wait_ms=max_wait_ms,
+                        instrument=inst) as srv:
+                lat, _, _, _ = closed_loop(srv, ids_seq)
+            p50s[inst].append(_pcts(lat)["p50_ms"])
+    def _med(vs):
+        vs = sorted(vs)
+        n = len(vs)
+        return vs[n // 2] if n % 2 else 0.5 * (vs[n // 2 - 1]
+                                               + vs[n // 2])
+    on, off = _med(p50s[True]), _med(p50s[False])
+    return {"trials": trials, "queries_per_pass": queries,
+            "p50_on_ms": round(on, 4), "p50_off_ms": round(off, 4),
+            "p50_on_all": [round(v, 4) for v in p50s[True]],
+            "p50_off_all": [round(v, 4) for v in p50s[False]],
+            "overhead_pct": round(100.0 * (on - off)
+                                  / max(off, 1e-9), 1)}
+
+
+def run_slo_smoke(ds, model, cfg, art_root, queries=100,
+                  n_replicas=2, batch=4, seed=0):
+    """The SLO smoke (PR 17 CI gate): export the precomputed backend,
+    cold-load it behind a Router with declared objectives, drive a
+    quiet load-gen pass, and require ``Router.health()`` green —
+    availability 1.0 and every burn rate in-state.  Exit-enforced by
+    scripts/test.sh preflight and round6_chain step 0b: a serving
+    tier that cannot pass a quiet smoke has no business in a round."""
+    from roc_tpu.serve.export import build_predictor, export_predictor
+    from roc_tpu.serve.router import Router
+    out_dir = os.path.join(art_root, "slo_smoke")
+    pred = build_predictor(model, ds, cfg, backend="precomputed")
+    export_predictor(pred, out_dir,
+                     dataset_meta={"V": ds.graph.num_nodes,
+                                   "E": ds.graph.num_edges})
+    rng = np.random.RandomState(seed)
+    ids_seq = [rng.randint(0, ds.graph.num_nodes,
+                           size=batch).astype(np.int32)
+               for _ in range(queries)]
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("ROC_TPU_FAULT", None)   # a smoke is quiet by definition
+    slos = ("availability(ok/requests) >= 0.99 over 30s",
+            "latency_p99: p99(request_ms) <= 2000ms over 30s")
+    # a genuine breach during the smoke must not litter the caller's
+    # cwd with flight records — dumps land next to the artifact
+    prev_flight = os.environ.get("ROC_TPU_FLIGHT_DIR")
+    os.environ["ROC_TPU_FLIGHT_DIR"] = out_dir
+    t0 = time.perf_counter()
+    try:
+        with Router(out_dir, n_replicas=n_replicas, cpu=True, env=env,
+                    default_deadline_ms=30_000.0, slos=slos) as router:
+            futs = [router.submit(ids) for ids in ids_seq]
+            for f in futs:
+                f.result(timeout=60)
+            health = router.health()
+            stats = router.stats()
+    finally:
+        if prev_flight is None:
+            os.environ.pop("ROC_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["ROC_TPU_FLIGHT_DIR"] = prev_flight
+    return {"queries": queries, "replicas": n_replicas,
+            "ok": bool(health.get("ok")),
+            "availability": stats.get("availability"),
+            "p99_ms": stats.get("p99_ms"),
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "health": health}
 
 
 def run_router_drill(ds, model, cfg, art_root, queries=120,
@@ -273,6 +392,17 @@ def main(argv=None):
                     help="also run the kill-a-replica router drill "
                          "(2 CPU replicas, replica 1 SIGKILLed "
                          "mid-load; availability/failover row)")
+    ap.add_argument("--slo-smoke", action="store_true",
+                    help="run ONLY the SLO smoke: export → cold-load "
+                         "behind a 2-replica Router with declared "
+                         "objectives → quiet load-gen → require "
+                         "health green (exit 1 otherwise) — the CI "
+                         "serving-tier gate")
+    ap.add_argument("--no-obs-ab", action="store_true",
+                    help="skip the instrumentation-off A/B row "
+                         "(precomputed backend re-run with "
+                         "instrument=False; the observability-"
+                         "overhead acceptance)")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--out", default=None,
                     help="write the result JSON here (e.g. "
@@ -286,6 +416,18 @@ def main(argv=None):
     dev = jax.devices()[0]
     ds, model, cfg = build_rig(args.nodes, args.degree, args.feat,
                                args.classes, args.hops)
+    if args.slo_smoke:
+        from roc_tpu.models.builder import Model
+        with tempfile.TemporaryDirectory(prefix="roc_slo_") as art:
+            row = run_slo_smoke(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                queries=args.queries, batch=args.batch)
+        print(f"# slo smoke: {'GREEN' if row['ok'] else 'RED'} "
+              f"({row['queries']} queries, availability "
+              f"{row['availability']}, p99 {row['p99_ms']} ms)",
+              file=sys.stderr)
+        print(json.dumps(row))
+        return 0 if row["ok"] else 1
     out = {"device": f"{dev.platform} {dev.device_kind}",
            "config": {"V": ds.graph.num_nodes,
                       "E": ds.graph.num_edges, "F": args.feat,
@@ -304,9 +446,35 @@ def main(argv=None):
             print(f"# {backend}: closed p50 "
                   f"{row['closed']['p50_ms']} ms p99 "
                   f"{row['closed']['p99_ms']} ms "
-                  f"{row['closed']['qps']} qps | open p50 "
-                  f"{row['open']['p50_ms']} ms p99 "
+                  f"{row['closed']['qps']} qps (queue p50 "
+                  f"{row['closed'].get('queue_p50_ms')} / device p50 "
+                  f"{row['closed'].get('device_p50_ms')} ms) | open "
+                  f"p50 {row['open']['p50_ms']} ms p99 "
                   f"{row['open']['p99_ms']} ms", file=sys.stderr)
+        if "precomputed" in out["backends"] and not args.no_obs_ab:
+            # the observability-overhead A/B: same backend, same
+            # load, registry + trace stamping disarmed
+            from roc_tpu.models.builder import Model
+            row = run_backend(
+                "precomputed", ds, Model.from_spec(model.to_spec()),
+                cfg, args.queries, args.batch, args.rate,
+                os.path.join(art, "noobs"), instrument=False)
+            out["backends"]["precomputed_noobs"] = row
+            # the headline overhead number comes from a PAIRED
+            # interleaved A/B over one loaded predictor, not the two
+            # independent rows above — at sub-ms p50s the sequential
+            # rows disagree by ±30% on machine drift alone
+            from roc_tpu.serve.export import load_predictor
+            pred = load_predictor(os.path.join(art, "precomputed"))
+            pred.warm(name="serve_obs_ab")
+            ab = run_obs_ab(pred, ds, args.queries, args.batch,
+                            args.max_wait_ms)
+            out["obs_ab"] = ab
+            out["obs_overhead_pct"] = ab["overhead_pct"]
+            print(f"# obs overhead (paired A/B, median of "
+                  f"{ab['trials']}): instrumented p50 "
+                  f"{ab['p50_on_ms']} ms vs off {ab['p50_off_ms']} ms "
+                  f"({ab['overhead_pct']:+.1f}%)", file=sys.stderr)
         if args.drill:
             from roc_tpu.models.builder import Model
             row = run_router_drill(

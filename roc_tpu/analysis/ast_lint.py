@@ -383,10 +383,61 @@ class EventClockRule(AstRule):
                         key=f"event-dict@{node.lineno}")
 
 
+class MetricAdhocRule(AstRule):
+    """Serving/training hot paths must record metrics through the
+    streaming registry (``obs/metrics_registry.py``), not ad-hoc
+    instance state: a hand-rolled ``self._n_foo += 1`` counter has no
+    window and no snapshot, and an unbounded ``*_ms``/``*_lat`` list
+    grows without limit AND costs an O(n) sort at every quantile read
+    — exactly the failure modes the registry's O(1) counters and
+    log-bucket histograms exist to close.  Flags (a) ``+=``/``-=``
+    augmented assignment onto a ``_n_*`` attribute and (b)
+    ``.append(...)`` onto an attribute ending ``_ms``/``_lat``.
+    Sanctioned buffers (the trainer's timeline span laps) carry a
+    ``# roc-lint: ok=metric-adhoc`` pragma saying why."""
+
+    name = "metric-adhoc"
+    why = ("hot-path counters/latency samples belong in the metrics "
+           "registry (windowed, O(1), snapshot-able) — ad-hoc "
+           "attributes have no window and unbounded lists leak")
+    PREFIXES = ("roc_tpu/serve/",)
+    FILES = {"roc_tpu/train/trainer.py"}
+
+    def select(self, relpath: str) -> bool:
+        return (relpath.startswith(self.PREFIXES)
+                or relpath in self.FILES)
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.AugAssign)
+                    and isinstance(node.target, ast.Attribute)
+                    and node.target.attr.startswith("_n_")):
+                yield Finding(
+                    self.name, relpath,
+                    f"ad-hoc counter '{node.target.attr} "
+                    f"{type(node.op).__name__}=' — use a registry "
+                    f"Counter (windowed, O(1) inc)",
+                    line=node.lineno,
+                    key=f"adhoc-counter@{node.lineno}")
+            elif (isinstance(node, ast.Call)
+                  and _is_attr(node.func, "append")
+                  and isinstance(node.func.value, ast.Attribute)
+                  and node.func.value.attr.endswith(("_ms", "_lat"))):
+                yield Finding(
+                    self.name, relpath,
+                    f"ad-hoc latency list "
+                    f"'{node.func.value.attr}.append' — use a "
+                    f"registry Histogram (log-bucket, bounded, "
+                    f"windowed quantiles)",
+                    line=node.lineno,
+                    key=f"adhoc-latency@{node.lineno}")
+
+
 RULES: List[AstRule] = [StdoutPrintRule(), HostSyncHotPathRule(),
                         SyncH2dInLoopRule(), BareJitRule(),
                         PallasInterpretRule(),
-                        SwallowedExceptionRule(), EventClockRule()]
+                        SwallowedExceptionRule(), EventClockRule(),
+                        MetricAdhocRule()]
 
 
 def run_ast_lint(root: str,

@@ -16,8 +16,16 @@ This package makes every run self-describing:
 - :mod:`heartbeat` — stall watchdog emitting periodic "still waiting
   in <stage>" events so a hang is diagnosed instead of a blank
   timeout.
+- :mod:`metrics_registry` — the *live* layer: counters, gauges, and
+  sliding-window log-bucket quantile histograms the serving tier and
+  trainer record into (windowed shed/error/availability rates,
+  current p50/p95/p99).
+- :mod:`slo` — declarative objectives with multi-window burn-rate
+  alerting over the registry; breaches emit dated ``slo`` events and
+  dump the flight recorder.
 
-``python -m roc_tpu.report`` summarizes the emitted JSONL.
+``python -m roc_tpu.report`` summarizes the emitted JSONL
+(``--slo`` renders a registry snapshot + SLO verdict dashboard).
 """
 
 from .events import (CATEGORIES, ConsoleSink, EventLog,  # noqa: F401
@@ -26,3 +34,6 @@ from .events import (CATEGORIES, ConsoleSink, EventLog,  # noqa: F401
                      install_excepthook, set_clock_identity)
 from .heartbeat import Heartbeat  # noqa: F401
 from .manifest import run_manifest  # noqa: F401
+from .metrics_registry import (Counter, Gauge,  # noqa: F401
+                               Histogram, MetricsRegistry)
+from .slo import Slo, SloEngine, parse_slo  # noqa: F401

@@ -70,10 +70,16 @@ from typing import Any, Dict, List, Optional
 #             block/write/commit timings, superseded-snapshot drops,
 #             sync-fallback decisions — the ``ckpt_*`` timeline spans
 #             ride the ordinary timeline/spans batches
+#   slo       SLO-engine transitions (obs/slo.py): dated burn-rate
+#             breach/recovered events per objective, each carrying
+#             the spec, burn multiple, alert window, and the windowed
+#             value vs target — the breach also dumps the flight
+#             recorder, and ``python -m roc_tpu.report --slo``
+#             renders the breach windows from these records
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
               "costmodel", "programspace", "resilience", "timeline",
-              "serve", "sharding", "checkpoint")
+              "serve", "sharding", "checkpoint", "slo")
 
 
 # ---------------------------------------------------------- clock tuple

@@ -120,9 +120,11 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     out: Dict[str, Any] = {"path": os.path.basename(path),
                            "step_ms": None, "compile_s": None,
                            "overlap_frac": None, "serve_p50_ms": None,
+                           "serve_p99_ms": None,
                            "serve_qps": None, "serve_shed_rate": None,
                            "serve_error_rate": None,
                            "serve_availability": None,
+                           "serve_slo_ok": None,
                            "ckpt_save_ms": None,
                            "ckpt_block_ms": None,
                            "mesh_epoch_ratio": None,
@@ -150,8 +152,13 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     # checkpoint-cost columns (ISSUE 15): the async save's wall time
     # and its step-path blocked time ride the headline exactly like
     # the serve columns — both gated lower-better
-    for k in ("serve_p50_ms", "serve_qps", "serve_shed_rate",
-              "serve_error_rate", "serve_availability",
+    # PR 17 adds the windowed tail latency (serve_p99_ms, from the
+    # registry's log-bucket histogram) and the SLO-smoke verdict
+    # (serve_slo_ok, 1.0 = Router.health() green) — rounds recorded
+    # before PR 17 simply lack the keys and stay None (no_data)
+    for k in ("serve_p50_ms", "serve_p99_ms", "serve_qps",
+              "serve_shed_rate", "serve_error_rate",
+              "serve_availability", "serve_slo_ok",
               "ckpt_save_ms", "ckpt_block_ms"):
         if isinstance(parsed.get(k), (int, float)):
             out[k] = float(parsed[k])
@@ -260,6 +267,10 @@ def check_run(rounds: List[Dict[str, Any]],
                                higher_is_better=True),
         "serve_p50_ms": detect([r.get("serve_p50_ms") for r in rounds],
                                current.get("serve_p50_ms")),
+        # windowed tail latency (PR 17): the registry histogram's p99
+        # over the stats window, gated lower-better like the median
+        "serve_p99_ms": detect([r.get("serve_p99_ms") for r in rounds],
+                               current.get("serve_p99_ms")),
         "serve_qps": detect([r.get("serve_qps") for r in rounds],
                             current.get("serve_qps"),
                             higher_is_better=True),
@@ -276,6 +287,15 @@ def check_run(rounds: List[Dict[str, Any]],
         "serve_availability": detect(
             [r.get("serve_availability") for r in rounds],
             current.get("serve_availability"),
+            higher_is_better=True, allow_zero=True,
+            abs_floor=RATE_ABS_FLOOR),
+        # SLO-smoke verdict (PR 17): 1.0 = Router.health() green on
+        # the quiet load-gen pass, 0.0 = an objective in breach — a
+        # binary gated higher-better (a healthy history of 1.0s makes
+        # any 0.0 bite via the relative floor)
+        "serve_slo_ok": detect(
+            [r.get("serve_slo_ok") for r in rounds],
+            current.get("serve_slo_ok"),
             higher_is_better=True, allow_zero=True,
             abs_floor=RATE_ABS_FLOOR),
         # checkpoint v3 (ISSUE 15): async save wall + step-path
@@ -391,10 +411,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "compile_s": cur["compile_s"],
                    "overlap_frac": cur.get("overlap_frac"),
                    "serve_p50_ms": cur.get("serve_p50_ms"),
+                   "serve_p99_ms": cur.get("serve_p99_ms"),
                    "serve_qps": cur.get("serve_qps"),
                    "serve_shed_rate": cur.get("serve_shed_rate"),
                    "serve_error_rate": cur.get("serve_error_rate"),
                    "serve_availability": cur.get("serve_availability"),
+                   "serve_slo_ok": cur.get("serve_slo_ok"),
                    "ckpt_save_ms": cur.get("ckpt_save_ms"),
                    "ckpt_block_ms": cur.get("ckpt_block_ms"),
                    "dtype": args.dtype or cur.get("dtype"),

@@ -194,14 +194,24 @@ def _marker(rec: Dict[str, Any]) -> Optional[Tuple[str, Dict[str, Any]]]:
         # per-microbatch spans ride the ordinary span batches); router
         # failover/hedge markers carry the replica index so a killed
         # replica's failover is findable on the timeline (ISSUE 13
-        # acceptance)
+        # acceptance), and the request id(s) so the marker joins the
+        # per-request distributed trace (PR 17 --request)
         return (f"serve:{rec.get('kind', 'serve')}",
                 {"msg": rec.get("msg"),
                  "n_queries": rec.get("n_queries"),
                  "rows": rec.get("rows"),
                  "replica": rec.get("replica"),
                  "requeued": rec.get("requeued"),
-                 "version": rec.get("version")})
+                 "version": rec.get("version"),
+                 "rid": rec.get("rid"),
+                 "rids": rec.get("rids") or None})
+    if cat == "slo":
+        # SLO breach/recovery transitions render as markers on the
+        # emitting component's lane
+        return (f"slo:{rec.get('kind', 'slo')}:{rec.get('slo')}",
+                {"msg": rec.get("msg"), "spec": rec.get("spec"),
+                 "burn": rec.get("burn"), "value": rec.get("value"),
+                 "target": rec.get("target")})
     if cat in ("bench", "programspace", "run"):
         return (f"{cat}", {"msg": rec.get("msg")})
     return None
@@ -245,9 +255,14 @@ def merge_timeline(events: List[Dict[str, Any]],
                     name, t0, ms = lap[0], float(lap[1]), float(lap[2])
                 except (TypeError, ValueError, IndexError):
                     continue
+                # optional 4th element: per-span args (the serving
+                # tier stamps rids/batch/version there — PR 17 request
+                # tracing); older 3-element laps merge unchanged
+                args = (lap[3] if len(lap) > 3
+                        and isinstance(lap[3], dict) else {})
                 tid = (TID_H2D if str(name).startswith("h2d")
                        else TID_PHASES)
-                spans.append((off + t0, ms, str(name), pid, tid, {}))
+                spans.append((off + t0, ms, str(name), pid, tid, args))
             continue
         if ts is None:
             continue
@@ -313,6 +328,57 @@ def merge_timeline(events: List[Dict[str, Any]],
     }
 
 
+def request_trace(doc: Dict[str, Any], rid: str) -> Dict[str, Any]:
+    """One request's distributed trace, pulled from a merged doc: the
+    router's ``route_request`` span, every replica microbatch span
+    whose ``rids`` include it, and the hedge/failover markers carrying
+    it — across however many process lanes the request touched.
+    ``connected`` verifies the trace is ONE story: a router span
+    exists and every other event overlaps it (small slack for
+    clock-sync skew) — a hedged or failover-requeued request must
+    still merge into a single connected trace, not orphaned
+    fragments."""
+    evs = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") not in ("X", "i"):
+            continue
+        args = ev.get("args") or {}
+        rids = args.get("rids")
+        if args.get("rid") == rid or (
+                isinstance(rids, list) and rid in rids):
+            evs.append(ev)
+    evs.sort(key=lambda e: e.get("ts", 0.0))
+    lanes = sorted({e["pid"] for e in evs})
+    routes = [e for e in evs if e.get("ph") == "X"
+              and str(e.get("name", "")).startswith("route_request")]
+    connected = bool(routes)
+    slack_us = 50e3
+    for r in routes:
+        lo = r["ts"] - slack_us
+        hi = r["ts"] + r.get("dur", 0.0) + slack_us
+        for e in evs:
+            if e is r:
+                continue
+            if not (lo <= e["ts"] <= hi):
+                connected = False
+    t0 = min((e["ts"] for e in evs), default=0.0)
+    t1 = max((e["ts"] + e.get("dur", 0.0) for e in evs), default=0.0)
+    return {"rid": rid,
+            "n_events": len(evs),
+            "lanes": lanes,
+            "connected": connected,
+            "span_ms": round((t1 - t0) / 1e3, 3),
+            "events": [{"name": e.get("name"),
+                        "ph": e.get("ph"),
+                        "pid": e.get("pid"), "tid": e.get("tid"),
+                        "ts_ms": round(e.get("ts", 0.0) / 1e3, 3),
+                        "dur_ms": (round(e["dur"] / 1e3, 3)
+                                   if e.get("dur") is not None
+                                   else None),
+                        "args": e.get("args") or {}}
+                       for e in evs]}
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="roc_tpu.timeline", description=__doc__,
@@ -326,6 +392,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("-o", "--out", default="timeline_trace.json",
                     help="merged Chrome-trace/Perfetto JSON output "
                          "(default: %(default)s)")
+    ap.add_argument("--request", default=None, metavar="RID",
+                    help="also print the distributed trace of ONE "
+                         "request id (router span, replica microbatch "
+                         "spans, hedge/failover markers)")
     args = ap.parse_args(argv)
 
     ev_paths = expand_paths(args.events)
@@ -360,6 +430,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         "events": len(doc["traceEvents"]),
         "straggler": meta["straggler"][-8:],
     }
+    if args.request:
+        summary["request"] = request_trace(doc, args.request)
     # one machine-readable line: this CLI's stdout IS its product
     print(json.dumps(summary))
     return 0

@@ -334,6 +334,13 @@ def summarize(events: List[Dict[str, Any]],
             [{**e, "ledger": e.get("ledger") or []} for e in sh],
             out)
 
+    # SLO transitions: the burn-rate engine's dated breach/recovered
+    # events (obs/slo.py) — every row is an objective crossing its
+    # alert threshold (or coming back).  A clean run shows (none);
+    # `--slo` renders the focused view of the same records plus the
+    # live registry-snapshot dashboard.
+    summarize_slo_events(events, out)
+
     stalls = [e for e in events if e.get("cat") == "stall"]
     by_stage: Dict[str, List[float]] = {}
     for e in stalls:
@@ -414,6 +421,107 @@ def summarize_sharding(reports: List[Dict[str, Any]],
     return 0
 
 
+def summarize_slo_events(events: List[Dict[str, Any]],
+                         out=None) -> int:
+    """The dated SLO transition table: one row per burn-rate
+    breach/recovered event (``cat=slo``), wall-clock stamped — the
+    post-mortem's 'when did serving go out of objective, and when did
+    it come back'."""
+    import time as _time
+    out = out if out is not None else sys.stdout
+    rows = []
+    for e in events:
+        if e.get("cat") != "slo":
+            continue
+        t = e.get("t")
+        when = (_time.strftime("%Y-%m-%d %H:%M:%S",
+                               _time.localtime(float(t)))
+                if t is not None else "?")
+        rows.append([when, str(e.get("kind", "?")),
+                     str(e.get("slo", "?")),
+                     str(e.get("component", "?")),
+                     f"{float(e.get('burn', 0)):.1f}x",
+                     str(e.get("value")),
+                     str(e.get("target")),
+                     str(e.get("spec", ""))[:48]])
+    _rows("slo transitions (burn-rate alerts)",
+          ["when", "kind", "slo", "component", "burn", "value",
+           "target", "spec"], rows, out)
+    return 0
+
+
+def summarize_slo(doc: Dict[str, Any], out=None) -> int:
+    """Render one metrics-registry snapshot (the ``reg.dump`` /
+    ``ROC_TPU_SLO_SNAPSHOT`` artifact) as the live text dashboard:
+    the SLO verdict first (health + per-objective burn/value), then
+    every counter/gauge/histogram with its windowed view.  Pairs with
+    ``watch``: ``watch -n1 python -m roc_tpu.report --slo snap.json``
+    is the fleet console."""
+    out = out if out is not None else sys.stdout
+    windows = [int(w) for w in doc.get("windows_s") or []]
+    print(f"slo dashboard: registry '{doc.get('registry', '?')}'"
+          + (f"  component={doc['component']}"
+             if doc.get("component") else "")
+          + (f"  t={doc['t']}" if doc.get("t") is not None else ""),
+          file=out)
+    health = doc.get("health")
+    if health is not None:
+        verdict = "OK" if health.get("ok") else "BREACH"
+        line = f"  health: {verdict}"
+        if health.get("replicas") is not None:
+            line += (f"  ({health.get('replicas_alive', '?')}/"
+                     f"{health['replicas']} replicas alive)")
+        print(line, file=out)
+        rows = []
+        for ob in health.get("objectives") or []:
+            state = (health.get("states") or {}).get(
+                ob.get("name"), "?")
+            rows.append([str(ob.get("name")),
+                         str(ob.get("spec", ""))[:52],
+                         state,
+                         "yes" if ob.get("compliant") else "NO",
+                         str(ob.get("value")),
+                         str(ob.get("target")),
+                         f"{float(ob.get('burn', 0)):.2f}x",
+                         f"{float(ob.get('bad_frac', 0)):.4f}",
+                         f"{float(ob.get('budget', 0)):.4f}"])
+        _rows("objectives",
+              ["name", "spec", "state", "compliant", "value",
+               "target", "burn", "bad_frac", "budget"], rows, out)
+    metrics = doc.get("metrics") or {}
+    rows = []
+    for name in sorted(metrics):
+        m = metrics[name]
+        if m.get("kind") == "counter":
+            rows.append([name, str(m.get("total"))]
+                        + [str(m.get(f"sum_{w}s", "?"))
+                           for w in windows])
+    _rows("counters", ["name", "total"]
+          + [f"sum_{w}s" for w in windows], rows, out)
+    rows = []
+    for name in sorted(metrics):
+        m = metrics[name]
+        if m.get("kind") == "gauge":
+            rows.append([name, str(m.get("value")),
+                         str(m.get("ewma", "-")), str(m.get("n"))])
+    _rows("gauges", ["name", "value", "ewma", "n"], rows, out)
+    rows = []
+    for name in sorted(metrics):
+        m = metrics[name]
+        if m.get("kind") == "histogram":
+            row = [name, str(m.get("total")), str(m.get("mean"))]
+            for w in windows:
+                row += [str(m.get(f"n_{w}s", "?")),
+                        str(m.get(f"p50_{w}s")),
+                        str(m.get(f"p99_{w}s"))]
+            rows.append(row)
+    hdr = ["name", "total", "mean"]
+    for w in windows:
+        hdr += [f"n_{w}s", f"p50_{w}s", f"p99_{w}s"]
+    _rows("histograms (ms)", hdr, rows, out)
+    return 0
+
+
 def _expand(patterns: List[str]) -> List[str]:
     """Literal paths plus glob patterns, deduped, order-preserving;
     a missing path / zero-match glob is KEPT so the open() below
@@ -462,7 +570,56 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "the audit live on the 8-virtual-device "
                          "CPU rig — the one mode of this tool that "
                          "imports jax")
+    ap.add_argument("--slo", nargs="?", const="__events__",
+                    default=None, metavar="SNAPSHOT",
+                    help="SLO/observability view.  With SNAPSHOT: "
+                         "render a metrics-registry snapshot JSON "
+                         "(the Router's ROC_TPU_SLO_SNAPSHOT / "
+                         "MetricsRegistry.dump artifact) as the live "
+                         "dashboard — watch-able: `watch -n1 python "
+                         "-m roc_tpu.report --slo snap.json`.  "
+                         "Without SNAPSHOT (bare --slo) with event "
+                         "files: render only the dated SLO "
+                         "transition table from the event stream")
     args = ap.parse_args(argv)
+    # --slo SNAPSHOT: the registry-snapshot dashboard; renders with
+    # or without event files (with them, the focused transition table
+    # from the events follows)
+    if args.slo is not None and args.slo != "__events__":
+        try:
+            with open(args.slo) as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {args.slo}: {e}",
+                  file=sys.stderr)
+            return 2
+        summarize_slo(snap if isinstance(snap, dict) else {})
+        if not args.events:
+            return 0
+        events = []
+        for path in _expand(args.events):
+            try:
+                events.extend(load_jsonl(path))
+            except OSError as e:
+                print(f"error: cannot read {path}: {e}",
+                      file=sys.stderr)
+                return 2
+        events.sort(key=lambda e: float(e.get("t") or 0.0))
+        return summarize_slo_events(events)
+    if args.slo == "__events__":
+        if not args.events:
+            ap.error("--slo without a SNAPSHOT file needs event "
+                     "files to read transitions from")
+        events = []
+        for path in _expand(args.events):
+            try:
+                events.extend(load_jsonl(path))
+            except OSError as e:
+                print(f"error: cannot read {path}: {e}",
+                      file=sys.stderr)
+                return 2
+        events.sort(key=lambda e: float(e.get("t") or 0.0))
+        return summarize_slo_events(events)
     # --sharding FILE loads the payload up front, whether or not
     # event files are also given — an explicitly-passed report must
     # render either way (with events, its tables follow the event

@@ -8,7 +8,12 @@ cache), runs a :class:`~roc_tpu.serve.server.Server`, and speaks a
 line-JSON protocol over stdin/stdout:
 
 stdin  (router → replica)
-    ``{"id": i, "ids": [...], "deadline_ms": f|null}``  one request
+    ``{"id": i, "ids": [...], "deadline_ms": f|null, "rid": s|null}``
+    one request — ``rid`` is the router-minted request id the
+    distributed trace connects on (PR 17): the Server stamps it into
+    the microbatch span this request rides, so ``python -m
+    roc_tpu.timeline --request RID`` follows one request across the
+    router and replica lanes
     ``{"kind": "close"}``  drain-and-exit (stdin EOF means the same)
 
 stdout (replica → router)
@@ -145,7 +150,8 @@ def serve_loop(server, wire: _Wire, replica: int,
                 continue
             inflight[0] += 1
             fut = server.submit(msg.get("ids") or [],
-                                deadline_ms=msg.get("deadline_ms"))
+                                deadline_ms=msg.get("deadline_ms"),
+                                rid=msg.get("rid"))
             fut.add_done_callback(on_done(req_id))
         stop.set()
 

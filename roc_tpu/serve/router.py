@@ -35,6 +35,25 @@ The failure contract is the serve tier's one contract
 answer or fails typed.  Replica-side *retryable* failures (the
 ``serve_io`` drill) are re-dispatched transparently, bounded by
 ``max_tries``; deadline/shed/closed failures propagate as themselves.
+
+Observability (PR 17): every client submit mints a request id
+(``rid``) that rides the wire to the replica and is stamped into the
+replica server's microbatch spans — ``python -m roc_tpu.timeline
+--request RID`` renders one request's full router → replica →
+microbatch → table-version path, including splits, hedges, and
+failover requeues (the hedge/failover markers carry the rid too).
+All counting goes through a
+:class:`~roc_tpu.obs.metrics_registry.MetricsRegistry` (roc-lint
+``metric-adhoc``), so ``stats()`` reports *windowed* rates and p50/p99
+alongside lifetime totals.  Pass ``slos=[...]`` (spec strings or
+:class:`~roc_tpu.obs.slo.Slo`) to arm the burn-rate
+:class:`~roc_tpu.obs.slo.SloEngine` over the router's registry: the
+monitor loop ticks it, breaches emit dated ``slo`` events + a flight-
+record dump, and :meth:`Router.health` returns the machine-readable
+verdict.  ``snapshot_path`` (or ``ROC_TPU_SLO_SNAPSHOT``) makes the
+monitor publish a 1 Hz registry+verdict snapshot JSON —
+``watch -n1 python -m roc_tpu.report --slo <path>`` is the live
+dashboard.
 """
 
 from __future__ import annotations
@@ -51,6 +70,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs.events import emit
+from ..obs.metrics_registry import MetricsRegistry
+from ..obs.slo import SloEngine
 from .errors import (ReplicaLost, ServeClosed, ServeError,
                      ServeOverload, ServeTimeout)
 from .replica import hb_interval
@@ -121,16 +142,22 @@ class _Sub:
 
 
 class _Parent:
-    """One client submit: future + per-shard result slots."""
+    """One client submit: future + per-shard result slots, plus the
+    minted request id and submit stamp the trace/latency metrics
+    read."""
 
-    __slots__ = ("fut", "n_left", "parts", "order", "version")
+    __slots__ = ("fut", "n_left", "parts", "order", "version",
+                 "rid", "t0")
 
-    def __init__(self, fut: Future, n_slots: int, order):
+    def __init__(self, fut: Future, n_slots: int, order,
+                 rid: Optional[str] = None, t0: float = 0.0):
         self.fut = fut
         self.n_left = n_slots
         self.parts: List[Optional[np.ndarray]] = [None] * n_slots
         self.order = order
         self.version: Optional[int] = None
+        self.rid = rid
+        self.t0 = t0
 
 
 class Router:
@@ -149,7 +176,11 @@ class Router:
                  cpu: bool = False,
                  ready_timeout_s: float = 180.0,
                  env: Optional[Dict[str, str]] = None,
-                 replica_args: Optional[Sequence[str]] = None):
+                 replica_args: Optional[Sequence[str]] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 stats_window_s: float = 60.0,
+                 slos: Optional[Sequence[Any]] = None,
+                 snapshot_path: Optional[str] = None):
         if n_replicas < 1:
             raise ValueError("need at least one replica")
         if shards is not None and len(shards) != n_replicas:
@@ -160,19 +191,38 @@ class Router:
         self.hedge_pct = float(hedge_pct)
         self.hedge_min_ms = float(hedge_min_ms)
         self.max_tries = int(max_tries)
+        self.stats_window_s = float(stats_window_s)
         self._lock = threading.Lock()
         self._pending: Dict[int, _Sub] = {}
         self._next_id = 0
+        self._rid_seq = 0
         self._closed = False
         self._stop = threading.Event()
-        self._lat_ms: List[float] = []     # completed-latency window
-        self._n_submitted = 0
-        self._n_shed = 0
-        self._n_timeout = 0
-        self._n_failover = 0
-        self._n_hedge = 0
-        self._n_ok = 0
-        self._n_failed = 0
+        # ALL counting goes through the registry: lifetime totals AND
+        # windowed rates from one recording (roc-lint metric-adhoc)
+        self.reg = (registry if registry is not None
+                    else MetricsRegistry("router"))
+        self._c_requests = self.reg.counter("requests")
+        self._c_shed = self.reg.counter("shed")
+        self._c_timeout = self.reg.counter("timeout")
+        self._c_failover = self.reg.counter("failover")
+        self._c_hedge = self.reg.counter("hedge")
+        self._c_ok = self.reg.counter("ok")
+        self._c_failed = self.reg.counter("failed")
+        # wire_ms: per-sub replica round trips (the hedge threshold's
+        # base); request_ms: client submit -> assembled result (the
+        # p99 the latency SLO guards)
+        self._h_wire = self.reg.histogram("wire_ms")
+        self._h_request = self.reg.histogram("request_ms")
+        self._spans: List[Tuple[str, float, float,
+                                Dict[str, Any]]] = []
+        self._slo: Optional[SloEngine] = None
+        if slos:
+            self._slo = SloEngine(self.reg, slos, component="router")
+        self.snapshot_path = (snapshot_path
+                              or os.environ.get("ROC_TPU_SLO_SNAPSHOT")
+                              or None)
+        self._last_snapshot = 0.0
         self.num_nodes: Optional[int] = None
         # the router's own lane handshake, like Server's
         emit("timeline", f"clock_sync: serve router up "
@@ -283,6 +333,7 @@ class Router:
         for rep in self.replicas:
             if rep.reader is not None:
                 rep.reader.join(timeout=5.0)
+        self._flush_spans(final=True)
         s = self.stats()
         emit("serve", f"router closed: {s['n_ok']} ok / "
              f"{s['n_timeout']} timeout / {s['n_shed']} shed / "
@@ -300,7 +351,8 @@ class Router:
     def submit(self, node_ids,
                deadline_ms: Optional[float] = None) -> Future:
         """One client request; resolves to the fp32 ``[n, C]`` logits
-        or a typed ``serve/errors.py`` failure."""
+        or a typed ``serve/errors.py`` failure.  Mints the request id
+        (``rid``) the distributed trace connects on."""
         ids = np.asarray(node_ids, dtype=np.int32).ravel()
         fut: Future = Future()
         if ids.size and self.num_nodes is not None and (
@@ -310,23 +362,25 @@ class Router:
             return fut
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
+        t0 = time.monotonic()
         deadline_t = (None if deadline_ms is None
-                      else time.monotonic() + max(0.0, deadline_ms)
-                      / 1e3)
+                      else t0 + max(0.0, deadline_ms) / 1e3)
         groups = self._shard_groups(ids)
         with self._lock:
             if self._closed:
                 fut.set_exception(ServeClosed("router is closed"))
                 return fut
+            self._c_requests.inc()
             if len(self._pending) + len(groups) > self.max_inflight:
-                self._n_shed += 1
+                self._c_shed.inc()
                 fut.set_exception(ServeOverload(
                     f"router in-flight cap {self.max_inflight} "
                     f"reached — load shed"))
                 return fut
-            self._n_submitted += 1
+            self._rid_seq += 1
+            rid = f"{os.getpid():x}-{self._rid_seq}"
             parent = _Parent(fut, len(groups),
-                             [g[1] for g in groups])
+                             [g[1] for g in groups], rid=rid, t0=t0)
             subs = []
             for slot, (gids, _order) in enumerate(groups):
                 wire_id = self._next_id
@@ -400,7 +454,8 @@ class Router:
                                 * 1e3))
             ok = rep.send({"id": sub.wire_id,
                            "ids": sub.ids.tolist(),
-                           "deadline_ms": remaining_ms})
+                           "deadline_ms": remaining_ms,
+                           "rid": sub.parent.rid})
             if ok:
                 with self._lock:
                     rep.inflight += 1
@@ -431,10 +486,10 @@ class Router:
                     self._pending.pop(wid)
                     popped = True
             count = popped and not sub.parent.fut.done()
-            if count:
-                if isinstance(exc, ServeTimeout):
-                    self._n_timeout += 1
-                self._n_failed += 1
+        if count:
+            if isinstance(exc, ServeTimeout):
+                self._c_timeout.inc()
+            self._c_failed.inc()
         if count and not sub.parent.fut.done():
             try:
                 sub.parent.fut.set_exception(exc)
@@ -482,11 +537,10 @@ class Router:
             sub = self._pending.get(msg.get("id"))
             if sub is not None and msg.get("ok"):
                 del self._pending[sub.wire_id]
-                self._lat_ms.append(
-                    (time.monotonic() - sub.t_sent) * 1e3)
-                if len(self._lat_ms) > 512:
-                    del self._lat_ms[:256]
                 rep.served += 1
+                wire_ms = (time.monotonic() - sub.t_sent) * 1e3
+        if sub is not None and msg.get("ok"):
+            self._h_wire.record(wire_ms)
         if sub is None:
             return   # hedge already won (or expired): late twin
         if msg.get("ok"):
@@ -522,9 +576,22 @@ class Router:
                                   else max(parent.version, version))
             parent.n_left -= 1
             done = parent.n_left == 0
-            if done:
-                self._n_ok += 1
-        if not done or parent.fut.done():
+        if not done:
+            return
+        self._c_ok.inc()
+        ms = (time.monotonic() - parent.t0) * 1e3
+        self._h_request.record(ms)
+        # the router-lane span for this request's trace (flushed in
+        # batches like Server's)
+        with self._lock:
+            self._spans.append(
+                ("route_request", parent.t0, ms,
+                 {"rid": parent.rid,
+                  "version": int(parent.version or 0)}))
+            flush = len(self._spans) >= 64
+        if flush:
+            self._flush_spans()
+        if parent.fut.done():
             return
         if len(parent.parts) == 1:
             out = parent.parts[0]
@@ -558,14 +625,18 @@ class Router:
                            if (s.replica == rep.idx
                                or s.hedge_replica == rep.idx)
                            and s is not skip]
-                self._n_failover += len(orphans)
             closed = self._closed
         if closed or (not was_alive and not orphans):
             return
-        # the failover marker the timeline renders on the router lane
+        # the failover marker the timeline renders on the router lane;
+        # rids connect it into each requeued request's trace
+        rids = sorted({s.parent.rid for s in orphans
+                       if s.parent.rid is not None})
+        self._c_failover.inc(len(orphans))
         emit("serve", f"replica {rep.idx} died ({why}): failing over "
              f"{len(orphans)} in-flight request(s)",
-             kind="failover", replica=rep.idx, requeued=len(orphans))
+             kind="failover", replica=rep.idx, requeued=len(orphans),
+             rids=rids)
         for sub in orphans:
             if sub.hedge_replica == rep.idx:
                 with self._lock:
@@ -577,11 +648,14 @@ class Router:
             self._dispatch(sub)
 
     def _hedge_threshold_ms(self) -> float:
-        with self._lock:
-            lat = sorted(self._lat_ms)
-        if not lat:
+        # windowed first (current behavior under load shifts), whole-
+        # ring fallback; the log-bucket quantile's ~16% grain is fine
+        # for a 2x-padded hedge trigger
+        q = (self._h_wire.quantile(self.hedge_pct,
+                                   self.stats_window_s)
+             or self._h_wire.quantile(self.hedge_pct, None))
+        if q is None:
             return self.hedge_min_ms
-        q = lat[min(len(lat) - 1, int(self.hedge_pct * len(lat)))]
         return max(self.hedge_min_ms, q * 2.0)
 
     def _monitor_loop(self) -> None:
@@ -606,11 +680,12 @@ class Router:
                         and len([r for r in self.replicas
                                  if r.alive]) > 1]
             for sub in slow:
-                self._n_hedge += 1
+                self._c_hedge.inc()
                 emit("serve", f"hedging request {sub.wire_id} "
                      f"(in flight {1e3 * (now - sub.t_sent):.0f} ms "
                      f"on replica {sub.replica})", console=False,
-                     kind="hedge", replica=sub.replica)
+                     kind="hedge", replica=sub.replica,
+                     rid=sub.parent.rid)
                 self._dispatch(sub, hedge=True)
             # health: dead processes + silent heartbeats
             for rep in list(self.replicas):
@@ -631,35 +706,84 @@ class Router:
                          f"silent for {age:.1f}s",
                          stage=f"serve_replica{rep.idx}",
                          elapsed_s=round(age, 1))
+            # SLO evaluation (rate-limited inside tick) + the live
+            # dashboard feed
+            if self._slo is not None:
+                self._slo.tick()
+            if (self.snapshot_path
+                    and now - self._last_snapshot >= 1.0):
+                self._last_snapshot = now
+                extra = {"component": "router",
+                         "health": (self._slo.tick()
+                                    if self._slo is not None
+                                    else None)}
+                self.reg.dump(self.snapshot_path,
+                              windows=(10.0, self.stats_window_s),
+                              extra=extra)
+
+    def _flush_spans(self, final: bool = False) -> None:
+        with self._lock:
+            spans, self._spans = self._spans, []
+        if not spans:
+            return
+        emit("timeline",
+             f"spans: {len(spans)} routed request(s)"
+             + (" (final)" if final else ""), console=False,
+             kind="spans",
+             spans=[[n, round(t0, 6), round(ms, 3), args]
+                    for n, t0, ms, args in spans])
 
     # ----------------------------------------------------------- stats
 
     def stats(self) -> Dict[str, Any]:
+        """Lifetime ``n_*`` totals + *windowed* rates and latency
+        quantiles over the trailing ``window_s`` seconds (``None``
+        when the window saw no requests)."""
+        w = self.stats_window_s
         with self._lock:
-            lat = sorted(self._lat_ms)
             reps = [{"replica": r.idx, "alive": r.alive,
                      "inflight": r.inflight, "served": r.served,
                      "shard": list(r.shard) if r.shard else None}
                     for r in self.replicas]
-            n_sub = self._n_submitted
-            n_shed = self._n_shed
-            out = {"n_submitted": n_sub, "n_ok": self._n_ok,
-                   "n_failed": self._n_failed,
-                   "n_timeout": self._n_timeout,
-                   "n_shed": n_shed,
-                   "n_failover": self._n_failover,
-                   "n_hedge": self._n_hedge,
-                   "replicas": reps}
+        n_req = self._c_requests.total
+        n_shed = self._c_shed.total
+        out = {"n_submitted": n_req - n_shed, "n_ok": self._c_ok.total,
+               "n_failed": self._c_failed.total,
+               "n_timeout": self._c_timeout.total,
+               "n_shed": n_shed,
+               "n_failover": self._c_failover.total,
+               "n_hedge": self._c_hedge.total,
+               "replicas": reps,
+               "window_s": w}
+        w_denom = self._c_requests.sum_over(w)
 
-        def pct(p):
-            if not lat:
-                return None
-            return round(lat[min(len(lat) - 1, int(p * len(lat)))], 4)
+        def rate(num: int) -> Optional[float]:
+            return round(num / w_denom, 4) if w_denom > 0 else None
 
-        denom = max(n_sub + n_shed, 1)
-        out["p50_ms"] = pct(0.50)
-        out["p99_ms"] = pct(0.99)
-        out["shed_rate"] = round(n_shed / denom, 4)
-        out["error_rate"] = round(out["n_failed"] / denom, 4)
-        out["availability"] = round(out["n_ok"] / denom, 4)
+        def q(h, p: float) -> Optional[float]:
+            v = h.quantile(p, None)
+            return round(v, 4) if v is not None else None
+
+        out["p50_ms"] = q(self._h_request, 0.50)
+        out["p99_ms"] = q(self._h_request, 0.99)
+        out["shed_rate"] = rate(self._c_shed.sum_over(w))
+        out["error_rate"] = rate(self._c_failed.sum_over(w))
+        out["availability"] = rate(self._c_ok.sum_over(w))
         return out
+
+    def health(self) -> Dict[str, Any]:
+        """Machine-readable serving health: the SLO engine's verdict
+        (fresh evaluation) + replica liveness.  ``ok`` is the one bit
+        an autoscaler/pager keys on: every objective in-state AND at
+        least one replica alive."""
+        alive = sum(1 for r in self.replicas if r.alive)
+        if self._slo is None:
+            v: Dict[str, Any] = {"ok": True, "states": {},
+                                 "objectives": []}
+        else:
+            v = self._slo.verdict()
+        v = dict(v)
+        v["replicas_alive"] = alive
+        v["replicas"] = len(self.replicas)
+        v["ok"] = bool(v["ok"]) and alive > 0
+        return v

@@ -43,6 +43,20 @@ request pipeline renders next to the training lanes with zero new
 merger code.  A ``serve`` summary event (queries, batches, latency
 percentiles, shed/timeout counts) closes the session.
 
+All counting goes through a
+:class:`~roc_tpu.obs.metrics_registry.MetricsRegistry` (PR 17 — the
+roc-lint ``metric-adhoc`` rule bans hand-rolled stats accumulators in
+serve/), so ``stats()`` reports *current windowed* shed/error/
+availability rates (``window_s``, default 60 s) next to the lifetime
+totals.  Each microbatch span is stamped with the router-minted
+request ids (``rids``) riding its requests plus the table version it
+served, and every :class:`ServeResult` decomposes its latency into
+``queue_ms`` (admission → dispatch start) vs ``device_ms`` (the
+microbatch's device wall) — queue-depth pressure is visible before it
+becomes shed.  ``instrument=False`` disarms registry recording and
+trace stamping for overhead A/B runs (``micro_serve.py`` records both
+rows; stats() is meaningless in that mode).
+
 The request loop is a hot path under roc-lint's
 ``host-sync-hot-path`` rule (``analysis/ast_lint.py`` scopes
 ``roc_tpu/serve/`` in): the ONLY device→host sync is the result fetch
@@ -63,6 +77,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..obs.events import emit
+from ..obs.metrics_registry import MetricsRegistry
 from ..resilience import inject
 from .errors import (ServeClosed, ServeError, ServeOverload,
                      ServeTimeout)
@@ -82,27 +97,42 @@ DEFAULT_MAX_QUEUE = 1024
 class ServeResult(np.ndarray):
     """The fp32 ``[n, C]`` logits, plus the table ``version`` the
     request's microbatch was served under — an ndarray view, so every
-    existing consumer keeps treating results as plain arrays."""
+    existing consumer keeps treating results as plain arrays.  The
+    trace stamps ride along: ``queue_ms`` (admission → dispatch
+    start) and ``device_ms`` (the microbatch's device wall) decompose
+    the request's server-side latency."""
     version: int = 0
+    queue_ms: Optional[float] = None
+    device_ms: Optional[float] = None
 
 
-def _result(rows: np.ndarray, version: int) -> ServeResult:
+def _result(rows: np.ndarray, version: int,
+            queue_ms: Optional[float] = None,
+            device_ms: Optional[float] = None) -> ServeResult:
     out = rows.view(ServeResult)
     out.version = int(version)
+    out.queue_ms = queue_ms
+    out.device_ms = device_ms
     return out
 
 
 class _Req:
-    """One queued request: ids, the caller's future, and the absolute
-    monotonic deadline (None = no deadline)."""
+    """One queued request: ids, the caller's future, the absolute
+    monotonic deadline (None = no deadline), the admission stamp the
+    queue-delay decomposition reads, and the router-minted request id
+    (``rid``) the timeline trace connects on."""
 
-    __slots__ = ("ids", "fut", "deadline_t")
+    __slots__ = ("ids", "fut", "deadline_t", "t_admit", "rid")
 
     def __init__(self, ids: np.ndarray, fut: Future,
-                 deadline_t: Optional[float]):
+                 deadline_t: Optional[float],
+                 t_admit: float = 0.0,
+                 rid: Optional[str] = None):
         self.ids = ids
         self.fut = fut
         self.deadline_t = deadline_t
+        self.t_admit = t_admit
+        self.rid = rid
 
 
 class Server:
@@ -119,26 +149,38 @@ class Server:
                  max_wait_ms: float = 0.2,
                  name: str = "serve",
                  max_queue: int = DEFAULT_MAX_QUEUE,
-                 default_deadline_ms: Optional[float] = None):
+                 default_deadline_ms: Optional[float] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 instrument: bool = True,
+                 stats_window_s: float = 60.0):
         self.pred = predictor
         self.max_wait_s = max(0.0, float(max_wait_ms)) / 1e3
         self.name = name
         self.max_queue = int(max_queue)
         self.default_deadline_ms = default_deadline_ms
+        self.stats_window_s = float(stats_window_s)
         self._lock = threading.Condition()
         self._queue: List[_Req] = []
         self._closed = False
         self._draining = False
         self._dispatching = False
-        self._spans: List[Tuple[str, float, float]] = []
-        self._batch_ms: List[float] = []
-        self._batch_n: List[int] = []
-        self._n_queries = 0          # accepted into the queue
-        self._n_shed = 0             # ServeOverload at submit
-        self._n_timeout = 0          # ServeTimeout at a batch boundary
-        self._n_rejected_closed = 0  # ServeClosed at submit
-        self._n_errors = 0           # dispatch failures (batch-wide)
-        self._n_ok = 0               # requests completed with rows
+        self._spans: List[Tuple[str, float, float, Dict[str, Any]]] = []
+        # ALL counting goes through the registry (windowed rates +
+        # lifetime totals from one recording); instrument=False
+        # disarms it for overhead A/B runs
+        self._obs = bool(instrument)
+        self.reg = (registry if registry is not None
+                    else MetricsRegistry(f"server:{name}"))
+        self._c_accepted = self.reg.counter("accepted")
+        self._c_shed = self.reg.counter("shed")
+        self._c_timeout = self.reg.counter("timeout")
+        self._c_rejected = self.reg.counter("rejected_closed")
+        self._c_errors = self.reg.counter("errors")
+        self._c_ok = self.reg.counter("ok")
+        self._c_batches = self.reg.counter("batches")
+        self._c_rows = self.reg.counter("rows")
+        self._h_batch = self.reg.histogram("batch_ms")
+        self._h_queue = self.reg.histogram("queue_ms")
         self._batch_seq = 0
         self._versions = set()       # table versions actually served
         # the lane handshake: wall/mono stamped by the bus — the
@@ -154,11 +196,14 @@ class Server:
     # ---------------------------------------------------------- public
 
     def submit(self, node_ids,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               rid: Optional[str] = None) -> Future:
         """Queue a query; the returned future resolves to the fp32
         ``[len(node_ids), C]`` logits (a :class:`ServeResult` carrying
         the table ``version`` it was served under), or to one of the
-        typed ``serve/errors.py`` failures — never a bare hang."""
+        typed ``serve/errors.py`` failures — never a bare hang.
+        ``rid`` is the router-minted request id the timeline trace
+        connects on (stamped into this request's microbatch span)."""
         ids = np.asarray(node_ids, dtype=np.int32).ravel()
         fut: Future = Future()
         if ids.size and (ids.min() < 0
@@ -168,25 +213,29 @@ class Server:
             return fut
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
+        now = time.monotonic()
         deadline_t = (None if deadline_ms is None
-                      else time.monotonic() + max(0.0, deadline_ms)
-                      / 1e3)
+                      else now + max(0.0, deadline_ms) / 1e3)
         with self._lock:
             if self._closed or self._draining:
-                self._n_rejected_closed += 1
+                if self._obs:
+                    self._c_rejected.inc()
                 fut.set_exception(ServeClosed(
                     f"server '{self.name}' is "
                     + ("draining" if self._draining and not self._closed
                        else "closed")))
                 return fut
             if len(self._queue) >= self.max_queue:
-                self._n_shed += 1
+                if self._obs:
+                    self._c_shed.inc()
                 fut.set_exception(ServeOverload(
                     f"admission queue full ({self.max_queue} queued) "
                     f"— load shed"))
                 return fut
-            self._queue.append(_Req(ids, fut, deadline_t))
-            self._n_queries += 1
+            self._queue.append(_Req(ids, fut, deadline_t,
+                                    t_admit=now, rid=rid))
+            if self._obs:
+                self._c_accepted.inc()
             self._lock.notify()
         return fut
 
@@ -196,45 +245,55 @@ class Server:
         return self.submit(node_ids, deadline_ms=deadline_ms).result()
 
     def stats(self) -> Dict[str, Any]:
-        """Microbatch + robustness accounting since startup.
-        Snapshots under the server lock: the dispatcher thread appends
-        to these series concurrently (roc-lint
-        unguarded-shared-state — a sorted() over a list mid-append is
-        exactly the race class)."""
+        """Microbatch + robustness accounting.  The ``n_*`` keys are
+        lifetime totals; ``shed_rate``/``error_rate``/``availability``
+        are *windowed* — computed over the trailing ``window_s``
+        seconds from the metrics registry, so a recovered server
+        reports its current health, not its whole history (``None``
+        when the window saw no admissions).  Latency quantiles come
+        from the registry's log-bucket histograms (within one bucket,
+        ~16%% relative, of exact)."""
+        w = self.stats_window_s    # already float-coerced in __init__
+        n_queries = self._c_accepted.total
+        n_shed = self._c_shed.total
+        n_timeout = self._c_timeout.total
+        n_rejected = self._c_rejected.total
+        n_errors = self._c_errors.total
+        n_ok = self._c_ok.total
+        n_batches = self._c_batches.total
+        n_rows = self._c_rows.total
+        w_shed = self._c_shed.sum_over(w)
+        w_denom = (self._c_accepted.sum_over(w) + w_shed
+                   + self._c_rejected.sum_over(w))
+        w_bad = self._c_timeout.sum_over(w) + self._c_errors.sum_over(w)
+        w_ok = self._c_ok.sum_over(w)
         with self._lock:
-            ms = sorted(self._batch_ms)
-            batch_n = list(self._batch_n)
-            n_queries = self._n_queries
-            n_shed = self._n_shed
-            n_timeout = self._n_timeout
-            n_rejected = self._n_rejected_closed
-            n_errors = self._n_errors
-            n_ok = self._n_ok
             versions = sorted(self._versions)
 
-        def pct(p: float) -> Optional[float]:
-            if not ms:
-                return None
-            q = ms[min(len(ms) - 1, int(p * len(ms)))]
-            return round(q, 4)
+        def rate(num: int) -> Optional[float]:
+            return round(num / w_denom, 4) if w_denom > 0 else None
 
-        mean_rows = np.mean(batch_n) if batch_n else None
-        submitted = n_queries + n_shed + n_rejected
-        denom = max(submitted, 1)
+        def q(h, p: float, window: Optional[float] = None
+              ) -> Optional[float]:
+            v = h.quantile(p, window)
+            return round(v, 4) if v is not None else None
+
         return {"n_queries": n_queries,
-                "n_batches": len(ms),
-                "rows_per_batch": (round(float(mean_rows), 2)
-                                   if mean_rows is not None else None),
-                "batch_p50_ms": pct(0.50),
-                "batch_p99_ms": pct(0.99),
+                "n_batches": n_batches,
+                "rows_per_batch": (round(n_rows / n_batches, 2)
+                                   if n_batches else None),
+                "batch_p50_ms": q(self._h_batch, 0.50),
+                "batch_p99_ms": q(self._h_batch, 0.99),
+                "queue_p50_ms": q(self._h_queue, 0.50),
                 "n_shed": n_shed,
                 "n_timeout": n_timeout,
                 "n_rejected_closed": n_rejected,
                 "n_errors": n_errors,
                 "n_ok": n_ok,
-                "shed_rate": round(n_shed / denom, 4),
-                "error_rate": round((n_timeout + n_errors) / denom, 4),
-                "availability": round(n_ok / denom, 4),
+                "window_s": w,
+                "shed_rate": rate(w_shed),
+                "error_rate": rate(w_bad),
+                "availability": rate(w_ok),
                 "table_versions": versions[-8:],
                 }
 
@@ -302,11 +361,14 @@ class Server:
             else:
                 live.append(r)
         self._queue = live
-        self._n_timeout += len(dead)
         return dead
 
-    @staticmethod
-    def _fail_timeouts(dead: List[_Req]) -> None:
+    def _fail_timeouts(self, dead: List[_Req]) -> None:
+        """Complete expired futures OUTSIDE the lock (done-callbacks
+        may re-enter submit); the registry counter has its own lock,
+        so counting here keeps it off submit()'s wait path too."""
+        if dead and self._obs:
+            self._c_timeout.inc(len(dead))
         for r in dead:
             if not r.fut.done():
                 r.fut.set_exception(ServeTimeout(
@@ -357,8 +419,8 @@ class Server:
             try:
                 self._dispatch(batch)
             except Exception as e:  # noqa: BLE001 - fail the futures
-                with self._lock:
-                    self._n_errors += len(batch)
+                if self._obs:
+                    self._c_errors.inc(len(batch))
                 # the typed-failure contract covers dispatch errors
                 # too: wrap foreign exceptions in ServeError, chained
                 # so the replica wire (and post-mortems) can still
@@ -392,25 +454,38 @@ class Server:
         t0 = time.monotonic()
         rows = self.pred.query(ids, pub=pub)
         ms = (time.monotonic() - t0) * 1e3
-        # the device dispatch above runs UNLOCKED; only the bounded
-        # bookkeeping appends hold the lock (stats() reads them from
-        # caller threads), and the span flush emits after release —
-        # an emit under the lock would put JSONL I/O on submit()'s
-        # wait path (roc-lint blocking-under-lock)
+        # the device dispatch above runs UNLOCKED; registry metrics
+        # carry their own fine-grained locks, so only the version set
+        # and span buffer hold the server lock, and the span flush
+        # emits after release — an emit under the lock would put JSONL
+        # I/O on submit()'s wait path (roc-lint blocking-under-lock)
+        if self._obs:
+            self._h_batch.record(ms)
+            self._c_batches.inc()
+            self._c_rows.inc(int(ids.size))
+            self._c_ok.inc(len(batch))
+            for r in batch:
+                self._h_queue.record(max(0.0, (t0 - r.t_admit) * 1e3))
+        rids = sorted({r.rid for r in batch if r.rid is not None})
+        args: Dict[str, Any] = {"batch": batch_no,
+                                "rows": int(ids.size),
+                                "version": int(pub.version)}
+        if rids:
+            args["rids"] = rids
         with self._lock:
-            self._batch_ms.append(ms)
-            self._batch_n.append(int(ids.size))
-            self._n_ok += len(batch)
             self._versions.add(int(pub.version))
-            self._spans.append(("serve_batch", t0, ms))
+            self._spans.append(("serve_batch", t0, ms, args))
             flush = len(self._spans) >= _SPAN_FLUSH_EVERY
         if flush:
             self._flush_spans()
         lo = 0
         for r in batch:
             if not r.fut.done():
+                qms = max(0.0, (t0 - r.t_admit) * 1e3)
                 r.fut.set_result(
-                    _result(rows[lo:lo + r.ids.size], pub.version))
+                    _result(rows[lo:lo + r.ids.size], pub.version,
+                            queue_ms=round(qms, 3),
+                            device_ms=round(ms, 3)))
             lo += r.ids.size
 
     def _flush_spans(self, final: bool = False) -> None:
@@ -421,5 +496,6 @@ class Server:
         emit("timeline",
              f"spans: {len(spans)} microbatch(es)"
              + (" (final)" if final else ""), console=False,
-             kind="spans", spans=[[n, round(t0, 6), round(ms, 3)]
-                                  for n, t0, ms in spans])
+             kind="spans",
+             spans=[[n, round(t0, 6), round(ms, 3), args]
+                    for n, t0, ms, args in spans])

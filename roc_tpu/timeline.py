@@ -12,7 +12,8 @@ from __future__ import annotations
 import sys
 
 from .obs.timeline import (clock_offsets, expand_paths,  # noqa: F401
-                           main, merge_timeline, straggler_records)
+                           main, merge_timeline, request_trace,
+                           straggler_records)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -22,6 +22,7 @@ from ..core.graph import Dataset
 from ..core.partition import padded_edge_list
 from ..models.builder import GraphContext, Model
 from ..obs.events import emit
+from ..obs.metrics_registry import MetricsRegistry
 from ..ops.loss import perf_metrics, summarize_metrics
 from .optimizer import AdamConfig, adam_init, adam_update, decayed_lr
 
@@ -1318,6 +1319,18 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
         inject.arm(cfg.fault)
     epochs = epochs if epochs is not None else cfg.epochs
     history: List[Dict[str, float]] = []
+    # the loop's live registry view (PR 17): step-time EWMA, epoch-lap
+    # histogram, straggler ratio, h2d wait — the same numbers the
+    # metrics rows log, but windowed/current for dashboards and the
+    # roc-lint metric-adhoc contract (no hand-rolled accumulators in
+    # the hot loop)
+    reg = getattr(tr, "reg", None)
+    if reg is None:
+        reg = tr.reg = MetricsRegistry("train")
+    g_step = reg.gauge("step_ewma_ms", ewma_alpha=0.2)
+    g_strag = reg.gauge("straggler_ratio")
+    g_h2d = reg.gauge("h2d_wait_p50_ms")
+    h_epoch = reg.histogram("epoch_ms")
     t_last = time.perf_counter()
     e_last = tr.epoch
     compile_ms: Optional[float] = None
@@ -1344,6 +1357,10 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                         tr.sync()
                     now = time.perf_counter()
                     compile_ms = (now - t_last) * 1e3
+                    # timer laps are the timeline span buffer
+                    # (flushed per eval), not a quantile store; the
+                    # registry histogram records the same lap below
+                    # roc-lint: ok=metric-adhoc
                     tr.timer.laps_ms.append(compile_ms)
                     tr.timer.note_span("compile", compile_ms)
                     # clock-sync handshake, piggybacked on the barrier
@@ -1376,6 +1393,8 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                         m["epoch_ms"] = compile_ms
                     else:
                         m["epoch_ms"] = (now - t_last) * 1e3 / span
+                        # span buffer, see the compile lap above
+                        # roc-lint: ok=metric-adhoc
                         tr.timer.laps_ms.append(m["epoch_ms"])
                         tr.timer.spans_ms.setdefault(
                             "train", []).append(m["epoch_ms"])
@@ -1408,6 +1427,19 @@ def run_epoch_loop(tr, epochs: Optional[int], do_step,
                     sf = getattr(tr, "straggler_fields", None)
                     if sf is not None:
                         m.update(sf(m) or {})
+                    # registry recording + the row's EWMA field: only
+                    # steady laps feed the EWMA (the compile lap would
+                    # drag it for ~1/alpha evals)
+                    if span > 0 and m.get("epoch_ms"):
+                        h_epoch.record(m["epoch_ms"])
+                        g_step.set(m["epoch_ms"])
+                        ew = g_step.ewma
+                        if ew is not None:
+                            m["step_ewma_ms"] = round(ew, 2)
+                    if m.get("straggler_ratio") is not None:
+                        g_strag.set(m["straggler_ratio"])
+                    if m.get("h2d_wait_p50_ms") is not None:
+                        g_h2d.set(m["h2d_wait_p50_ms"])
                     t_last, e_last = t_eval_end, tr.epoch + 1
                     history.append(m)
                     tr.metrics_log.log(m)

@@ -58,6 +58,15 @@ python -m roc_tpu.sentinel --json || exit 1
 #     same harness there).
 python benchmarks/micro_serve.py --cpu --queries 100 --drill \
   --out benchmarks/micro_serve_cpu.json > /dev/null || exit 1
+#     SLO smoke (ISSUE 17): same export → cold-load path, but with
+#     the declared availability/latency objectives armed — 100
+#     queries of quiet load-gen must leave Router.health() green
+#     (availability 1.0, no burn-rate alert firing).  Gate ENFORCED:
+#     an SLO engine that false-alarms on quiet traffic would page on
+#     every chip round, and one that cannot go green cannot certify
+#     the serve stage's headline numbers.
+python benchmarks/micro_serve.py --slo-smoke --cpu \
+  --queries 100 --nodes 2000 > /dev/null || exit 1
 # 1. staged headline refresh (regression guard before the new rows;
 #    now includes the serve stage — serve_p50_ms/p99/qps land in the
 #    headline line and the sentinel trajectory)

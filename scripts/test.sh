@@ -39,6 +39,14 @@ python -m roc_tpu.analysis --strict
 # round that regressed step/compile time beyond noise fails HERE,
 # before chip time is spent (set -e makes the nonzero exit fatal)
 python -m roc_tpu.sentinel --json
+# serving SLO smoke preflight (PR 17): export a predictor artifact,
+# cold-load it in subprocess replicas, drive a 100-query load gen
+# with the declared availability/latency objectives armed, and
+# require Router.health() green — a serving tier whose SLO engine
+# reports a breach on quiet CPU traffic must not reach chip time
+# (set -e makes the nonzero exit fatal)
+python benchmarks/micro_serve.py --slo-smoke --cpu \
+    --queries 100 --nodes 2000 > /dev/null
 exec python -m roc_tpu.train.cli \
     -lr "$LR" -decay "$WD" -decay-rate "$DR" -dropout "$DROP" \
     -layers "$LAYERS" -e "$EPOCHS" -file dataset/reddit-dgl "$@"

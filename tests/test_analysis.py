@@ -193,6 +193,44 @@ def test_event_clock_registered_and_tree_clean():
     assert got == [], [(f.unit, f.line, f.msg) for f in got]
 
 
+def test_metric_adhoc_fires_and_allows(tmp_path):
+    """metric-adhoc (PR 17): serve/train hot paths must record
+    through the metrics registry — an ad-hoc ``self._n_* +=``
+    counter and a ``*_ms``/``*_lat`` ``.append`` both fire; registry
+    calls, non-metric attributes, the pragma, and the same code
+    OUTSIDE the scoped paths are all clean."""
+    code = ("class S:\n"
+            "    def hot(self, ms):\n"
+            "        self._n_shed += 1\n"                  # line 3
+            "        self.lat_ms.append(ms)\n"            # line 4
+            "        self._h_batch.record(ms)\n"          # registry: ok
+            "        self._c_shed.inc()\n"                # registry: ok
+            "        self.rows.append(ms)\n"              # not *_ms: ok
+            "        # span buffer: roc-lint: ok=metric-adhoc\n"
+            "        self.laps_ms.append(ms)\n")          # pragma'd
+    _plant(tmp_path, "roc_tpu/serve/mod.py", code)
+    _plant(tmp_path, "roc_tpu/train/trainer.py", code)
+    _plant(tmp_path, "roc_tpu/ops/cold.py", code)  # out of scope
+    got = run_ast_lint(str(tmp_path), select=["metric-adhoc"])
+    assert [(f.rule, f.unit, f.line) for f in got] == [
+        ("metric-adhoc", "roc_tpu/serve/mod.py", 3),
+        ("metric-adhoc", "roc_tpu/serve/mod.py", 4),
+        ("metric-adhoc", "roc_tpu/train/trainer.py", 3),
+        ("metric-adhoc", "roc_tpu/train/trainer.py", 4)]
+
+
+def test_metric_adhoc_registered_and_tree_clean():
+    """The rule rides the shrink-only baseline ratchet from zero: the
+    real serve/ + trainer hot paths carry no unpragma'd ad-hoc
+    metric sites (the sanctioned timer-lap buffers carry the
+    documented pragma)."""
+    from roc_tpu.analysis.driver import all_rule_names, is_trace_rule
+    assert "metric-adhoc" in all_rule_names()
+    assert not is_trace_rule("metric-adhoc")
+    got = run_ast_lint(_REPO, select=["metric-adhoc"])
+    assert got == [], [(f.unit, f.line, f.msg) for f in got]
+
+
 # ----------------------------------------------------- jaxpr fixtures
 
 def _unit(fn, *args, name="fix", **ctx):

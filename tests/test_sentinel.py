@@ -154,6 +154,49 @@ def test_serve_availability_loaded_from_round(tmp_path):
     assert bad["regressed"] == ["serve_availability"]
 
 
+def test_serve_p99_and_slo_ok_bite(tmp_path):
+    """PR-17 satellite: the windowed tail latency and the SLO-smoke
+    verdict gate the serve trajectory — a synthetic p99 blowup bites
+    lower-better, a health-red smoke (0.0 after a 1.0 history) bites
+    higher-better, normal jitter passes, and load_bench_round reads
+    both columns back like serve_p50_ms."""
+    from roc_tpu.obs.sentinel import load_bench_round
+    doc = {"parsed": {"value": 100.0, "unit": "ms",
+                      "serve_p50_ms": 0.5, "serve_p99_ms": 1.2,
+                      "serve_slo_ok": 1.0}}
+    p = tmp_path / "BENCH_r22.json"
+    p.write_text(json.dumps(doc))
+    r = load_bench_round(str(p))
+    assert r["serve_p99_ms"] == 1.2
+    assert r["serve_slo_ok"] == 1.0
+    rounds = [dict(r, path=f"r{i}") for i in range(4)]
+    bad = check_run(rounds, {"serve_p99_ms": 6.0,
+                             "serve_slo_ok": 0.0})
+    assert set(bad["regressed"]) == {"serve_p99_ms", "serve_slo_ok"}
+    ok = check_run(rounds, {"serve_p99_ms": 1.3,
+                            "serve_slo_ok": 1.0})
+    assert ok["ok"], ok
+
+
+def test_serve_obs_columns_tolerate_old_rounds():
+    """Rounds recorded before PR 17 lack serve_p99_ms/serve_slo_ok
+    entirely: the loader leaves them None, history shrinks to
+    nothing, and the verdicts are no_history / no_data — never an
+    error, never a false regression."""
+    old = [{"path": f"r{i}", "serve_p50_ms": 0.5, "serve_qps": 900.0}
+           for i in range(3)]
+    res = check_run(old, {"serve_p50_ms": 0.51, "serve_qps": 880.0,
+                          "serve_p99_ms": 1.4, "serve_slo_ok": 1.0})
+    assert res["ok"], res
+    assert res["checks"]["serve_p99_ms"]["verdict"] == "no_history"
+    assert res["checks"]["serve_slo_ok"]["verdict"] == "no_history"
+    # and a current run WITHOUT the new columns against any history
+    res2 = check_run(old, {"serve_p50_ms": 0.5})
+    assert res2["checks"]["serve_p99_ms"]["verdict"] == "no_data"
+    assert res2["checks"]["serve_slo_ok"]["verdict"] == "no_data"
+    assert res2["ok"], res2
+
+
 def test_ckpt_columns_gate_and_load(tmp_path):
     """ISSUE-15 satellite: the checkpoint-cost pair rides the headline
     and gates lower-better — a synthetic 10x re-synchronized save

@@ -149,6 +149,131 @@ def test_straggler_records_and_markers():
     assert "fault:sigkill" in names
 
 
+# ----------------------------- per-request distributed trace (PR 17)
+
+RID = "abc1-7"
+
+
+def _request_run(hedge=False, failover=False, stray_s=None):
+    """Synthetic 3-process serve run: the router (proc 0) routes RID
+    end to end, replica procs 1/2 run microbatch spans carrying it in
+    their rids args.  Wall syncs agree at 1000.0; monotonic bases are
+    hundreds of seconds apart, so a connected trace PROVES the merge
+    aligned the lanes."""
+    events = []
+    for p in range(3):
+        events.append(_ev("timeline", 1000.0, 100.0 + 500.0 * p, p,
+                          kind="clock_sync", epoch=0))
+    # router: the end-to-end route span, 0.2 s after sync, 600 ms
+    events.append(_ev("timeline", 1001.0, 101.0, 0, kind="spans",
+                      spans=[["route_request", 100.2, 600.0,
+                              {"rid": RID, "version": 3}]]))
+    # replica 1: RID's primary microbatch inside the route interval,
+    # plus an unrelated request's microbatch that must stay OUT
+    events.append(_ev("timeline", 1001.0, 601.0, 1, kind="spans",
+                      spans=[["microbatch", 600.25, 120.0,
+                              {"batch": 1, "rows": 4, "version": 3,
+                               "rids": [RID]}],
+                            ["microbatch", 600.05, 80.0,
+                             {"batch": 0, "rows": 2, "version": 3,
+                              "rids": ["other-1"]}]]))
+    if hedge:
+        events.append(_ev("serve", 1000.45, 100.45, 0, kind="hedge",
+                          replica=2, rid=RID))
+        events.append(_ev("timeline", 1001.0, 1101.0, 2, kind="spans",
+                          spans=[["microbatch", 1100.5, 100.0,
+                                  {"batch": 0, "rows": 4, "version": 3,
+                                   "rids": [RID]}]]))
+    if failover:
+        events.append(_ev("serve", 1000.4, 100.4, 0, kind="failover",
+                          replica=1, requeued=1, rids=[RID]))
+        events.append(_ev("timeline", 1001.0, 1101.0, 2, kind="spans",
+                          spans=[["microbatch", 1100.45, 150.0,
+                                  {"batch": 0, "rows": 4, "version": 3,
+                                   "rids": [RID]}]]))
+    if stray_s is not None:
+        # a RID-tagged span far outside the route interval — an
+        # orphaned fragment the connectivity check must flag
+        events.append(_ev("timeline", 1003.0, 1103.0, 2, kind="spans",
+                          spans=[["microbatch", 1100.0 + stray_s, 90.0,
+                                  {"rids": [RID]}]]))
+    return events
+
+
+def test_request_trace_hedged_single_connected():
+    """A hedged request — primary microbatch on replica 1, hedge
+    marker on the router, hedge microbatch on replica 2 — renders as
+    ONE connected trace spanning all three lanes, with the unrelated
+    request's microbatch excluded."""
+    from roc_tpu.timeline import request_trace
+    doc = merge_timeline(_request_run(hedge=True))
+    tr = request_trace(doc, RID)
+    assert tr["connected"] is True
+    assert tr["n_events"] == 4
+    assert len(tr["lanes"]) == 3
+    names = [e["name"] for e in tr["events"]]
+    assert "route_request" in names
+    assert "serve:hedge" in names
+    assert names.count("microbatch") == 2
+    for e in tr["events"]:
+        assert "other-1" not in (e["args"].get("rids") or [])
+
+
+def test_request_trace_failover_requeue_single_connected():
+    """A failover-requeued request — replica 1's batch orphaned, the
+    router's failover marker carrying the rid, the requeued batch on
+    replica 2 — still merges into one connected trace."""
+    from roc_tpu.obs.timeline import request_trace
+    doc = merge_timeline(_request_run(failover=True))
+    tr = request_trace(doc, RID)
+    assert tr["connected"] is True
+    assert len(tr["lanes"]) == 3
+    names = [e["name"] for e in tr["events"]]
+    assert "serve:failover" in names
+    marker = next(e for e in tr["events"]
+                  if e["name"] == "serve:failover")
+    assert marker["args"]["replica"] == 1
+
+
+def test_request_trace_orphan_fragment_not_connected():
+    """A rid-tagged span far outside the route_request interval is an
+    orphaned fragment: the trace still collects it, but connectivity
+    goes False instead of papering over the gap."""
+    from roc_tpu.obs.timeline import request_trace
+    doc = merge_timeline(_request_run(stray_s=1.5))
+    tr = request_trace(doc, RID)
+    assert tr["n_events"] == 3
+    assert tr["connected"] is False
+
+
+def test_request_trace_unknown_rid_empty():
+    from roc_tpu.obs.timeline import request_trace
+    doc = merge_timeline(_request_run())
+    tr = request_trace(doc, "nope-0")
+    assert tr["n_events"] == 0
+    assert tr["connected"] is False
+
+
+def test_span_lap_args_roundtrip_and_legacy():
+    """4-element span laps carry their args dict onto the merged X
+    event; legacy 3-element laps still parse with empty args."""
+    events = [
+        _ev("timeline", 1000.0, 50.0, 0, kind="clock_sync"),
+        _ev("timeline", 1001.0, 51.0, 0, kind="spans",
+            spans=[["microbatch", 50.5, 10.0,
+                    {"rids": [RID], "rows": 4}],
+                   ["train", 50.7, 10.0]]),
+    ]
+    doc = merge_timeline(events)
+    mb = next(e for e in doc["traceEvents"]
+              if e.get("name") == "microbatch")
+    assert mb["args"]["rids"] == [RID]
+    assert mb["args"]["rows"] == 4
+    tr = next(e for e in doc["traceEvents"]
+              if e.get("name") == "train")
+    assert tr["ph"] == "X" and tr["args"] == {}
+
+
 # --------------------------------------------- live P=4 rig (2 procs)
 
 @pytest.fixture(scope="module")
