@@ -3,7 +3,7 @@ plus a rule-driven source lint — regressions against the invariants the
 ROC performance story rests on are caught BEFORE merge, not after a
 chip run.
 
-Seven levels, mirroring XLA's own cost_analysis / HLO-verifier split:
+Eight levels, mirroring XLA's own cost_analysis / HLO-verifier split:
 
 - :mod:`ast_lint` — source-level rules over the tree (stdout
   discipline, host syncs in hot paths, jits bypassing the compile
@@ -27,7 +27,15 @@ Seven levels, mirroring XLA's own cost_analysis / HLO-verifier split:
 - :mod:`sharding_lint` — sharding propagation over the candidate
   jaxprs: the replication ledger vs ``replication_budget``,
   full-width re-gathers, sharding mismatches, donation under
-  sharding, and the (parts, model) mesh-portability report.
+  sharding, and the (parts, model) mesh-portability report;
+- :mod:`protocol_lint` — the protocol auditor & bounded model
+  checker: AST-extracted wire vocabulary of the router<->replica
+  channels held against :mod:`protocol_specs`'s declared contracts
+  (per-kind field sets, unknown-kind rejection), plus
+  :mod:`modelcheck`'s exhaustive bounded BFS over crash/interleave
+  schedules of the router request lifecycle, the checkpoint v3
+  two-phase commit, and the versioned-table swap — jax-free like
+  the AST and concurrency levels.
 
 :mod:`driver` assembles the lint units (synthetic dataset, both
 trainers, the 8-virtual-device mesh) and runs every rule;

@@ -10,6 +10,7 @@ Usage:
     python -m roc_tpu.analysis --select stdout-print   # one rule
     python -m roc_tpu.analysis --select concurrency    # level six
     python -m roc_tpu.analysis --select sharding       # level seven
+    python -m roc_tpu.analysis --select protocol       # level eight
     python -m roc_tpu.analysis --update-baseline   # shrink ratchet
     python -m roc_tpu.analysis --json              # machine-readable
 
@@ -62,7 +63,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "round6_chain.sh preflight selection); "
                         "'sharding' expands to every level-seven "
                         "sharding/replication rule (runs the rig "
-                        "builds + jaxpr walks, no compiles)")
+                        "builds + jaxpr walks, no compiles); "
+                        "'protocol' expands to every level-eight "
+                        "protocol-audit/model-check rule (jax-free "
+                        "— preflight class)")
     p.add_argument("--no-trace", action="store_true",
                    help="skip the jaxpr/HLO trace stage (AST only)")
     p.add_argument("--baseline", default=None,
@@ -89,9 +93,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         # concurrency-only preflight never touches or forces jax);
         # 'sharding' names the level-seven set the same way
         from .concurrency_lint import CONCURRENCY_RULES
+        from .protocol_lint import PROTOCOL_RULES
         from .sharding_lint import SHARDING_RULES
         groups = {"concurrency": CONCURRENCY_RULES,
-                  "sharding": SHARDING_RULES}
+                  "sharding": SHARDING_RULES,
+                  "protocol": PROTOCOL_RULES}
         select = [r for s in select
                   for r in groups.get(s, (s,))]
     trace = not args.no_trace
@@ -245,6 +251,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             "sharding": sh_reports,
             "replication_budget_stale": repl["orphans"],
             "concurrency_surface": extras.get("concurrency"),
+            "protocol_surface": extras.get("protocol"),
             "summary": {"new": len(new), "baselined": len(old),
                         "stale": len(stale),
                         "budget_slack": len(prog["slack"]),

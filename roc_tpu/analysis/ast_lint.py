@@ -449,10 +449,12 @@ def run_ast_lint(root: str,
     if select is not None:
         from .concurrency_lint import CONCURRENCY_RULES
         from .driver import is_trace_rule   # lazy: no import cycle
+        from .protocol_lint import PROTOCOL_RULES
         known = {r.name for r in RULES}
         bad = [s for s in select
                if s not in known and not is_trace_rule(s)
-               and s not in CONCURRENCY_RULES]
+               and s not in CONCURRENCY_RULES
+               and s not in PROTOCOL_RULES]
         if bad:
             raise ValueError(f"unknown lint rule(s): {bad}; "
                              f"AST rules: {sorted(known)}")

@@ -57,6 +57,7 @@ from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
 
 from .ast_lint import pragma_ok
 from .findings import Finding
+from .protocol_specs import ckpt_artifact_entries
 
 CONCURRENCY_RULES = (
     "signal-unsafe-handler",
@@ -1281,14 +1282,11 @@ _ROTATION_CTOR = "CheckpointRotation"
 _ROTATION_WRITERS = {"checkpoint_trainer", "save_checkpoint"}
 _PER_PROCESS_PATH_MARKERS = ("getpid", "process_index", "pid")
 _GATE_ATTRS = {"process_index", "process_count"}
-# checkpoint-v3 two-phase-commit vocabulary (utils/checkpoint.py):
-# the manifest publish is the COMMIT RECORD and must follow every
-# shard rename — a writer that publishes first re-creates the torn-
-# read window the protocol exists to close.  ``write_snapshot`` /
-# ``commit_manifest`` call sites are also inventoried in the
-# artifact surface (the async saver thread's shard writers included).
-_MANIFEST_COMMITTERS = {"commit_manifest"}
-_SHARD_WRITERS = {"write_snapshot", "_write_shard"}
+# checkpoint-v3 two-phase-commit vocabulary: migrated to
+# protocol_specs (roc-lint level eight owns the commit-ORDER rule,
+# ``ckpt-commit-order``); the artifact surface below still inventories
+# the same call sites through the shared helper so ``--select
+# concurrency`` output stays stable.
 
 
 def _refs_process_gate(tm: TreeModel, fd: FuncDef, _depth: int = 0,
@@ -1471,7 +1469,6 @@ def check_artifact_lock_ownership(tm: TreeModel) -> List[Finding]:
                       "handshake) or use a per-process prefix",
                     line=node.lineno,
                     key=f"writer|{fd.qualname}|{label}"))
-        findings.extend(_check_commit_order(m))
     return findings
 
 
@@ -1482,46 +1479,6 @@ def _call_name(node: ast.Call) -> Optional[str]:
     if isinstance(f, ast.Attribute):
         return f.attr
     return None
-
-
-def _check_commit_order(m: ModuleModel) -> List[Finding]:
-    """[artifact-lock-ownership] the v3 two-phase-commit ORDER: within
-    any function that both renames artifact files into place
-    (``os.replace``) and publishes a checkpoint manifest
-    (``commit_manifest``), every publish must come AFTER the last
-    rename — a manifest published before a shard rename points at
-    files that may never land, exactly the torn read the commit
-    protocol exists to rule out."""
-    findings: List[Finding] = []
-    for fd in set(m.funcs.values()):
-        commits: List[int] = []
-        replaces: List[int] = []
-        for node in _walk_own(fd.node):
-            if not isinstance(node, ast.Call):
-                continue
-            name = _call_name(node)
-            if name in _MANIFEST_COMMITTERS:
-                commits.append(node.lineno)
-            elif name == "replace" and \
-                    isinstance(node.func, ast.Attribute) and \
-                    isinstance(node.func.value, ast.Name) and \
-                    node.func.value.id == "os":
-                replaces.append(node.lineno)
-        if not commits or not replaces:
-            continue
-        first_commit = min(commits)
-        late = [ln for ln in replaces if ln > first_commit]
-        if late:
-            findings.append(Finding(
-                "artifact-lock-ownership", m.rel,
-                f"{fd.qualname} publishes the checkpoint manifest "
-                f"(line {first_commit}) BEFORE a shard rename (line "
-                f"{late[0]}): the commit record would point at files "
-                f"that may never land — publish the manifest only "
-                f"after every shard's os.replace",
-                line=first_commit,
-                key=f"commit-order|{fd.qualname}"))
-    return findings
 
 
 def artifact_surface(tm: TreeModel) -> List[Dict[str, Any]]:
@@ -1556,17 +1513,11 @@ def artifact_surface(tm: TreeModel) -> List[Dict[str, Any]]:
                 arts.append({"kind": "warm-state",
                              "line": node.lineno,
                              "owner": "atomic-replace"})
-            elif callee in _SHARD_WRITERS:
-                # checkpoint-v3 shard writers (the async saver thread
-                # included): per-process shard_<proc>.npz filenames
-                # ARE the ownership evidence
-                arts.append({"kind": "ckpt-shard",
-                             "line": node.lineno,
-                             "owner": "per-process-file"})
-            elif callee in _MANIFEST_COMMITTERS:
-                arts.append({"kind": "ckpt-manifest",
-                             "line": node.lineno,
-                             "owner": "proc0-commit-after-shards"})
+        # checkpoint-v3 shard/manifest call sites: the writer
+        # vocabulary and inventory live in protocol_specs (level
+        # eight is the one source of truth; this surface keeps them
+        # for ``--select concurrency`` output stability)
+        arts.extend(ckpt_artifact_entries(m.tree))
         if arts:
             out.append({"module": rel, "artifacts": arts})
     return out

@@ -28,6 +28,7 @@ from .hlo_lint import check_bytes_model, check_large_copy
 from .jaxpr_lint import JAXPR_RULES, JaxprUnit, run_jaxpr_lint
 from .programspace import (_C, _DEG, _F, _H, _V, PROGRAMSPACE_RULES,
                            audit_program_space)
+from .protocol_lint import PROTOCOL_RULES, audit_protocol
 from .sharding_lint import SHARDING_RULES, audit_sharding
 
 HLO_RULES = ("hlo-large-copy", "hlo-bytes-model")
@@ -98,7 +99,7 @@ def check_partition_imbalance(unit: str, real_edges,
 
 def all_rule_names() -> List[str]:
     return ([r.name for r in AST_RULES] + list(CONCURRENCY_RULES)
-            + list(JAXPR_RULES)
+            + list(PROTOCOL_RULES) + list(JAXPR_RULES)
             + list(HLO_RULES) + list(EXTRA_TRACE_RULES)
             + list(COLLECTIVE_RULES) + list(PROGRAMSPACE_RULES)
             + list(SHARDING_RULES))
@@ -308,6 +309,11 @@ def analyze(root: str, select: Optional[List[str]] = None,
     if select is None or any(s in CONCURRENCY_RULES for s in select):
         findings.extend(audit_concurrency(root, select=select,
                                           extras=extras))
+    # level eight: the protocol auditor & bounded model checker —
+    # pure AST + pure-Python BFS, same millisecond class as level six
+    if select is None or any(s in PROTOCOL_RULES for s in select):
+        findings.extend(audit_protocol(root, select=select,
+                                       extras=extras))
     if trace and _needs_trace(select):
         findings.extend(build_trace_findings(select=select))
     if trace and _needs_programspace(select):

@@ -1,0 +1,193 @@
+"""Declared protocol spec tables — roc-lint level eight's contract.
+
+These tables are the DECLARED protocol: the line-JSON wire vocabulary
+the router and its replicas speak (per-kind field contracts included),
+the request-lifecycle and checkpoint-commit transition sites, and the
+invariants the bounded model checker (:mod:`modelcheck`) proves over
+the three protocol models.  :mod:`protocol_lint` extracts the ACTUAL
+protocol from the AST of the five protocol modules and cross-validates
+it against these tables — any disagreement is a ``protocol-spec-drift``
+finding, in either direction:
+
+- code sends/handles a kind (or field, or transition site) this file
+  does not declare → the change must extend the spec table FIRST;
+- this file declares something the code no longer has → the table is
+  stale and must shrink.
+
+That makes the spec the extension point for the rollout/autoscaler/
+resize PRs: add the new kind's row here, watch the lint tell you every
+send/handle/field site the implementation still owes.
+
+This module is jax-free and near-declarative: besides the tables it
+carries only the tiny AST helper both the protocol and concurrency
+levels use to inventory checkpoint-v3 artifact writers (ONE source of
+truth for the callee-name sets — PR 15's inventory migrated here).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Dict, List
+
+# ------------------------------------------------------- wire protocol
+#
+# One entry per directed channel.  Per kind:
+#   required  fields every send site of this kind MUST carry
+#   optional  fields a send site MAY carry (variant shapes — e.g. the
+#             ok/error halves of ``res``)
+#   sent      False for kinds the in-tree sender legitimately never
+#             puts on the wire (with ``note`` saying why); the
+#             wire-vocabulary rule would otherwise flag the receiver
+#             branch as dead vocabulary
+WIRE_CHANNELS: List[Dict[str, Any]] = [
+    {
+        "name": "router->replica",
+        "sender": "roc_tpu/serve/router.py",
+        "receiver": "roc_tpu/serve/replica.py",
+        "kinds": {
+            "req": {
+                "required": ("kind", "id", "ids", "deadline_ms",
+                             "rid"),
+                "optional": (),
+                "sent": True,
+            },
+            "close": {
+                "required": ("kind",),
+                "optional": (),
+                # Router.close() closes the replica's stdin instead of
+                # writing this line: stdin EOF and {"kind": "close"}
+                # funnel into the same drain path, and EOF also covers
+                # a router that died without draining
+                "sent": False,
+                "note": "stdin EOF is the close signal "
+                        "(Router.close closes the pipe)",
+            },
+        },
+    },
+    {
+        "name": "replica->router",
+        "sender": "roc_tpu/serve/replica.py",
+        "receiver": "roc_tpu/serve/router.py",
+        "kinds": {
+            "ready": {
+                "required": ("kind", "replica", "pid", "num_nodes",
+                             "num_classes", "buckets", "backend",
+                             "shard"),
+                "optional": (),
+                "sent": True,
+            },
+            "hb": {
+                "required": ("kind", "inflight", "served", "mono"),
+                "optional": (),
+                "sent": True,
+            },
+            "res": {
+                "required": ("kind", "id", "ok"),
+                # ok=true carries rows+version; ok=false carries the
+                # typed error triple — both shapes are ``res``
+                "optional": ("rows", "version", "error", "msg",
+                             "retryable"),
+                "sent": True,
+            },
+            "drained": {
+                "required": ("kind", "clean", "replica", "served"),
+                "optional": (),
+                "sent": True,
+            },
+        },
+    },
+]
+
+# -------------------------------------------------- transition sites
+#
+# The request-lifecycle and checkpoint-commit state machines, named by
+# the functions that implement their transitions.  Extraction verifies
+# each declared site still exists (a rename/removal without a spec
+# edit is drift) and the surface reports each site's line — the
+# machine-readable "where does this protocol live" index.
+LIFECYCLE_SITES: Dict[str, tuple] = {
+    # router request lifecycle: admit → dispatch → result/failover/
+    # hedge → complete/fail, with the monitor as the deadline backstop
+    "roc_tpu/serve/router.py": (
+        "Router.submit", "Router._dispatch", "Router._on_result",
+        "Router._complete", "Router._fail_sub", "Router._mark_dead",
+        "Router._monitor_loop", "Router.close",
+    ),
+    # replica side: the stdin→drain lifecycle
+    "roc_tpu/serve/replica.py": ("serve_loop",),
+    # in-process server: admission + the versioned-table microbatch
+    "roc_tpu/serve/server.py": (
+        "Server.submit", "Server._dispatch", "Server.drain",
+        "Server.close",
+    ),
+}
+
+COMMIT_SITES: Dict[str, tuple] = {
+    # checkpoint-v3 two-phase commit: shard writes → renames →
+    # manifest publish (the commit record), and the restore-side
+    # validators that refuse torn state
+    "roc_tpu/utils/checkpoint.py": (
+        "write_snapshot", "_write_shard", "commit_manifest",
+        "read_manifest", "is_committed",
+    ),
+    # the async saver drives write_snapshot off the step path;
+    # submit/flush are where a stored error re-raises
+    "roc_tpu/resilience/async_save.py": (
+        "AsyncSaver.submit", "AsyncSaver.flush", "AsyncSaver._process",
+    ),
+}
+
+# ---------------------------------------------------- model invariants
+#
+# Declared per-model invariant tables, cross-checked against
+# modelcheck.model_invariants() — a model gaining/losing an invariant
+# without a spec edit is drift.
+MODEL_INVARIANTS: Dict[str, tuple] = {
+    "router-lifecycle": (
+        "terminal-exactly-once",
+        "failover-requeue-at-most-once",
+        "no-completion-after-close",
+        "deadline-liveness",
+    ),
+    "ckpt-commit": (
+        "manifest-published-last",
+        "restore-never-torn",
+    ),
+    "table-swap": (
+        "single-version-batch",
+    ),
+}
+
+# -------------------------------------- checkpoint artifact inventory
+#
+# Checkpoint-v3 writer vocabulary (utils/checkpoint.py): the manifest
+# publish is the COMMIT RECORD and must follow every shard rename.
+# These sets are the ONE source of truth — the protocol level's
+# ckpt-commit-order rule and the concurrency level's artifact surface
+# both read them (migrated from concurrency_lint, PR 15 → PR 18).
+MANIFEST_COMMITTERS = frozenset({"commit_manifest"})
+SHARD_WRITERS = frozenset({"write_snapshot", "_write_shard"})
+
+
+def ckpt_artifact_entries(tree: ast.Module) -> List[Dict[str, Any]]:
+    """Checkpoint-v3 artifact inventory for ONE module's AST:
+    ``ckpt-shard`` entries for shard-writer call sites (per-process
+    ``shard_<proc>.npz`` filenames ARE the ownership evidence) and
+    ``ckpt-manifest`` entries for manifest commits (proc-0, after
+    every shard rename).  Shared by the protocol surface and the
+    concurrency level's artifact surface."""
+    out: List[Dict[str, Any]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        callee = (f.id if isinstance(f, ast.Name)
+                  else f.attr if isinstance(f, ast.Attribute)
+                  else None)
+        if callee in SHARD_WRITERS:
+            out.append({"kind": "ckpt-shard", "line": node.lineno,
+                        "owner": "per-process-file"})
+        elif callee in MANIFEST_COMMITTERS:
+            out.append({"kind": "ckpt-manifest", "line": node.lineno,
+                        "owner": "proc0-commit-after-shards"})
+    return out

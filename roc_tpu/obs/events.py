@@ -76,10 +76,16 @@ from typing import Any, Dict, List, Optional
 #             value vs target — the breach also dumps the flight
 #             recorder, and ``python -m roc_tpu.report --slo``
 #             renders the breach windows from these records
+#   protocol  protocol-audit surface from roc-lint level eight
+#             (analysis/protocol_lint.py): the extracted wire
+#             vocabulary per channel, transition-site index, and the
+#             bounded model checker's per-model state counts and
+#             invariant verdicts — ``python -m roc_tpu.report
+#             --protocol`` renders the tables from these records
 CATEGORIES = ("manifest", "resolve", "plan", "compile", "epoch",
               "bench", "stall", "run", "analysis", "pipeline",
               "costmodel", "programspace", "resilience", "timeline",
-              "serve", "sharding", "checkpoint", "slo")
+              "serve", "sharding", "checkpoint", "slo", "protocol")
 
 
 # ---------------------------------------------------------- clock tuple

@@ -99,7 +99,8 @@ def _stream_key(rec: Dict[str, Any]):
 def summarize(events: List[Dict[str, Any]],
               metrics: Optional[List[Dict[str, Any]]] = None,
               out=None,
-              concurrency: Optional[Dict[str, Any]] = None) -> int:
+              concurrency: Optional[Dict[str, Any]] = None,
+              protocol: Optional[Dict[str, Any]] = None) -> int:
     out = out if out is not None else sys.stdout
 
     # merged multi-process artifacts: one JSONL per process, each
@@ -324,6 +325,22 @@ def summarize(events: List[Dict[str, Any]],
           ["module", "threads", "sync objects", "signal handlers"],
           rows, out)
 
+    # protocol surface: the level-eight auditor's wire vocabulary +
+    # model-check verdicts.  Source: the ``--protocol`` payload (the
+    # ``python -m roc_tpu.analysis --select protocol --json`` report)
+    # or the ``protocol_surface`` event any audited run leaves in its
+    # stream.
+    proto = protocol
+    if proto is None:
+        evs = [e for e in events
+               if e.get("kind") == "protocol_surface"]
+        if evs:
+            proto = {"channels": evs[-1].get("channels") or [],
+                     "models": evs[-1].get("models") or [],
+                     "totals": evs[-1].get("totals") or {}}
+    if proto:
+        summarize_protocol(proto, out)
+
     # sharding: the level-seven auditor's replication ledger +
     # mesh-portability report (cat=sharding events, or the
     # --sharding payload below via summarize_sharding)
@@ -418,6 +435,65 @@ def summarize_sharding(reports: List[Dict[str, Any]],
               f"{shape[0]}x{shape[1]})",
               ["role", "tensor", "bytes", "split", "replicated",
                "per_device"], rows, out)
+    return 0
+
+
+def summarize_protocol(surface: Dict[str, Any], out=None) -> int:
+    """Render the level-eight protocol audit: the per-channel wire
+    vocabulary (kind, field contract, send/handle sites, drift
+    status), each dispatcher's unknown-kind-rejection verdict, the
+    bounded model checker's per-model state counts and invariant
+    verdicts (with counterexample schedules when a violation fired),
+    and the lifecycle/commit transition-site index.  Input: the
+    ``protocol_surface`` of ``python -m roc_tpu.analysis --select
+    protocol --json`` (or the equivalent ``protocol`` event)."""
+    out = out if out is not None else sys.stdout
+    for chan in surface.get("channels") or []:
+        rows = []
+        for kind, k in sorted((chan.get("kinds") or {}).items()):
+            sent_at = ",".join(str(x) for x in k.get("sent_at") or [])
+            if not sent_at:
+                sent_at = ("(by design)" if k.get("sent") is False
+                           else "-")
+            rows.append([
+                kind,
+                ",".join(k.get("required") or []) or "?",
+                ",".join(k.get("optional") or []) or "-",
+                sent_at,
+                ",".join(str(x) for x in k.get("handled_at") or [])
+                or "-",
+                str(k.get("status", "?"))])
+        _rows(f"wire vocabulary: {chan.get('name')} "
+              f"({chan.get('sender')} -> {chan.get('receiver')})",
+              ["kind", "required", "optional", "sent@", "handled@",
+               "status"], rows, out)
+        rej = ", ".join(
+            f"{d.get('func')}:{d.get('line')}"
+            + ("" if d.get("rejects_unknown") else " [NO REJECTION]")
+            for d in chan.get("dispatchers") or []) or "(none)"
+        print(f"  unknown-kind rejection: {rej}", file=out)
+    rows = [[str(m.get("model", "?")), str(m.get("states")),
+             str(m.get("transitions")),
+             "yes" if m.get("complete") else "BUDGET EXHAUSTED",
+             str(len(m.get("violations") or [])),
+             ", ".join(m.get("invariants") or [])]
+            for m in surface.get("models") or []]
+    _rows("protocol models (bounded exhaustive exploration)",
+          ["model", "states", "transitions", "complete",
+           "violations", "invariants"], rows, out)
+    for m in surface.get("models") or []:
+        for v in m.get("violations") or []:
+            print(f"  VIOLATION {m.get('model')}/"
+                  f"{v.get('invariant')}: {v.get('msg')}", file=out)
+            sched = " -> ".join(v.get("trace") or [])
+            print(f"    schedule: {sched or '<initial state>'}",
+                  file=out)
+    rows = [[str(s.get("machine", "?")), str(s.get("module", "?")),
+             str(s.get("site", "?")), str(s.get("line") or "-"),
+             "yes" if s.get("present") else "MISSING"]
+            for s in surface.get("sites") or []]
+    _rows("protocol transition sites",
+          ["machine", "module", "site", "line", "present"], rows, out)
     return 0
 
 
@@ -560,6 +636,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "concurrency-surface table (threads / locks "
                          "/ signal handlers per module) from it "
                          "instead of the event stream")
+    ap.add_argument("--protocol", default=None, metavar="FILE",
+                    help="`python -m roc_tpu.analysis --select "
+                         "protocol --json` payload: renders the "
+                         "level-eight wire-vocabulary, model-check "
+                         "and transition-site tables from it (works "
+                         "with or without event files)")
     ap.add_argument("--sharding", nargs="?", const="__live__",
                     default=None, metavar="FILE",
                     help="render the sharding auditor's replication "
@@ -637,6 +719,21 @@ def main(argv: Optional[List[str]] = None) -> int:
                    if isinstance(payload, dict) else payload)
         sharding_reports = reports if isinstance(reports, list) \
             else []
+    # --protocol FILE: same contract — accepts the full --json
+    # object or a bare protocol_surface dict; renders standalone
+    # when no event files are given
+    protocol: Optional[Dict[str, Any]] = None
+    if args.protocol:
+        try:
+            with open(args.protocol) as f:
+                payload = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot read {args.protocol}: {e}",
+                  file=sys.stderr)
+            return 2
+        surface = payload.get("protocol_surface", payload) \
+            if isinstance(payload, dict) else None
+        protocol = surface if isinstance(surface, dict) else None
     if not args.events:
         if args.sharding == "__live__":
             # live audit: the single backend-touching mode, kept out
@@ -657,9 +754,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             extras: Dict[str, Any] = {}
             audit_sharding(replication_budget=budget, extras=extras)
             return summarize_sharding(extras.get("sharding", []))
+        rc = None
+        if protocol is not None:
+            rc = summarize_protocol(protocol)
         if sharding_reports is not None:
-            return summarize_sharding(sharding_reports)
-        ap.error("event files required (or --sharding)")
+            rc = summarize_sharding(sharding_reports)
+        if rc is not None:
+            return rc
+        ap.error("event files required (or --sharding / --protocol)")
     events: List[Dict[str, Any]] = []
     for path in _expand(args.events):
         try:
@@ -693,7 +795,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         # accept the full --json object or a bare surface dict
         concurrency = payload.get("concurrency_surface", payload) \
             if isinstance(payload, dict) else None
-    rc = summarize(events, metrics, concurrency=concurrency)
+    rc = summarize(events, metrics, concurrency=concurrency,
+                   protocol=protocol)
     if sharding_reports is not None:
         summarize_sharding(sharding_reports)
     return rc

@@ -8,7 +8,8 @@ cache), runs a :class:`~roc_tpu.serve.server.Server`, and speaks a
 line-JSON protocol over stdin/stdout:
 
 stdin  (router → replica)
-    ``{"id": i, "ids": [...], "deadline_ms": f|null, "rid": s|null}``
+    ``{"kind": "req", "id": i, "ids": [...], "deadline_ms": f|null,
+    "rid": s|null}``
     one request — ``rid`` is the router-minted request id the
     distributed trace connects on (PR 17): the Server stamps it into
     the microbatch span this request rides, so ``python -m
@@ -105,6 +106,7 @@ def serve_loop(server, wire: _Wire, replica: int,
                drain_timeout_s: float = 30.0) -> bool:
     """Read requests until stdin EOF, a ``close`` message, or a
     preemption signal; then drain.  Returns the drain verdict."""
+    from ..obs.events import emit
     from ..resilience import preempt
 
     inflight = [0]
@@ -143,9 +145,25 @@ def serve_loop(server, wire: _Wire, replica: int,
                 msg = json.loads(line)
             except ValueError:
                 continue
-            if msg.get("kind") == "close":
+            kind = msg.get("kind")
+            if kind == "close":
                 break
             req_id = msg.get("id")
+            if kind != "req":
+                # explicit unknown-kind rejection: a typo'd or
+                # future kind must fail LOUD, not be silently
+                # treated as a request (the wire-vocabulary bug
+                # class roc-lint level eight audits for)
+                emit("serve",
+                     f"replica {replica}: rejecting unknown wire "
+                     f"kind {kind!r}", console=False,
+                     kind_rejected=str(kind), replica=replica)
+                if req_id is not None:
+                    wire.send({"kind": "res", "id": req_id,
+                               "ok": False, "error": "ServeError",
+                               "msg": f"unknown wire kind {kind!r}",
+                               "retryable": False})
+                continue
             if req_id is None:
                 continue
             inflight[0] += 1

@@ -452,7 +452,7 @@ class Router:
             remaining_ms = (None if sub.deadline_t is None else
                             max(0.0, (sub.deadline_t - time.monotonic())
                                 * 1e3))
-            ok = rep.send({"id": sub.wire_id,
+            ok = rep.send({"kind": "req", "id": sub.wire_id,
                            "ids": sub.ids.tolist(),
                            "deadline_ms": remaining_ms,
                            "rid": sub.parent.rid})
@@ -526,6 +526,14 @@ class Router:
                 elif kind == "drained":
                     with self._lock:
                         rep.last_hb = time.monotonic()
+                else:
+                    # explicit unknown-kind rejection: a replica
+                    # speaking a newer/typo'd protocol fails loud
+                    # on the bus instead of being silently ignored
+                    emit("serve",
+                         f"replica {rep.idx} sent unknown wire "
+                         f"kind {kind!r} — dropped", console=False,
+                         kind_rejected=str(kind), replica=rep.idx)
         except (OSError, ValueError):
             pass
         finally:

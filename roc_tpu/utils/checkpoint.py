@@ -423,6 +423,18 @@ def write_snapshot(path: str, snap: Snapshot) -> Dict[str, Any]:
         # pointing at half-replaced shards
         os.remove(man)
         _fsync_dir(d)
+    if len(snap.writer_procs) > 1:
+        # un-commit barrier: no writer may rename its shard into
+        # place while a previous manifest could still reference the
+        # old bytes — without this, a peer's early os.replace races
+        # proc 0's un-commit and a crash in that window leaves a live
+        # manifest over a half-replaced shard set (found by the
+        # level-eight model checker's ckpt-commit model; CRC
+        # validation at restore would detect it, but the ordering
+        # guarantee is what makes a present manifest ALWAYS valid)
+        from ..parallel.multihost import checkpoint_commit_barrier
+        checkpoint_commit_barrier(
+            f"{os.path.basename(d)}:{snap.epoch}:uncommit")
     my_name = my_raw = None
     if snap.pieces:
         my_name, my_raw = _write_shard(d, snap)

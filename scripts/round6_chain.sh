@@ -36,6 +36,16 @@ python -m roc_tpu.analysis --json --select concurrency \
 #    `python -m roc_tpu.report --sharding benchmarks/sharding_report.json`
 python -m roc_tpu.analysis --json --select sharding \
   > benchmarks/sharding_report.json || exit 1
+#    protocol audit & bounded model check (roc-lint level eight,
+#    jax-free): the extracted wire vocabulary of the router<->replica
+#    channels vs the declared spec tables, plus exhaustive bounded
+#    exploration of the router-lifecycle / ckpt-commit / table-swap
+#    state machines under crash-at-any-step schedules — a protocol
+#    drift or invariant violation must not reach the serve drill or
+#    chip stages; the artifact renders via
+#    `python -m roc_tpu.report --protocol benchmarks/protocol_report.json`
+python -m roc_tpu.analysis --json --select protocol \
+  > benchmarks/protocol_report.json || exit 1
 #    --jobs stays 1 on the chip host: libtpu owns the accelerator
 #    exclusively, so parallel prewarm children would fail backend
 #    init (sequential children each claim and release it)

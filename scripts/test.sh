@@ -27,6 +27,17 @@ python -m roc_tpu.analysis --select concurrency --json \
 SHARD_REPORT="${TMPDIR:-/tmp}/roc_sharding_report.json"
 python -m roc_tpu.analysis --select sharding --json \
     > "$SHARD_REPORT" || { cat "$SHARD_REPORT"; exit 1; }
+# protocol audit + bounded model check preflight (roc-lint level
+# eight): pure-AST wire-vocabulary extraction over the serve/ckpt
+# state machines plus an exhaustive bounded BFS over crash/interleave
+# schedules of the router lifecycle, the v3 two-phase commit, and the
+# versioned-table swap — jax-free, millisecond class; a sent-but-
+# unhandled wire kind, a dropped field contract, or an invariant
+# violation fails HERE.  The --json report carries the surface for
+# `python -m roc_tpu.report --protocol <file>`
+PROTO_REPORT="${TMPDIR:-/tmp}/roc_protocol_report.json"
+python -m roc_tpu.analysis --select protocol --json \
+    > "$PROTO_REPORT" || { cat "$PROTO_REPORT"; exit 1; }
 # pre-flight static analysis (roc-lint): regressions against the
 # perf invariants fail HERE, before any chip time is spent.  The run
 # also prints the program-space compile-budget delta vs

@@ -590,32 +590,6 @@ def test_artifact_lock_ownership_pragma_and_writer_fns(tmp_path):
         [(f.line, f.msg) for f in got]
 
 
-def test_commit_order_fires_on_manifest_before_shard_rename(tmp_path):
-    """Checkpoint-v3 two-phase-commit ORDER (ISSUE 15 satellite): a
-    writer that publishes the manifest BEFORE a shard rename
-    re-creates the torn-read window — the lint bites; the correct
-    rename-then-publish order (and a pragma'd site) pass."""
-    _plant(tmp_path, "roc_tpu/ck.py",
-           "import os\n"
-           "from roc_tpu.utils.checkpoint import commit_manifest\n"
-           "def bad_writer(d, snap, shards, tmp, shard):\n"
-           "    commit_manifest(d, snap, shards)\n"           # line 4
-           "    os.replace(tmp, shard)\n"
-           "def good_writer(d, snap, shards, tmp, shard):\n"
-           "    os.replace(tmp, shard)\n"
-           "    commit_manifest(d, snap, shards)\n"
-           "def waived_writer(d, snap, shards, tmp, shard):\n"
-           "    commit_manifest(d, snap, shards)  "
-           "# re-commit of a landed shard: roc-lint: "
-           "ok=artifact-lock-ownership\n"
-           "    os.replace(tmp, shard)\n")
-    got = run_concurrency_lint(str(tmp_path),
-                               select=["artifact-lock-ownership"])
-    assert [f.line for f in got] == [4], \
-        [(f.line, f.msg) for f in got]
-    assert "BEFORE a shard rename" in got[0].msg
-
-
 def test_artifact_surface_inventories_real_tree():
     """The surface documents which process-shared artifacts each
     module touches and their ownership protocol: the tree's rotation
