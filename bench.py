@@ -1034,7 +1034,11 @@ def child_serve(args) -> dict:
     (micro_serve.run_router_drill — 2 CPU replicas, replica 1
     SIGKILLed mid-load) contributes the availability columns
     (``serve_shed_rate``/``serve_error_rate``/``serve_availability``)
-    the sentinel's availability checks gate."""
+    the sentinel's availability checks gate.  The ``precomputed_q8``
+    row (PR 19) re-exports the precomputed backend at int8 and feeds
+    the ``serve_table_bytes``/``serve_quant_drift`` columns — the
+    artifact's table bytes and the export drift gate's relative
+    max |Δlogit|."""
     import jax
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -1050,6 +1054,18 @@ def child_serve(args) -> dict:
             rows[backend] = ms.run_backend(
                 backend, ds, Model.from_spec(model.to_spec()), cfg,
                 queries=200, batch=4, rate="auto", art_root=art)
+        # the quantized-serving A/B (PR 19): the precomputed backend
+        # re-exported at int8 — table bytes + the export drift gate's
+        # measurements become the serve_table_bytes /
+        # serve_quant_drift headline columns the sentinel gates
+        try:
+            from roc_tpu.models.builder import Model
+            rows["precomputed_q8"] = ms.run_backend(
+                "precomputed", ds, Model.from_spec(model.to_spec()),
+                cfg, queries=200, batch=4, rate="auto", art_root=art,
+                quant="int8")
+        except Exception as e:  # noqa: BLE001 - latency rows survive
+            rows["precomputed_q8"] = {"error": _errstr(e)}
         try:
             from roc_tpu.models.builder import Model
             drill = ms.run_router_drill(
@@ -1593,6 +1609,19 @@ def parent(args, argv) -> int:
         if smoke.get("ok") is not None:
             serve_fields["serve_slo_ok"] = (1.0 if smoke.get("ok")
                                             else 0.0)
+        # quantized serving (PR 19): the int8 A/B row's artifact
+        # table bytes + the export drift gate's relative max |Δlogit|
+        # — the serve_table_bytes (lower-better: a regression means
+        # the shrink was lost) and serve_quant_drift (gate metric)
+        # sentinel columns, mined exactly like the latency pair
+        q8 = (sv["result"].get("backends") or {}).get(
+            "precomputed_q8") or {}
+        if q8.get("table_bytes") is not None:
+            serve_fields["serve_table_bytes"] = q8.get("table_bytes")
+            serve_fields["serve_quant_drift"] = q8.get("quant_drift")
+            serve_fields["serve_table_shrink"] = q8.get("table_shrink")
+            serve_fields["serve_p50_int8_ms"] = (
+                q8.get("closed") or {}).get("p50_ms")
         # availability columns from the kill-a-replica router drill —
         # the sentinel gates these over the BENCH trajectory exactly
         # like serve_p50_ms (obs/sentinel.py serve_shed_rate /

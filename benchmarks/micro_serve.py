@@ -33,6 +33,16 @@ tracing within 5% of instrumentation-off" acceptance reads
 gate: export → cold-load behind a 2-replica Router with declared
 SLOs → quiet load-gen → exit 0 iff ``Router.health()`` is green.
 
+The quantized-serving pair (PR 19): the ``precomputed_q8`` row
+re-exports the precomputed backend with ``--quantize int8`` and the
+``quant_ab`` summary pairs it with the fp32 row — artifact table
+bytes (the ≥3× shrink acceptance), p50/p99/QPS, and the export drift
+gate's argmax/|Δlogit| measurements (``serve_table_bytes`` /
+``serve_quant_drift`` sentinel columns).  ``--quant-smoke`` runs ONLY
+the PR-19 CI gate: export int8 (drift gate must pass) → cold-load →
+load-gen → served answers bit-equal to the gated values, exit 1
+otherwise.
+
 Usage: python benchmarks/micro_serve.py [--cpu] [--queries N]
        [--rate QPS|auto] [--out out.json]
 The CPU rehearsal artifact lives at benchmarks/micro_serve_cpu.json;
@@ -142,19 +152,25 @@ def open_loop(server, ids_seq, rate_qps, seed=0):
 
 
 def run_backend(backend, ds, model, cfg, queries, batch, rate,
-                art_root, seed=0, max_wait_ms=0.2, instrument=True):
+                art_root, seed=0, max_wait_ms=0.2, instrument=True,
+                quant="off"):
     """Export one backend through the real artifact path, then drive
     closed- and open-loop traffic against a cold-loaded server.
     ``instrument=False`` runs the same load with registry recording
     and trace stamping disarmed — the A/B row the observability-
-    overhead acceptance (steady-state p50 within 5%) is measured
-    on."""
+    overhead acceptance (steady-state p50 within 5%) is measured on.
+    ``quant='int8'`` exports quantized serving tables (PR 19) — the
+    row additionally carries the artifact's table bytes and the
+    export drift gate's measurements, the quant:off/quant:int8 A/B
+    pair the headline mines."""
     from roc_tpu.serve.export import (build_predictor, export_predictor,
                                       load_predictor)
     from roc_tpu.serve.server import Server
-    out_dir = os.path.join(art_root, backend)
+    out_dir = os.path.join(
+        art_root, backend + ("" if quant == "off" else f"_{quant}"))
     t0 = time.perf_counter()
-    pred = build_predictor(model, ds, cfg, backend=backend)
+    pred = build_predictor(model, ds, cfg, backend=backend,
+                           quant=quant)
     manifest = export_predictor(
         pred, out_dir,
         dataset_meta={"V": ds.graph.num_nodes,
@@ -165,18 +181,34 @@ def run_backend(backend, ds, model, cfg, queries, batch, rate,
     t0 = time.perf_counter()
     pred = load_predictor(
         out_dir, dataset=ds if backend == "full" else None)
-    warm = pred.warm(name=f"serve_bench_{backend}")
+    warm = pred.warm(name=f"serve_bench_{backend}_{quant}")
     load_s = time.perf_counter() - t0
     rng = np.random.RandomState(seed)
     ids_seq = [rng.randint(0, ds.graph.num_nodes,
                            size=batch).astype(np.int32)
                for _ in range(queries)]
     row = {"backend": backend, "flavor": manifest["flavor"],
+           "quant": quant,
            "instrument": bool(instrument),
            "export_s": round(export_s, 2),
            "cold_load_s": round(load_s, 3),
            "warm_hits": warm.get("compile_warm_hits"),
            "cold_compiles": warm.get("compile_cold")}
+    # quantized-serving columns (PR 19): the artifact's propagation
+    # table bytes (fp32 rows see shrink 1.0) and, for quantized
+    # exports, the gate's measured drift — these feed the
+    # serve_table_bytes / serve_quant_drift sentinel columns
+    qb = manifest.get("quant") or {}
+    table = qb.get("table") or {}
+    if table.get("bytes") is not None:
+        row["table_bytes"] = table["bytes"]
+        row["table_bytes_fp32"] = table.get("bytes_fp32")
+        row["table_shrink"] = table.get("shrink")
+    drift = qb.get("drift")
+    if drift is not None:
+        row["argmax_drift"] = round(
+            1.0 - drift["argmax_agreement"], 4)
+        row["quant_drift"] = drift["rel_dlogit"]
     with Server(pred, max_wait_ms=max_wait_ms,
                 instrument=instrument) as srv:
         # closed loop first — its throughput calibrates 'auto' rate
@@ -285,6 +317,107 @@ def run_slo_smoke(ds, model, cfg, art_root, queries=100,
             "p99_ms": stats.get("p99_ms"),
             "wall_s": round(time.perf_counter() - t0, 2),
             "health": health}
+
+
+def run_quant_ab(pred_off, pred_q8, ds, queries, batch,
+                 max_wait_ms, trials=4, seed=0):
+    """Paired interleaved p50 A/B between the fp32 and int8 loaded
+    predictors — the ``run_obs_ab`` precedent: at sub-ms request
+    latencies two sequential rows disagree by ±30% on machine drift
+    alone, so the 'int8 p50 no worse than fp32' acceptance is
+    measured on interleaved arms and median-of-trials, not on the
+    independent backend rows."""
+    from roc_tpu.serve.server import Server
+    rng = np.random.RandomState(seed)
+    ids_seq = [rng.randint(0, ds.graph.num_nodes,
+                           size=batch).astype(np.int32)
+               for _ in range(queries)]
+    p50s = {"off": [], "int8": []}
+    arms = {"off": pred_off, "int8": pred_q8}
+    for trial in range(trials):
+        order = (("off", "int8") if trial % 2 == 0
+                 else ("int8", "off"))
+        for name in order:
+            with Server(arms[name], max_wait_ms=max_wait_ms) as srv:
+                lat, _, _, _ = closed_loop(srv, ids_seq)
+            p50s[name].append(_pcts(lat)["p50_ms"])
+    def _med(vs):
+        vs = sorted(vs)
+        n = len(vs)
+        return vs[n // 2] if n % 2 else 0.5 * (vs[n // 2 - 1]
+                                               + vs[n // 2])
+    off, q8 = _med(p50s["off"]), _med(p50s["int8"])
+    return {"trials": trials, "queries_per_pass": queries,
+            "p50_off_ms": round(off, 4), "p50_int8_ms": round(q8, 4),
+            "p50_off_all": [round(v, 4) for v in p50s["off"]],
+            "p50_int8_all": [round(v, 4) for v in p50s["int8"]],
+            "delta_pct": round(100.0 * (q8 - off)
+                               / max(off, 1e-9), 1)}
+
+
+def run_quant_smoke(ds, model, cfg, art_root, queries=100,
+                    batch=4, mode="int8", seed=0):
+    """The quantized-serving smoke (PR 19 CI gate): export the
+    precomputed backend at ``mode`` — the export-side drift gate must
+    pass (export REFUSES past threshold) — then cold-load the
+    artifact, drive a quiet load-gen pass through a Server, and
+    require every served answer to match the export-process
+    predictor's gated values bit-exactly (the round-trip identity:
+    quantize∘dequantize∘quantize is lossless, so a cold load
+    reconstructs the same device codes).  Exit-enforced by
+    scripts/test.sh preflight and round6_chain step 0b: a quantized
+    artifact that drifts past the gate, or a cold load that serves
+    different values than were gated, never reaches a round."""
+    from roc_tpu.serve.export import (build_predictor, export_predictor,
+                                      load_predictor)
+    from roc_tpu.serve.quant import QuantDriftError
+    from roc_tpu.serve.server import Server
+    out_dir = os.path.join(art_root, "quant_smoke")
+    t_start = time.perf_counter()
+    pred = build_predictor(model, ds, cfg, backend="precomputed",
+                           quant=mode)
+    try:
+        manifest = export_predictor(
+            pred, out_dir,
+            dataset_meta={"V": ds.graph.num_nodes,
+                          "E": ds.graph.num_edges})
+    except QuantDriftError as e:
+        return {"mode": mode, "queries": queries, "ok": False,
+                "stage": "export-gate", "error": str(e)}
+    qb = manifest["quant"]
+    drift = qb["drift"]
+    table = qb.get("table") or {}
+    rng = np.random.RandomState(seed)
+    ids_seq = [rng.randint(0, ds.graph.num_nodes,
+                           size=batch).astype(np.int32)
+               for _ in range(queries)]
+    # reference answers from the export-process predictor — already
+    # the gated dequantize∘quantize values the artifact persists
+    want = [np.asarray(pred.query(ids)) for ids in ids_seq]
+    cold = load_predictor(out_dir)
+    wrong = 0
+    qmodes = set()
+    lat = []
+    with Server(cold, max_wait_ms=0.2) as srv:
+        for ids, ref in zip(ids_seq, want):
+            t0 = time.perf_counter()
+            res = srv.query(ids)
+            lat.append((time.perf_counter() - t0) * 1e3)
+            qmodes.add(getattr(res, "qmode", None))
+            if np.abs(np.asarray(res) - ref).max() > 0.0:
+                wrong += 1
+    ok = (bool(drift.get("ok")) and wrong == 0
+          and cold.quant == mode and qmodes == {mode})
+    row = {"mode": mode, "queries": queries, "ok": ok,
+           "wrong": wrong, "qmode_served": sorted(
+               str(m) for m in qmodes),
+           "loaded_quant": cold.quant,
+           "export_drift": drift,
+           "table_bytes": table.get("bytes"),
+           "table_shrink": table.get("shrink"),
+           "wall_s": round(time.perf_counter() - t_start, 2)}
+    row.update(_pcts(lat))
+    return row
 
 
 def run_router_drill(ds, model, cfg, art_root, queries=120,
@@ -398,6 +531,16 @@ def main(argv=None):
                          "objectives → quiet load-gen → require "
                          "health green (exit 1 otherwise) — the CI "
                          "serving-tier gate")
+    ap.add_argument("--quant-smoke", action="store_true",
+                    help="run ONLY the quantized-serving smoke: "
+                         "export int8 (drift gate must pass) → "
+                         "cold-load → load-gen → served answers must "
+                         "match the gated values bit-exactly (exit 1 "
+                         "otherwise) — the PR-19 CI gate")
+    ap.add_argument("--no-quant-ab", action="store_true",
+                    help="skip the quant:int8 A/B row (precomputed "
+                         "backend re-exported with --quantize int8; "
+                         "the table-bytes/drift acceptance)")
     ap.add_argument("--no-obs-ab", action="store_true",
                     help="skip the instrumentation-off A/B row "
                          "(precomputed backend re-run with "
@@ -416,6 +559,21 @@ def main(argv=None):
     dev = jax.devices()[0]
     ds, model, cfg = build_rig(args.nodes, args.degree, args.feat,
                                args.classes, args.hops)
+    if args.quant_smoke:
+        from roc_tpu.models.builder import Model
+        with tempfile.TemporaryDirectory(prefix="roc_quant_") as art:
+            row = run_quant_smoke(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                queries=args.queries, batch=args.batch)
+        drift = row.get("export_drift") or {}
+        print(f"# quant smoke: {'GREEN' if row['ok'] else 'RED'} "
+              f"({row['queries']} queries, mode {row['mode']}, "
+              f"rel drift {drift.get('rel_dlogit')}, "
+              f"shrink {row.get('table_shrink')}x, "
+              f"{row.get('wrong', '?')} served mismatches)",
+              file=sys.stderr)
+        print(json.dumps(row))
+        return 0 if row["ok"] else 1
     if args.slo_smoke:
         from roc_tpu.models.builder import Model
         with tempfile.TemporaryDirectory(prefix="roc_slo_") as art:
@@ -451,6 +609,49 @@ def main(argv=None):
                   f"{row['closed'].get('device_p50_ms')} ms) | open "
                   f"p50 {row['open']['p50_ms']} ms p99 "
                   f"{row['open']['p99_ms']} ms", file=sys.stderr)
+        if "precomputed" in out["backends"] and not args.no_quant_ab:
+            # the quantized-serving A/B (PR 19): same backend, same
+            # load, tables + params exported at int8 — the paired
+            # quant:off/quant:int8 rows the table-bytes/drift
+            # acceptance reads
+            from roc_tpu.models.builder import Model
+            row = run_backend(
+                "precomputed", ds, Model.from_spec(model.to_spec()),
+                cfg, args.queries, args.batch, args.rate, art,
+                quant="int8")
+            out["backends"]["precomputed_q8"] = row
+            pre = out["backends"]["precomputed"]
+            out["quant_ab"] = {
+                "table_bytes_off": pre.get("table_bytes"),
+                "table_bytes_int8": row.get("table_bytes"),
+                "table_shrink": row.get("table_shrink"),
+                "p50_off_ms": pre["closed"]["p50_ms"],
+                "p50_int8_ms": row["closed"]["p50_ms"],
+                "p99_off_ms": pre["closed"]["p99_ms"],
+                "p99_int8_ms": row["closed"]["p99_ms"],
+                "qps_off": pre["closed"]["qps"],
+                "qps_int8": row["closed"]["qps"],
+                "argmax_drift": row.get("argmax_drift"),
+                "quant_drift": row.get("quant_drift")}
+            # the headline p50 comparison comes from a PAIRED
+            # interleaved A/B over the two cold-loaded artifacts —
+            # the sequential rows above drift ±30% at sub-ms p50s
+            from roc_tpu.serve.export import load_predictor
+            p_off = load_predictor(os.path.join(art, "precomputed"))
+            p_off.warm(name="serve_quant_ab_off")
+            p_q8 = load_predictor(
+                os.path.join(art, "precomputed_int8"))
+            p_q8.warm(name="serve_quant_ab_int8")
+            paired = run_quant_ab(p_off, p_q8, ds, args.queries,
+                                  args.batch, args.max_wait_ms)
+            out["quant_ab"]["paired"] = paired
+            print(f"# quant A/B: table {pre.get('table_bytes')} B "
+                  f"fp32 → {row.get('table_bytes')} B int8 "
+                  f"({row.get('table_shrink')}x), paired p50 "
+                  f"{paired['p50_off_ms']} → "
+                  f"{paired['p50_int8_ms']} ms "
+                  f"({paired['delta_pct']:+.1f}%), argmax drift "
+                  f"{row.get('argmax_drift')}", file=sys.stderr)
         if "precomputed" in out["backends"] and not args.no_obs_ab:
             # the observability-overhead A/B: same backend, same
             # load, registry + trace stamping disarmed

@@ -433,11 +433,80 @@ class MetricAdhocRule(AstRule):
                     key=f"adhoc-latency@{node.lineno}")
 
 
+class DequantHotPathRule(AstRule):
+    """Materializing a full fp32 copy of a quantized serving table
+    inside ``roc_tpu/serve/``: the whole point of int8/fp8 tables
+    (``serve/quant.py``) is that the ``[V, F]`` buffer never widens —
+    the serve programs gather the bucket's rows and dequantize
+    IN-REGISTER.  An ``.astype(float32)`` (or
+    ``asarray(..., dtype=float32)`` / ``float32(...)`` cast) applied
+    to a table/stage-named array undoes the capacity win in one line
+    and doubles+ the replica's memory right where it is scarcest.
+    Sanctioned sites — host-side build/load paths and rows-only
+    refresh slices — carry a ``# roc-lint: ok=dequant-hot-path``
+    pragma saying why they are not the hot path."""
+
+    name = "dequant-hot-path"
+    why = ("serve/ must dequantize gathered rows in-register — a "
+           "full fp32 copy of a [V, F] table forfeits the quantized "
+           "capacity win; pragma host-side build/refresh sites")
+
+    def select(self, relpath: str) -> bool:
+        return relpath.startswith("roc_tpu/serve/")
+
+    @staticmethod
+    def _is_f32(node: ast.AST) -> bool:
+        return (_is_attr(node, "float32") or _is_name(node, "float32")
+                or (isinstance(node, ast.Constant)
+                    and node.value == "float32"))
+
+    @staticmethod
+    def _tableish(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            ident = (n.id if isinstance(n, ast.Name)
+                     else n.attr if isinstance(n, ast.Attribute)
+                     else None)
+            if ident and ("table" in ident.lower()
+                          or "stage" in ident.lower()):
+                return True
+        return False
+
+    def check(self, tree, relpath):
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "astype"
+                    and node.args and self._is_f32(node.args[0])
+                    and self._tableish(f.value)):
+                yield Finding(
+                    self.name, relpath,
+                    "full fp32 .astype on a table-shaped array — "
+                    "dequantize gathered rows in-register instead",
+                    line=node.lineno, key=f"astype@{node.lineno}")
+            elif (isinstance(f, ast.Attribute) and f.attr == "asarray"
+                    and node.args and self._tableish(node.args[0])
+                    and any(kw.arg == "dtype" and self._is_f32(kw.value)
+                            for kw in node.keywords)):
+                yield Finding(
+                    self.name, relpath,
+                    "asarray(<table>, dtype=float32) materializes a "
+                    "full fp32 table copy",
+                    line=node.lineno, key=f"asarray@{node.lineno}")
+            elif (self._is_f32(f) and node.args
+                    and self._tableish(node.args[0])):
+                yield Finding(
+                    self.name, relpath,
+                    "float32(<table>) cast materializes a full fp32 "
+                    "table copy",
+                    line=node.lineno, key=f"cast@{node.lineno}")
+
+
 RULES: List[AstRule] = [StdoutPrintRule(), HostSyncHotPathRule(),
                         SyncH2dInLoopRule(), BareJitRule(),
                         PallasInterpretRule(),
                         SwallowedExceptionRule(), EventClockRule(),
-                        MetricAdhocRule()]
+                        MetricAdhocRule(), DequantHotPathRule()]
 
 
 def run_ast_lint(root: str,

@@ -34,11 +34,14 @@ The three models:
   is served from exactly that one version, under any interleaving of
   the swap.
 
-Each model carries one seedable bug (``seed=`` names it) so the test
+Each model carries seedable bugs (``seed=`` names one) so the test
 tier can prove the checker actually bites: ``double-requeue`` drops
 the per-corpse requeue guard, ``manifest-first`` publishes the
 manifest before the shard renames, ``swap-mid-query`` reads the live
-published version per row instead of the captured one.
+published version per row instead of the captured one, and
+``live-qmode`` (PR 19) keeps the captured rows but picks the dequant
+program from the live published version's quant spec — the
+mid-rollout fp32→int8 window ``quant-spec-pinned`` exists for.
 """
 
 from __future__ import annotations
@@ -56,11 +59,19 @@ STATE_BUDGET = 20_000
 
 MODELS = ("router-lifecycle", "ckpt-commit", "table-swap")
 
-# the one seedable bug per model (test fixtures)
+# the canonical seedable bug per model (test fixtures)
 SEEDS = {
     "router-lifecycle": "double-requeue",
     "ckpt-commit": "manifest-first",
     "table-swap": "swap-mid-query",
+}
+
+# additional seedable bugs (PR 19): the quantized-rollout window —
+# "live-qmode" keeps the captured version's ROWS but selects the
+# dequant program by the LIVE published version's quant spec, the
+# mid-rollout bug class quant-spec-pinned exists to catch
+EXTRA_SEEDS = {
+    "table-swap": ("live-qmode",),
 }
 
 
@@ -376,37 +387,50 @@ def _ckpt_model(seed: Optional[str], budget: int) -> ModelReport:
 # -------------------------------------------- model 3: table swap
 
 # one two-row microbatch racing one publish: the dispatcher captures
-# published() once (step 0), then serves each row from the capture
+# published() once (step 0), then serves each row from the capture.
+# PR 19: the publish is a QUANTIZED rollout — version 0 is fp32,
+# version 1 int8 (_QMODE), so every served row records the (version,
+# decode-mode) pair and quant-spec-pinned can distinguish "read the
+# wrong version's rows" from "decoded the right rows with the wrong
+# version's program".
 _S = namedtuple("_S", "published captured served step")
+
+# the quant spec each published version carries (the mid-rollout
+# fp32→int8 swap the serve tier's versioned publish protocol covers)
+_QMODE = ("fp32", "int8")
 
 
 def _swap_step(seed: Optional[str]
                ) -> Callable[[Any], List[Tuple[str, Any]]]:
-    seeded = seed == "swap-mid-query"
+    live_rows = seed == "swap-mid-query"
+    live_mode = seed == "live-qmode"
 
     def step(s: _S) -> List[Tuple[str, Any]]:
         out: List[Tuple[str, Any]] = []
         done = s.step >= 3
         if s.published == 0 and not done:
-            # add_edges / rollout publishes v1 at any point
-            out.append(("publish@v1", s._replace(published=1)))
+            # add_edges / quantized rollout publishes v1 at any point
+            out.append(("publish@v1:int8", s._replace(published=1)))
         if s.step == 0:
             out.append(("capture", s._replace(
                 captured=s.published, step=1)))
         elif not done:
             row = s.step - 1
-            # the seeded bug reads the LIVE published version per row
-            # instead of the microbatch's captured one
-            v = s.published if seeded else s.captured
-            out.append((f"serve_row{row}@v{v}", s._replace(
-                served=_set(s.served, row, v), step=s.step + 1)))
+            # seeded bug 1 reads the LIVE published version's rows
+            # instead of the microbatch's captured ones
+            v = s.published if live_rows else s.captured
+            # seeded bug 2 keeps the captured rows but selects the
+            # dequant program by the LIVE version's quant spec
+            m = _QMODE[s.published if live_mode else v]
+            out.append((f"serve_row{row}@v{v}:{m}", s._replace(
+                served=_set(s.served, row, (v, m)), step=s.step + 1)))
         return out
 
     return step
 
 
 def _swap_invariant(s: _S) -> Optional[str]:
-    got = {v for v in s.served if v is not None}
+    got = {v for v, _ in (x for x in s.served if x is not None)}
     if len(got) > 1 or (got and s.captured is not None
                         and got != {s.captured}):
         return (f"microbatch served rows from versions "
@@ -416,10 +440,24 @@ def _swap_invariant(s: _S) -> Optional[str]:
     return None
 
 
+def _swap_quant_invariant(s: _S) -> Optional[str]:
+    for x in s.served:
+        if x is None:
+            continue
+        v, m = x
+        if m != _QMODE[v]:
+            return (f"row read from v{v} ({_QMODE[v]} table) was "
+                    f"decoded with the {m} program — the quant spec "
+                    f"must travel WITH the captured version, not be "
+                    f"re-read from the live publication mid-batch")
+    return None
+
+
 def _swap_model(seed: Optional[str], budget: int) -> ModelReport:
     init = _S(published=0, captured=None, served=(None, None), step=0)
     return _bfs("table-swap", init, _swap_step(seed),
-                [("single-version-batch", _swap_invariant)],
+                [("single-version-batch", _swap_invariant),
+                 ("quant-spec-pinned", _swap_quant_invariant)],
                 budget=budget)
 
 
@@ -449,9 +487,10 @@ def run_model(name: str, seed: Optional[str] = None,
     regression-tested; unknown names raise."""
     if name not in _BUILDERS:
         raise ValueError(f"unknown model {name!r}; have {MODELS}")
-    if seed is not None and seed != SEEDS.get(name):
+    known = (SEEDS.get(name),) + EXTRA_SEEDS.get(name, ())
+    if seed is not None and seed not in known:
         raise ValueError(f"unknown seed {seed!r} for {name!r}; "
-                         f"have {SEEDS[name]!r}")
+                         f"have {known}")
     return _BUILDERS[name](seed, budget)
 
 

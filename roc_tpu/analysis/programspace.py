@@ -141,13 +141,18 @@ class RigSpec:
     the idempotency assert still applies) and the enumerated set is
     its bucketed serve-program space — which is how the serve tier's
     programs fall under the SAME ``program_budget`` ratchet and
-    prewarm driver as the training steps."""
+    prewarm driver as the training steps.  ``quant`` selects the serve
+    table encoding (``serve/quant.py``): quantized variants are
+    DISTINCT programs with distinct slots (``_q8``/``_qf8``), so they
+    get their own rig + budget row instead of inflating the fp32
+    rig's."""
 
     name: str
     model: Callable[[], Any]
     config: Callable[[], Any]
     parts: int = 1
     serve: Optional[str] = None
+    quant: str = "off"
 
 
 def _rig_specs() -> Dict[str, RigSpec]:
@@ -195,6 +200,19 @@ def _rig_specs() -> Dict[str, RigSpec]:
             config=lambda: TrainConfig(
                 verbose=False, symmetric=True, dtype=jnp.float32),
             parts=1, serve="precomputed"),
+        # the QUANTIZED serve variant (PR 19): the same predictor
+        # under int8 tables — the dequant-in-register bucket programs
+        # (`serve_precomputed_akx_q8:{b}`) are a distinct program set
+        # with distinct arg avals (int8 codes + fp32 scales), so they
+        # ratchet under their own budget row while `sgc_serve` stays
+        # at delta +0, and the prewarm driver AOT-warms the quantized
+        # executables the export/cold-load path reuses.
+        "sgc_serve_q8": RigSpec(
+            name="sgc_serve_q8",
+            model=lambda: build_sgc([_F, _C], k=2, dropout_rate=0.5),
+            config=lambda: TrainConfig(
+                verbose=False, symmetric=True, dtype=jnp.float32),
+            parts=1, serve="precomputed", quant="int8"),
         # the (parts, model) 2-D mesh rig: gin_flat8's exact program
         # set widened to mesh=2x4 — params/Adam moments model-sharded
         # at rest, the partial-auto steps take the extra partition-
@@ -250,7 +268,7 @@ def build_rig_trainer(spec: RigSpec, dataset=None):
     if spec.serve:
         from ..serve.export import build_predictor
         return build_predictor(spec.model(), ds, spec.config(),
-                               backend=spec.serve)
+                               backend=spec.serve, quant=spec.quant)
     if spec.parts > 1:
         from ..parallel.distributed import DistributedTrainer
         return DistributedTrainer(spec.model(), ds, spec.parts,

@@ -70,9 +70,15 @@ WIRE_CHANNELS: List[Dict[str, Any]] = [
         "receiver": "roc_tpu/serve/router.py",
         "kinds": {
             "ready": {
+                # "quant" (PR 19): the replica advertises its serving
+                # tables' quantization mode (off/int8/fp8) so the
+                # router's fleet view can refuse a mixed-mode rollout
+                # it did not ask for — declared HERE first, per the
+                # spec-first workflow: the wire-field-contract rule
+                # then reports every send site still owed the field
                 "required": ("kind", "replica", "pid", "num_nodes",
                              "num_classes", "buckets", "backend",
-                             "shard"),
+                             "shard", "quant"),
                 "optional": (),
                 "sent": True,
             },
@@ -83,10 +89,14 @@ WIRE_CHANNELS: List[Dict[str, Any]] = [
             },
             "res": {
                 "required": ("kind", "id", "ok"),
-                # ok=true carries rows+version; ok=false carries the
-                # typed error triple — both shapes are ``res``
-                "optional": ("rows", "version", "error", "msg",
-                             "retryable"),
+                # ok=true carries rows+version (+qmode, PR 19: the
+                # quant spec of the table VERSION the microbatch was
+                # pinned to — a mid-rollout fp32→int8 swap answers
+                # with the captured version's mode, and the wire says
+                # so); ok=false carries the typed error triple — both
+                # shapes are ``res``
+                "optional": ("rows", "version", "qmode", "error",
+                             "msg", "retryable"),
                 "sent": True,
             },
             "drained": {
@@ -155,6 +165,12 @@ MODEL_INVARIANTS: Dict[str, tuple] = {
     ),
     "table-swap": (
         "single-version-batch",
+        # PR 19: every published version carries its quant spec, and a
+        # row must be DECODED with the qmode of the version it was
+        # read from — serving an fp32-captured batch through the int8
+        # dequant program (or vice versa, mid-rollout) is garbage even
+        # when the version ids agree.  Seedable as "live-qmode".
+        "quant-spec-pinned",
     ),
 }
 

@@ -499,7 +499,7 @@ def stream_prefix_to_host(graph: Graph, prefix_ops,
                           feats_host: np.ndarray,
                           block_rows: int = 65536,
                           prefetch: int = 1,
-                          capture: Optional[list] = None) -> np.ndarray:
+                          capture=None) -> np.ndarray:
     """Evaluate a parameter-free norm/aggregation prefix (the op list
     returned by ``Model.streamable_agg_head``, or its serialized dict
     form) with every [V, F] intermediate host-resident:
@@ -509,9 +509,15 @@ def stream_prefix_to_host(graph: Graph, prefix_ops,
     training session — this is the SGC-style precompute (A_hat^k X),
     after which epochs touch only the streamed head.
 
-    ``capture`` (a list) receives a COPY of the value after each op —
-    the per-stage tables the serve tier's incremental invalidation
-    needs (``serve/propagation.PropagationCache``).  ONE walk for the
+    ``capture`` receives each post-op stage table: a plain list (or
+    anything with ``.append``) keeps the fp32 arrays — the per-stage
+    tables the serve tier's incremental invalidation needs
+    (``serve/propagation.PropagationCache``) — while a CALLABLE is
+    invoked with each stage instead, which is the quantized-export
+    hook (``serve/quant.QuantizingCapture`` encodes each stage as it
+    streams, so the >RAM export's host peak holds ONE fp32 stage, not
+    all k).  Either way the sink receives an exclusively-owned array
+    (see the no-defensive-copy note below).  ONE walk for the
     trainer's precompute and the serving table, so the two can never
     diverge numerically."""
     from ..models.builder import AGGR_AVG, AGGR_SUM
@@ -551,7 +557,10 @@ def stream_prefix_to_host(graph: Graph, prefix_ops,
             # before this append), so each captured stage is
             # exclusively owned — a copy would double the host peak
             # of the >HBM export this path exists for
-            capture.append(x)
+            if callable(capture):
+                capture(x)
+            else:
+                capture.append(x)
     return x
 
 

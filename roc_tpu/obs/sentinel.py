@@ -125,6 +125,8 @@ def load_bench_round(path: str) -> Dict[str, Any]:
                            "serve_error_rate": None,
                            "serve_availability": None,
                            "serve_slo_ok": None,
+                           "serve_table_bytes": None,
+                           "serve_quant_drift": None,
                            "ckpt_save_ms": None,
                            "ckpt_block_ms": None,
                            "mesh_epoch_ratio": None,
@@ -156,9 +158,14 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     # registry's log-bucket histogram) and the SLO-smoke verdict
     # (serve_slo_ok, 1.0 = Router.health() green) — rounds recorded
     # before PR 17 simply lack the keys and stay None (no_data)
+    # PR 19 adds the quantized-serving pair: serve_table_bytes (the
+    # int8 artifact's propagation-table bytes, lower-better — a
+    # regression means the shrink was lost) and serve_quant_drift
+    # (the gate's relative max |Δlogit|, lower-better)
     for k in ("serve_p50_ms", "serve_p99_ms", "serve_qps",
               "serve_shed_rate", "serve_error_rate",
               "serve_availability", "serve_slo_ok",
+              "serve_table_bytes", "serve_quant_drift",
               "ckpt_save_ms", "ckpt_block_ms"):
         if isinstance(parsed.get(k), (int, float)):
             out[k] = float(parsed[k])
@@ -298,6 +305,20 @@ def check_run(rounds: List[Dict[str, Any]],
             current.get("serve_slo_ok"),
             higher_is_better=True, allow_zero=True,
             abs_floor=RATE_ABS_FLOOR),
+        # quantized serving (PR 19): the int8 artifact's propagation
+        # table bytes, lower-better — a regression means the export
+        # lost the shrink (e.g. the quant branch silently fell back
+        # to fp32 tables)
+        "serve_table_bytes": detect(
+            [r.get("serve_table_bytes") for r in rounds],
+            current.get("serve_table_bytes")),
+        # ... and the drift gate's relative max |Δlogit|, lower-better;
+        # healthy rounds sit well under the gate so an inflated round
+        # bites via the relative floor (0.0 is legitimate → allow_zero)
+        "serve_quant_drift": detect(
+            [r.get("serve_quant_drift") for r in rounds],
+            current.get("serve_quant_drift"), allow_zero=True,
+            abs_floor=RATE_ABS_FLOOR),
         # checkpoint v3 (ISSUE 15): async save wall + step-path
         # blocked time, lower-better — a PR that re-synchronizes the
         # save path (or bloats the snapshot) regresses here first
@@ -417,6 +438,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "serve_error_rate": cur.get("serve_error_rate"),
                    "serve_availability": cur.get("serve_availability"),
                    "serve_slo_ok": cur.get("serve_slo_ok"),
+                   "serve_table_bytes": cur.get("serve_table_bytes"),
+                   "serve_quant_drift": cur.get("serve_quant_drift"),
                    "ckpt_save_ms": cur.get("ckpt_save_ms"),
                    "ckpt_block_ms": cur.get("ckpt_block_ms"),
                    "dtype": args.dtype or cur.get("dtype"),

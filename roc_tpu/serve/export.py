@@ -115,11 +115,14 @@ def build_predictor(model, dataset, config, params=None,
                     backend: str = "auto",
                     buckets: Sequence[int] = SERVE_BUCKETS,
                     cache: Optional[PropagationCache] = None,
+                    quant: str = "off",
                     verbose: bool = False) -> Predictor:
     """Resolve + build a live Predictor.  ``params=None`` initializes
     fresh weights (rig/benchmark use); ``cache`` short-circuits the
     propagation precompute (the artifact loader passes the persisted
-    one — live builds compute it here)."""
+    one — live builds compute it here).  ``quant`` selects the serving
+    table encoding (``serve/quant.py``); the drift GATE lives in
+    :func:`export_predictor` — a live build is ungated rehearsal."""
     import jax
 
     from ..train.trainer import (resolve_config, resolve_symmetric)
@@ -153,28 +156,100 @@ def build_predictor(model, dataset, config, params=None,
                      cache=cache, head_model=head_model, flavor=flavor,
                      dataset=dataset if backend == "full" else None,
                      gctx=gctx, num_classes=_num_classes(model),
-                     verbose=verbose)
+                     quant=quant, verbose=verbose)
 
 
 # ------------------------------------------------------------ artifact
 
+def _quant_ref_logits(pred: Predictor, params, sample) -> np.ndarray:
+    """The fp32 half of the drift gate: fp32 table rows + the
+    UNquantized params through the same head.  Export-time-only
+    program, deliberately outside the audited serve set (the
+    ``_full_logits_host`` precedent)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..train.trainer import cast_floats
+    rows = pred.cache.table[sample]
+    if pred.flavor == "table":
+        return np.asarray(rows, dtype=np.float32)
+    x = jnp.asarray(rows, dtype=pred.compute)
+    out = jax.jit(
+        lambda p, v, g: pred.head_model.apply(
+            cast_floats(p, pred.compute), v, g, key=None, train=False)
+    )(params, x, pred._gctx)
+    # export-time gate fetch, not a request-path sync
+    return np.asarray(jax.device_get(out),  # roc-lint: ok=host-sync-hot-path
+                      dtype=np.float32)
+
+
 def export_predictor(pred: Predictor, out_dir: str,
                      dataset_meta: Optional[Dict[str, Any]] = None,
                      cache_dir: Optional[str] = None,
-                     verify_warm: bool = True) -> Dict[str, Any]:
+                     verify_warm: bool = True,
+                     drift_argmax_min: Optional[float] = None,
+                     drift_dlogit_max: Optional[float] = None
+                     ) -> Dict[str, Any]:
     """Persist ``pred`` as a serving artifact and pre-pay its compile
     wall: params + propagation tables + manifest on disk, every bucket
     program AOT-compiled into the persistent cache.  With
     ``verify_warm`` a second AOT pass asserts every program is now a
     warm hit — the prewarm-parity guarantee the manifest's
-    ``program_keys`` advertise.  Returns the manifest dict."""
+    ``program_keys`` advertise.  Returns the manifest dict.
+
+    A quantized predictor additionally runs the measured accuracy
+    drift gate BEFORE any file is written: argmax agreement + max
+    |Δlogit| vs the fp32 reference on a held-out node sample, with
+    :class:`roc_tpu.serve.quant.QuantDriftError` refusal past the
+    thresholds (CLI-adjustable; defaults in ``serve/quant.py``) —
+    a drifting quantization never becomes an artifact."""
     from ..utils.checkpoint import params_signature
-    os.makedirs(out_dir, exist_ok=True)
-    host_params = _host_params(pred.params)
-    np.savez(os.path.join(out_dir, "params.npz"), **host_params)
-    if pred.cache is not None:
-        pred.cache.save(os.path.join(out_dir, "propagation.npz"))
     import jax.numpy as jnp
+    host_params = _host_params(pred.params)
+    from .quant import QuantSpec
+    qblock: Dict[str, Any] = {"spec": QuantSpec(pred.quant).to_json()}
+    store_params = host_params
+    if pred.quant != "off":
+        from ..train.trainer import compute_dtype_of
+        from .quant import (drift_report, drift_sample,
+                            quantize_params, require_drift_ok,
+                            row_scales, scale_stats)
+        params_orig = pred.params
+        store_params, roundtrip, qkeys = quantize_params(
+            host_params, pred.quant)
+        # the export-time predictor must serve the exact values a
+        # cold load reconstructs: swap in the dequantize∘quantize
+        # round trip (structural fingerprint unchanged)
+        pred.params = {k: jnp.asarray(v)
+                       for k, v in roundtrip.items()}
+        sample = drift_sample(pred.num_nodes)
+        drift = drift_report(
+            _quant_ref_logits(pred, params_orig, sample),
+            pred.query(sample),
+            **{k: v for k, v in
+               (("argmax_min", drift_argmax_min),
+                ("dlogit_max", drift_dlogit_max)) if v is not None})
+        qblock["drift"] = drift
+        qblock["params"] = {"quantized": qkeys,
+                            "scale_suffix": "::scale"}
+        qblock["scale_stats"] = [scale_stats(row_scales(s, pred.quant))
+                                 for s in pred.cache.stages]
+        require_drift_ok(drift, f"export to {out_dir}")
+    if pred.cache is not None:
+        from .quant import table_bytes
+        shapes = [s.shape for s in pred.cache.stages]
+        b_fp32 = sum(table_bytes(s, "off") for s in shapes)
+        b_mode = sum(table_bytes(s, pred.quant) for s in shapes)
+        qblock["table"] = {
+            "stages": len(shapes),
+            "bytes_fp32": int(b_fp32),
+            "bytes": int(b_mode),
+            "shrink": round(b_fp32 / max(b_mode, 1), 2)}
+    os.makedirs(out_dir, exist_ok=True)
+    np.savez(os.path.join(out_dir, "params.npz"), **store_params)
+    if pred.cache is not None:
+        pred.cache.save(os.path.join(out_dir, "propagation.npz"),
+                        quant=pred.quant)
     cfg = pred.config
     manifest: Dict[str, Any] = {
         "version": MANIFEST_VERSION,
@@ -206,6 +281,7 @@ def export_predictor(pred: Predictor, out_dir: str,
         "dataset": dict(dataset_meta or {}),
         "num_nodes": pred.num_nodes,
         "program_keys": pred.program_keys(),
+        "quant": qblock,
         "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
     }
     warm = pred.warm(cache_dir=cache_dir, name="serve_export")
@@ -247,7 +323,8 @@ def export_trainer(trainer, dataset, out_dir: str,
                    backend: str = "auto",
                    buckets: Sequence[int] = SERVE_BUCKETS,
                    cache_dir: Optional[str] = None,
-                   verify_warm: bool = True) -> Dict[str, Any]:
+                   verify_warm: bool = True,
+                   quant: str = "off") -> Dict[str, Any]:
     """Export a LIVE trainer's weights as a serving artifact — works
     for both ``Trainer`` and ``DistributedTrainer`` (replicated params
     fetch identically); the trainer's model/config are already
@@ -255,7 +332,8 @@ def export_trainer(trainer, dataset, out_dir: str,
     records exactly what trained."""
     pred = build_predictor(
         trainer.model, dataset, trainer.config,
-        params=trainer.params, backend=backend, buckets=buckets)
+        params=trainer.params, backend=backend, buckets=buckets,
+        quant=quant)
     meta = {"V": int(dataset.graph.num_nodes),
             "E": int(dataset.graph.num_edges),
             "name": getattr(dataset, "name", None)}
@@ -296,9 +374,18 @@ def load_predictor(artifact_dir: str, dataset=None,
         bdense_min_fill=mc["bdense_min_fill"],
         bdense_a_budget=mc["bdense_a_budget"],
         bdense_group=mc["bdense_group"])
+    qmode = ((manifest.get("quant") or {}).get("spec")
+             or {}).get("mode", "off")
     with np.load(os.path.join(artifact_dir, "params.npz")) as z:
-        params = {k: jnp.asarray(z[k], dtype=config.dtype)
-                  for k in z.files}
+        raw = {k: np.asarray(z[k]) for k in z.files}
+    if qmode != "off":
+        # storage-byte views + ::scale companions → fp32, then cast
+        # like any params load; the fingerprint is structural, so the
+        # reconstructed tree hashes identically to the exported one
+        from .quant import dequantize_params
+        raw = dequantize_params(raw, qmode)
+    params = {k: jnp.asarray(v, dtype=config.dtype)
+              for k, v in raw.items()}
     sig = params_signature(params)
     want = (manifest.get("fingerprint") or {}).get("params_sig")
     if want and sig != want:
@@ -338,7 +425,7 @@ def load_predictor(artifact_dir: str, dataset=None,
                      dataset=dataset if backend == "full" else None,
                      gctx=gctx,
                      num_classes=manifest.get("num_classes"),
-                     verbose=verbose)
+                     quant=qmode, verbose=verbose)
     live = pred.program_keys()
     if sorted(manifest.get("program_keys") or []) != live:
         raise ValueError(
@@ -391,6 +478,19 @@ def parse_args(argv: Optional[List[str]] = None):
     ap.add_argument("--impl", default="auto")
     ap.add_argument("--fuse", default="auto",
                     choices=["auto", "on", "off"])
+    ap.add_argument("--quantize", default="off",
+                    choices=["off", "int8", "fp8"],
+                    help="serving-table/params quantization "
+                         "(symmetric per-row, scales alongside; int8 "
+                         "is the portable floor, fp8-e4m3 where jax "
+                         "supports it).  Export runs the accuracy "
+                         "drift gate and REFUSES past the thresholds")
+    ap.add_argument("--drift-argmax-min", type=float, default=None,
+                    help="drift gate: minimum argmax agreement vs the "
+                         "fp32 reference (default in serve/quant.py)")
+    ap.add_argument("--drift-dlogit-max", type=float, default=None,
+                    help="drift gate: maximum |Δlogit| vs the fp32 "
+                         "reference (default in serve/quant.py)")
     ap.add_argument("--cache-dir", default=None,
                     help="persistent compile cache dir (default: "
                          "$ROC_TPU_CACHE_DIR or ~/.cache/roc_tpu/xla)")
@@ -466,19 +566,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                else tuple(int(b) for b in args.buckets.split(",")))
     pred = build_predictor(model, ds, config, params=params,
                            backend=args.backend, buckets=buckets,
-                           verbose=args.verbose)
+                           quant=args.quantize, verbose=args.verbose)
     meta = {"V": int(ds.graph.num_nodes),
             "E": int(ds.graph.num_edges),
             "name": getattr(ds, "name", None),
             "prefix": args.file}
     manifest = export_predictor(pred, args.out, dataset_meta=meta,
                                 cache_dir=args.cache_dir,
-                                verify_warm=not args.no_verify_warm)
+                                verify_warm=not args.no_verify_warm,
+                                drift_argmax_min=args.drift_argmax_min,
+                                drift_dlogit_max=args.drift_dlogit_max)
     print(json.dumps({
         "artifact": args.out, "backend": manifest["backend"],
         "flavor": manifest["flavor"],
         "programs": len(manifest["program_keys"]),
         "buckets": manifest["buckets"],
+        "quant": manifest["quant"],
         "prewarm": manifest["prewarm"]}))
     return 0
 

@@ -50,6 +50,16 @@ class TableVersion(NamedTuple):
     dispatch under this version gathers from (the propagation table
     for the precomputed backend, the feature matrix for full-graph).
 
+    The version carries its OWN quantization spec: ``qmode`` says how
+    ``table``'s rows are encoded (``"off"`` → fp32/compute-dtype
+    values, ``scale`` is None; ``"int8"``/``"fp8"`` → ``table`` holds
+    the quantized codes and ``scale`` the per-row fp32 scales the
+    serve program dequantizes with in-register).  Dispatch selects the
+    PROGRAM by the captured version's qmode, so a mid-rollout
+    fp32→int8 swap (:meth:`Predictor.publish_quant`) is bit-exact per
+    captured version — the model checker's ``quant-spec-pinned``
+    invariant, live on the wire as the ``res.qmode`` field.
+
     Publishing a new version NEVER mutates the previous one: the new
     buffer is the old one with exactly the affected rows rewritten
     (``.at[rows].set`` — copy-on-write at the device boundary), so a
@@ -59,6 +69,8 @@ class TableVersion(NamedTuple):
     stress over a live ``add_edges`` publish)."""
     version: int
     table: Any
+    scale: Any = None
+    qmode: str = "off"
 
 # Quantized microbatch sizes — the ONLY ids shapes a server ever
 # dispatches.  Quantization is what keeps the serve program set finite
@@ -94,6 +106,7 @@ class Predictor:
                  head_model=None, flavor: Optional[str] = None,
                  dataset=None, gctx=None,
                  num_classes: Optional[int] = None,
+                 quant: str = "off",
                  verbose: bool = False):
         import jax.numpy as jnp
 
@@ -110,19 +123,21 @@ class Predictor:
         self.cache = cache
         self.head_model = head_model
         self.verbose = verbose
-        self._jits: Dict[int, Any] = {}
+        from .quant import check_mode
+        self.quant = check_mode(quant)
+        if self.quant != "off" and backend != "precomputed":
+            raise ValueError(
+                "quantized serving applies to the precomputed table "
+                "backend only (the full-graph path has no table to "
+                "shrink)")
+        self._jits: Dict[Tuple[str, int], Any] = {}
+        self.scale = None
         if backend == "precomputed":
             if cache is None:
                 raise ValueError("precomputed backend needs a "
                                  "PropagationCache")
             self.num_nodes = cache.num_nodes
-            # dummy zero row at index V — padded ids gather zeros
-            # (their logits are sliced off host-side); the table is
-            # device-resident in the COMPUTE dtype, uploaded once
-            t = np.concatenate(
-                [cache.table,
-                 np.zeros((1, cache.table.shape[1]), np.float32)])
-            self.table = jnp.asarray(t, dtype=self.compute)
+            self.table, self.scale = self._device_table(self.quant)
             self.pad_id = self.num_nodes
             self._gctx = self._trivial_gctx()
         elif backend == "full":
@@ -144,11 +159,36 @@ class Predictor:
         # snapshot by reading the one attribute — tuple assignment is
         # atomic, the lock serializes WRITERS against each other)
         self._pub_lock = threading.Lock()
-        self._published = TableVersion(
-            0, self.table if backend == "precomputed" else self.feats)
-        self._build_jits()
+        self._published = (
+            TableVersion(0, self.table, self.scale, self.quant)
+            if backend == "precomputed"
+            else TableVersion(0, self.feats))
+        self._build_jits(self.quant)
 
     # ------------------------------------------------------- programs
+
+    def _device_table(self, mode: str):
+        """Upload the host propagation table under ``mode``: fp32 →
+        the compute dtype; quantized → the ``(codes, scales)`` pair
+        the dequant-in-register program gathers from.  A dummy zero
+        row at index V absorbs padded ids (its logits are sliced off
+        host-side); its scale is 1.0 so padded dequant stays exact
+        zeros.  Also (re)pins the scale-envelope guard the
+        invalidation path re-checks refreshed rows against."""
+        import jax.numpy as jnp
+        if mode == "off":
+            t = np.concatenate(
+                [self.cache.table,
+                 np.zeros((1, self.cache.table.shape[1]), np.float32)])
+            return jnp.asarray(t, dtype=self.compute), None
+        from .quant import SCALE_GUARD_SLACK, quantize_rows
+        q, sc = quantize_rows(self.cache.table, mode)
+        # host numpy scale vector — build-time bookkeeping, no device
+        self._scale_guard = float(sc.max()) * SCALE_GUARD_SLACK  # roc-lint: ok=host-sync-hot-path
+        qpad = np.concatenate(
+            [q, np.zeros((1, q.shape[1]), dtype=q.dtype)])
+        spad = np.concatenate([sc, np.ones(1, np.float32)])
+        return jnp.asarray(qpad), jnp.asarray(spad)
 
     def _trivial_gctx(self):
         """A graph-free context for the dense head: precompute_split
@@ -165,25 +205,43 @@ class Predictor:
             num_rows=1, gathered_rows=1, aggr_impl="segment",
             symmetric=True)
 
-    def _build_jits(self) -> None:
+    def _build_jits(self, mode: str) -> None:
+        """One ObservedJit per (quant mode, bucket).  Modes get
+        DISTINCT program slots (``_q8``/``_qf8`` suffixes) because
+        they are distinct programs with distinct arg avals — the
+        auditor ratchets the quantized set under its own rig
+        (``sgc_serve_q8``) while the fp32 slots stay byte-identical,
+        keeping ``sgc_serve`` at budget delta +0."""
         from ..obs.compile_watch import ObservedJit
         for b in self.buckets:
-            self._jits[b] = ObservedJit(
-                self._serve_step, name=self._slot(b),
+            self._jits[(mode, b)] = ObservedJit(
+                self._serve_step, name=self._slot(b, mode),
                 verbose=self.verbose)
 
-    def _slot(self, bucket: int) -> str:
+    _QSUFFIX = {"off": "", "int8": "_q8", "fp8": "_qf8"}
+
+    def _slot(self, bucket: int, mode: str = "off") -> str:
         tag = (f"precomputed_{self.flavor}"
                if self.backend == "precomputed" else "full")
-        return f"serve_{tag}:{bucket}"
+        return f"serve_{tag}{self._QSUFFIX[mode]}:{bucket}"
 
     def _serve_step(self, *args):
         import jax.numpy as jnp
 
         from ..train.trainer import cast_floats
         if self.backend == "precomputed":
-            params, table, ids, gctx = args
-            x = jnp.take(table, ids, axis=0)
+            if len(args) == 5:
+                # quantized: gather the bucket's code rows + scales
+                # and dequantize IN-REGISTER — [bucket, F] widens to
+                # the compute dtype, the [V, F] table never does (the
+                # dequant-hot-path lint rule holds serve/ to this)
+                params, qtab, qscale, ids, gctx = args
+                x = (jnp.take(qtab, ids, axis=0).astype(self.compute)
+                     * jnp.take(qscale, ids)[:, None]
+                     .astype(self.compute))
+            else:
+                params, table, ids, gctx = args
+                x = jnp.take(table, ids, axis=0)
             if self.flavor == "table":
                 return x
             return self.head_model.apply(
@@ -200,10 +258,15 @@ class Predictor:
         auditor/prewarm keys and the runtime programs cannot drift.
         ``pub`` pins a captured table version (the microbatch server
         captures one per batch); None reads the current publication.
-        Versions only swap the table VALUES, never its shape/dtype,
-        so the program key is version-independent."""
+        Versions only swap the table VALUES, never its shape/dtype —
+        within one qmode the program key is version-independent, and
+        across qmodes the captured version routes to ITS mode's
+        program (the quant-spec-pinned invariant)."""
         if pub is None:
             pub = self._published
+        if pub.qmode != "off":
+            return (self.params, pub.table, pub.scale, ids,
+                    self._gctx)
         return (self.params, pub.table, ids, self._gctx)
 
     def serve_candidates(self) -> List[Any]:
@@ -219,14 +282,20 @@ class Predictor:
 
         from ..analysis.programspace import Candidate
         cands: List[Any] = []
+        quant = self.quant != "off"
+        # the quantized 5-tuple splits the table role into codes +
+        # scales (both version-swapped data planes); ids/gctx keep
+        # their off-mode roles so the replication auditor sees the
+        # same sharing story
+        roles = (("params", "data", "data", "other", "tables")
+                 if quant else ("params", "data", "other", "tables"))
         for b in self.buckets:
             ids = jax.ShapeDtypeStruct((b,), jnp.dtype(jnp.int32))
             args = self._args_for(ids)
-            jit = self._jits[b]._jit
+            jit = self._jits[(self.quant, b)]._jit
             cands.append(Candidate(
-                slot=self._slot(b), fn=jit, args=args, donate=(),
-                observed=False,
-                roles=("params", "data", "other", "tables"),
+                slot=self._slot(b, self.quant), fn=jit, args=args,
+                donate=(), observed=False, roles=roles,
                 aot=lambda j=jit, a=args: j.lower(*a).compile()))
         return cands
 
@@ -261,10 +330,17 @@ class Predictor:
         """One padded-bucket dispatch; returns the device logits
         ``[bucket, C]``.  ``ids_padded`` length must be a bucket."""
         b = int(ids_padded.shape[0])
-        if b not in self._jits:
+        if pub is None:
+            pub = self._published
+        # the program is selected by the CAPTURED version's qmode —
+        # a batch pinned to a fp32 version keeps running the fp32
+        # program even after publish_quant lands int8 (quant-spec-
+        # pinned, bit-exact per captured version)
+        jit = self._jits.get((pub.qmode, b))
+        if jit is None:
             raise ValueError(f"ids length {b} is not a bucket "
                              f"{self.buckets}")
-        return self._jits[b](*self._args_for(ids_padded, pub))
+        return jit(*self._args_for(ids_padded, pub))
 
     def query(self, node_ids,
               pub: Optional[TableVersion] = None) -> np.ndarray:
@@ -333,15 +409,78 @@ class Predictor:
         import jax.numpy as jnp
         if rows.size == 0:
             return None
-        vals = jnp.asarray(
-            self.cache.table[rows].astype(np.float32),
-            dtype=self.compute)
         old = self._published
-        new_table = old.table.at[jnp.asarray(
-            rows.astype(np.int32))].set(vals)
+        idx = jnp.asarray(rows.astype(np.int32))
+        if old.qmode != "off":
+            # requantize ONLY the recomputed rows.  Per-row symmetric
+            # scales are row-local, so these (q, scale) pairs are
+            # bit-identical to quantizing a full rebuild of the
+            # mutated table (tests/test_serve_quant.py pins it) —
+            # incremental invalidation loses nothing to quantization.
+            from .quant import QuantDriftError, quantize_rows
+            q, sc = quantize_rows(self.cache.table[rows], old.qmode)
+            guard = getattr(self, "_scale_guard", None)
+            # host numpy scales (control-plane refresh, not a query)
+            smax = float(sc.max())  # roc-lint: ok=host-sync-hot-path
+            if guard is not None and smax > guard:
+                # the post-invalidation drift re-check: a refreshed
+                # row whose quantization step left the envelope the
+                # export-time gate measured would serve coarser
+                # values than anything validated — refuse BEFORE
+                # publishing; the old version stays live and the
+                # operator re-exports (re-gating) instead
+                raise QuantDriftError(
+                    f"invalidation refused: refreshed row scale "
+                    f"{smax:.6g} exceeds the gated envelope "
+                    f"{guard:.6g} (build max × slack); serving "
+                    f"stays on v{old.version} — re-export to re-run "
+                    f"the drift gate on the mutated graph")
+            new_table = old.table.at[idx].set(jnp.asarray(q))
+            new_scale = old.scale.at[idx].set(jnp.asarray(sc))
+            self.table, self.scale = new_table, new_scale
+            self._published = TableVersion(
+                old.version + 1, new_table, new_scale, old.qmode)
+            return old.version + 1
+        vals = jnp.asarray(
+            self.cache.table[rows].astype(np.float32),  # roc-lint: ok=dequant-hot-path
+            dtype=self.compute)
+        new_table = old.table.at[idx].set(vals)
         self.table = new_table
-        self._published = TableVersion(old.version + 1, new_table)
+        self._published = TableVersion(
+            old.version + 1, new_table, None, "off")
         return old.version + 1
+
+    def publish_quant(self, mode: str) -> int:
+        """Control-plane re-publication of the CURRENT host table
+        under a new quant spec — the mid-rollout fp32→int8 (or back)
+        swap.  The target mode's bucket programs are built before the
+        publish so the hot path never constructs programs; the swap
+        itself is one versioned publish, and in-flight batches pinned
+        to the previous version finish on ITS mode's program against
+        ITS buffers (quant-spec-pinned — the model checker's
+        ``live-qmode`` seed shows what skipping the pin would serve).
+        Returns the published version."""
+        from .quant import check_mode
+        if self.backend != "precomputed" or self.cache is None:
+            raise NotImplementedError(
+                "quant swaps apply to the precomputed table backend")
+        mode = check_mode(mode)
+        if (mode, self.buckets[0]) not in self._jits:
+            self._build_jits(mode)
+        with self._pub_lock:
+            old = self._published
+            table, scale = self._device_table(mode)
+            self.table, self.scale = table, scale
+            self.quant = mode
+            version = old.version + 1
+            self._published = TableVersion(version, table, scale,
+                                           mode)
+        emit("serve", f"table version {version} published "
+             f"(quant swap {old.qmode}->{mode}; in-flight queries "
+             f"finish on v{old.version}:{old.qmode})", console=False,
+             kind="table_publish", version=version, rows=0,
+             qmode=mode)
+        return version
 
     def _emit_publish(self, version: Optional[int],
                       rows: np.ndarray) -> None:

@@ -86,6 +86,10 @@ class PropagationCache:
         # counter lets artifacts/stats say which host-table mutation
         # generation a publish came from.
         self.version = 0
+        # quant mode of the artifact this cache was loaded from (None
+        # for built/fp32-loaded caches) — load_predictor reads it to
+        # reconstruct the device table under the exported spec
+        self.loaded_quant: Optional[str] = None
 
     # ------------------------------------------------------------ build
 
@@ -222,7 +226,13 @@ class PropagationCache:
 
     # ------------------------------------------------------ persistence
 
-    def save(self, path: str) -> None:
+    def save(self, path: str, quant: str = "off") -> None:
+        """Persist the cache; ``quant`` in ``("int8", "fp8")`` stores
+        the stage tables quantized (``stage_{i}_q`` storage-byte views
+        + ``stage_{i}_scale``, spec in the ``quant`` blob) — the ≥3×
+        stage-bytes shrink on disk.  ``x0`` stays fp32 either way: it
+        is the chain's seed and quantizing it would compound error
+        through every stage, for a fraction of the total bytes."""
         import json
         import os
         import tempfile
@@ -231,8 +241,20 @@ class PropagationCache:
             "x0": self.x0,
             "ops": np.frombuffer(json.dumps(self.ops).encode(),
                                  dtype=np.uint8).copy()}
-        for i, s in enumerate(self.stages):
-            data[f"stage_{i}"] = s
+        if quant != "off":
+            from .quant import (QuantSpec, check_mode, quantize_rows,
+                                to_storage_bytes)
+            check_mode(quant)
+            for i, s in enumerate(self.stages):
+                q, sc = quantize_rows(s, quant)
+                data[f"stage_{i}_q"] = to_storage_bytes(q)
+                data[f"stage_{i}_scale"] = sc
+            data["quant"] = np.frombuffer(
+                json.dumps(QuantSpec(quant).to_json()).encode(),
+                dtype=np.uint8).copy()
+        else:
+            for i, s in enumerate(self.stages):
+                data[f"stage_{i}"] = s
         d = os.path.dirname(os.path.abspath(path)) or "."
         fd, tmp = tempfile.mkstemp(dir=d, suffix=".npz.tmp")
         try:
@@ -245,9 +267,29 @@ class PropagationCache:
 
     @classmethod
     def load(cls, path: str) -> "PropagationCache":
+        """Rebuild from disk.  Quantized artifacts dequantize into the
+        usual fp32 host stages (invalidation math stays exact and
+        mode-blind); ``loaded_quant`` records the artifact's mode so
+        ``load_predictor`` re-quantizes the DEVICE table under the same
+        spec — by the round-trip identity that reproduces the exported
+        ``(q, scale)`` bit-for-bit."""
         import json
         with np.load(path) as z:
             ops = json.loads(bytes(np.asarray(z["ops"])).decode())
+            if "quant" in z.files:
+                from .quant import (QuantSpec, dequantize_rows,
+                                    from_storage_bytes)
+                spec = QuantSpec.from_json(json.loads(
+                    bytes(np.asarray(z["quant"])).decode()))
+                n = sum(1 for k in z.files
+                        if k.startswith("stage_") and k.endswith("_q"))
+                stages = [dequantize_rows(
+                    from_storage_bytes(z[f"stage_{i}_q"], spec.mode),
+                    z[f"stage_{i}_scale"]) for i in range(n)]
+                out = cls(z["row_ptr"], z["col_idx"], ops, z["x0"],
+                          stages)
+                out.loaded_quant = spec.mode
+                return out
             stages = [z[f"stage_{i}"]
                       for i in range(sum(1 for k in z.files
                                          if k.startswith("stage_")))]
@@ -261,7 +303,9 @@ def logits_table_cache(table: np.ndarray) -> PropagationCache:
     the MLP and the frozen forward itself is the cacheable object) in
     the same container.  No stages, no graph — :meth:`add_edges`
     refuses with the re-export message."""
-    t = np.asarray(table, dtype=np.float32)
+    # export-time host build of the cache container, not the serve
+    # hot path (quantization happens at device upload)
+    t = np.asarray(table, dtype=np.float32)  # roc-lint: ok=dequant-hot-path
     V = t.shape[0]
     return PropagationCache(
         np.zeros(V + 1, dtype=np.int64), np.zeros(0, dtype=np.int32),

@@ -1,0 +1,345 @@
+"""Quantized serving tables: symmetric per-row int8/fp8 with scales.
+
+The serving money is in table HBM — every replica of the precomputed
+backend carries a full fp32 ``[V, F]`` propagation table, which caps
+graph size at one replica's memory.  This module is the quantization
+layer the whole serve tier shares:
+
+- **Scheme**: symmetric per-row.  ``scale[r] = amax(|x[r]|) / Q`` and
+  ``q[r] = clip(rint(x[r] / scale[r]), -Q, Q)`` with ``Q = 127`` for
+  int8 (fp8-e4m3 stores the scaled row directly; its ``Q`` is the
+  format's finite max, 448).  Per-row beats per-tensor on propagation
+  tables because hub rows after ``S^k`` aggregation have orders of
+  magnitude more mass than leaves — one shared scale would crush the
+  leaves to zero.
+- **Round-trip identity** (the property cold start leans on): the max
+  element of a row maps to exactly ±Q, so re-deriving the scale from
+  the DEquantized row reproduces the original scale to ~1 ulp and
+  ``rint`` recovers every ``q`` exactly.  Hence
+  ``quantize(dequantize(quantize(x))) == quantize(x)`` bit-for-bit —
+  an artifact that persists ``(q, scale)`` can rebuild the exact
+  device table with no fp32 master copy and ZERO new compiles
+  (tests/test_serve_quant.py pins this).
+- **Dequant-in-register**: the serve matmul gathers int8 rows and
+  multiplies by the gathered scales inside the jitted program
+  (``Predictor._serve_step``) — the full fp32 table is NEVER
+  materialized on device (the ``dequant-hot-path`` roc-lint rule
+  makes that a machine-checked invariant of ``roc_tpu/serve/``).
+- **Drift gate**: quantization is lossy, so export measures argmax
+  agreement and max |Δlogit| against the fp32 reference on a held-out
+  node sample and REFUSES (:class:`QuantDriftError`) past the
+  thresholds — loudly, the way fingerprint mismatches already refuse.
+  After an ``add_edges`` invalidation the refreshed rows re-check
+  against the scale envelope recorded at build (quantization error is
+  bounded by ``scale/2`` per element, so a row whose dynamic range
+  exploded is caught BEFORE its version publishes).
+
+int8 is the portable floor; fp8-e4m3 rides where the jax/ml_dtypes
+pair supports it (:func:`fp8_supported`) and persists as a uint8 byte
+view because ``np.load`` cannot round-trip the ml_dtypes dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+QMODES = ("off", "int8", "fp8")
+
+INT8_QMAX = 127.0
+FP8_QMAX = 448.0          # float8_e4m3fn finite max
+
+# drift-gate defaults: the export CLI/--quantize arm overrides them.
+# The Δlogit bound is RELATIVE to the reference logit magnitude
+# (max |Δ| / max(1, max |ref|)) — an absolute bound would bite or
+# slumber depending on the head's output scale; per-row int8 lands at
+# ~0.5-0.8% relative on the rig configs, so 2% is a real gate with
+# real headroom, at any logit scale
+DRIFT_ARGMAX_MIN = 0.99   # fraction of sampled nodes with equal argmax
+DRIFT_DLOGIT_MAX = 0.02   # relative max |q_logit - fp32_logit|
+DRIFT_SAMPLE = 512        # held-out node sample size (deterministic)
+
+# scale-envelope slack for post-invalidation re-checks: a refreshed
+# row may legitimately grow (new edges add mass), but a row whose
+# quantization step jumps past ``envelope * slack`` serves visibly
+# coarser values than anything the export-time drift gate measured
+SCALE_GUARD_SLACK = 4.0
+
+
+class QuantDriftError(RuntimeError):
+    """Quantized serving would drift past the gate — export refuses to
+    write the artifact; invalidation refuses to publish the version."""
+
+
+class QuantSpec(NamedTuple):
+    """The serialized quantization contract an artifact carries."""
+    mode: str                     # "off" | "int8" | "fp8"
+    scheme: str = "symmetric-per-row"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"mode": self.mode, "scheme": self.scheme}
+
+    @classmethod
+    def from_json(cls, d: Optional[Dict[str, Any]]) -> "QuantSpec":
+        if not d:
+            return cls("off")
+        return cls(str(d.get("mode", "off")),
+                   str(d.get("scheme", "symmetric-per-row")))
+
+
+def check_mode(mode: str) -> str:
+    if mode not in QMODES:
+        raise ValueError(f"unknown quant mode {mode!r}; have {QMODES}")
+    if mode == "fp8" and not fp8_supported():
+        raise ValueError(
+            "quant mode 'fp8' needs jax.numpy.float8_e4m3fn + "
+            "ml_dtypes — unavailable in this environment; int8 is "
+            "the portable floor")
+    return mode
+
+
+def fp8_supported() -> bool:
+    """fp8-e4m3 availability: the jnp dtype AND the ml_dtypes numpy
+    side (persistence + host dequant) must both exist."""
+    try:
+        import jax.numpy as jnp
+        import ml_dtypes
+        return hasattr(jnp, "float8_e4m3fn") \
+            and hasattr(ml_dtypes, "float8_e4m3fn")
+    except Exception:
+        return False
+
+
+def _fp8_np_dtype():
+    import ml_dtypes
+    return np.dtype(ml_dtypes.float8_e4m3fn)
+
+
+def storage_dtype(mode: str):
+    """The on-disk / on-device storage dtype of one quantized table."""
+    if mode == "int8":
+        return np.dtype(np.int8)
+    if mode == "fp8":
+        return _fp8_np_dtype()
+    raise ValueError(f"no storage dtype for quant mode {mode!r}")
+
+
+def qmax_of(mode: str) -> float:
+    return INT8_QMAX if mode == "int8" else FP8_QMAX
+
+
+# -------------------------------------------------------- core codec
+
+def row_scales(x: np.ndarray, mode: str) -> np.ndarray:
+    """fp32 ``[V]`` per-row scales; all-zero rows get scale 1.0 so the
+    codec never divides by zero (their q rows are exactly zero)."""
+    amax = np.max(np.abs(np.asarray(x, dtype=np.float32)), axis=1)
+    scale = amax / qmax_of(mode)
+    scale[scale == 0.0] = 1.0
+    return scale.astype(np.float32)
+
+
+def quantize_rows(x: np.ndarray, mode: str,
+                  scale: Optional[np.ndarray] = None
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """``(q, scale)`` for an fp32 ``[V, F]`` table.  ``scale`` may be
+    supplied to re-encode under a pinned envelope (refresh paths pass
+    None and re-derive — the round-trip identity needs the derived
+    scale)."""
+    x = np.asarray(x, dtype=np.float32)
+    if x.ndim != 2:
+        raise ValueError(f"quantize_rows wants [V, F], got {x.shape}")
+    if scale is None:
+        scale = row_scales(x, mode)
+    scaled = x / scale[:, None]
+    if mode == "int8":
+        q = np.clip(np.rint(scaled), -INT8_QMAX,
+                    INT8_QMAX).astype(np.int8)
+    elif mode == "fp8":
+        q = scaled.astype(_fp8_np_dtype())
+    else:
+        raise ValueError(f"cannot quantize to mode {mode!r}")
+    return q, scale
+
+
+def dequantize_rows(q: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """Host-side fp32 reconstruction (build/persistence paths only —
+    the device hot path dequantizes gathered rows in-register)."""
+    return (np.asarray(q, dtype=np.float32)
+            * np.asarray(scale, dtype=np.float32)[:, None])
+
+
+# ---------------------------------------------------- persistence aid
+
+def to_storage_bytes(q: np.ndarray) -> np.ndarray:
+    """npz-safe view of a quantized payload: fp8 goes through uint8
+    (``np.load`` reads ml_dtypes arrays back as void); int8 is already
+    npz-native but takes the same path for one load-side rule."""
+    return q.view(np.uint8)
+
+
+def from_storage_bytes(raw: np.ndarray, mode: str) -> np.ndarray:
+    return np.asarray(raw, dtype=np.uint8).view(storage_dtype(mode))
+
+
+# ----------------------------------------------------------- params
+
+PARAMS_SCALE_SUFFIX = "::scale"
+
+
+def quantize_params(host_params: Dict[str, np.ndarray], mode: str
+                    ) -> Tuple[Dict[str, np.ndarray],
+                               Dict[str, np.ndarray], List[str]]:
+    """Per-row quantization of the exportable param dict: every ≥2-D
+    float leaf quantizes along its leading axis (weights; a companion
+    ``<key>::scale`` entry carries the scales), everything else —
+    biases, 1-D norms, integer leaves — stays verbatim.  Returns
+    ``(store, roundtrip, quantized_keys)``: ``store`` is what
+    ``params.npz`` persists, ``roundtrip`` the dequantized params the
+    EXPORT-TIME predictor must serve with so export and cold load are
+    value-identical (the fingerprint is structural — shapes/dtypes —
+    and both sides keep the original structure)."""
+    store: Dict[str, np.ndarray] = {}
+    roundtrip: Dict[str, np.ndarray] = {}
+    qkeys: List[str] = []
+    for k, v in host_params.items():
+        v = np.asarray(v)
+        if v.ndim >= 2 and np.issubdtype(v.dtype, np.floating):
+            mat = v.reshape(v.shape[0], -1).astype(np.float32)
+            q, sc = quantize_rows(mat, mode)
+            store[k] = to_storage_bytes(q).reshape(v.shape)
+            store[k + PARAMS_SCALE_SUFFIX] = sc
+            roundtrip[k] = dequantize_rows(q, sc) \
+                .reshape(v.shape).astype(v.dtype)
+            qkeys.append(k)
+        else:
+            store[k] = v
+            roundtrip[k] = v
+    return store, roundtrip, qkeys
+
+
+def dequantize_params(raw: Dict[str, np.ndarray], mode: str
+                      ) -> Dict[str, np.ndarray]:
+    """Inverse of :func:`quantize_params` for a loaded ``params.npz``
+    dict (storage-byte views + ``::scale`` companions → fp32)."""
+    out: Dict[str, np.ndarray] = {}
+    for k, v in raw.items():
+        if k.endswith(PARAMS_SCALE_SUFFIX):
+            continue
+        sk = k + PARAMS_SCALE_SUFFIX
+        if sk in raw:
+            q = from_storage_bytes(
+                np.asarray(v).reshape(v.shape[0], -1), mode)
+            out[k] = dequantize_rows(q, raw[sk]) \
+                .reshape(v.shape).astype(np.float32)
+        else:
+            out[k] = v
+    return out
+
+
+# ------------------------------------------------------- measurement
+
+def table_bytes(shape: Tuple[int, int], mode: str) -> int:
+    """Device/disk bytes of ONE [V, F] table under ``mode`` (quantized
+    modes carry their fp32 per-row scale vector)."""
+    v, f = int(shape[0]), int(shape[1])
+    if mode == "off":
+        return v * f * 4
+    return v * f * storage_dtype(mode).itemsize + v * 4
+
+
+def scale_stats(scale: np.ndarray) -> Dict[str, float]:
+    # host numpy on export-time scale vectors — no device round trip
+    s = np.asarray(scale, dtype=np.float64)
+    return {"min": round(float(s.min()), 8),  # roc-lint: ok=host-sync-hot-path
+            "max": round(float(s.max()), 8),  # roc-lint: ok=host-sync-hot-path
+            "mean": round(float(s.mean()), 8)}  # roc-lint: ok=host-sync-hot-path
+
+
+def drift_report(ref_logits: np.ndarray, q_logits: np.ndarray,
+                 argmax_min: float = DRIFT_ARGMAX_MIN,
+                 dlogit_max: float = DRIFT_DLOGIT_MAX
+                 ) -> Dict[str, Any]:
+    """Measured accuracy drift of the quantized path vs the fp32
+    reference on one node sample: argmax agreement + max |Δlogit|,
+    with the pass/fail verdict against the thresholds."""
+    ref = np.asarray(ref_logits, dtype=np.float32)
+    got = np.asarray(q_logits, dtype=np.float32)
+    if ref.shape != got.shape:
+        raise ValueError(f"drift shapes differ: {ref.shape} vs "
+                         f"{got.shape}")
+    # host numpy over the already-fetched gate sample — export-time
+    # measurement, not a request-path sync
+    n = max(ref.shape[0], 1)
+    agree, dmax, refmax = 1.0, 0.0, 0.0
+    if ref.size:
+        eq = ref.argmax(axis=1) == got.argmax(axis=1)
+        agree = float(np.mean(eq))  # roc-lint: ok=host-sync-hot-path
+        dmax = float(np.abs(ref - got).max())  # roc-lint: ok=host-sync-hot-path
+        refmax = float(np.abs(ref).max())  # roc-lint: ok=host-sync-hot-path
+    rel = dmax / max(1.0, refmax)
+    return {"sample": int(n),
+            "argmax_agreement": round(agree, 6),
+            "max_abs_dlogit": round(dmax, 6),
+            "ref_max_logit": round(refmax, 6),
+            "rel_dlogit": round(rel, 6),
+            "argmax_min": argmax_min,
+            "dlogit_max": dlogit_max,
+            "ok": bool(agree >= argmax_min and rel <= dlogit_max)}
+
+
+def require_drift_ok(report: Dict[str, Any], where: str) -> None:
+    """The refusal: a failed gate raises with the full measurement in
+    the message (the fingerprint-mismatch idiom — loud, actionable,
+    and BEFORE any artifact/version becomes visible)."""
+    if not report.get("ok"):
+        raise QuantDriftError(
+            f"{where}: quantization drift gate FAILED — argmax "
+            f"agreement {report['argmax_agreement']} (need >= "
+            f"{report['argmax_min']}), relative max |dlogit| "
+            f"{report['rel_dlogit']} (need <= {report['dlogit_max']}; "
+            f"abs {report['max_abs_dlogit']} on ref magnitude "
+            f"{report['ref_max_logit']}) on {report['sample']} "
+            f"sampled node(s); export/serve fp32 or relax the "
+            f"thresholds deliberately")
+
+
+def drift_sample(num_nodes: int, n: int = DRIFT_SAMPLE,
+                 seed: int = 0) -> np.ndarray:
+    """The held-out node sample, deterministic per (V, n, seed) so
+    export and any later re-check measure the same rows."""
+    rng = np.random.RandomState(seed)
+    n = min(int(n), int(num_nodes))
+    return np.sort(rng.choice(num_nodes, size=n,
+                              replace=False)).astype(np.int32)
+
+
+# ----------------------------------------------------- capture hook
+
+class QuantizingCapture:
+    """A ``stream_prefix_to_host`` capture sink that quantizes each
+    stage table AS IT STREAMS (``core/streaming.py`` hands the sink
+    exclusively-owned arrays, so the fp32 stage can be dropped the
+    moment its ``(q, scale)`` pair is taken): the >RAM export path —
+    host peak holds ONE fp32 stage instead of all k.
+
+    ``keep_fp32_last=True`` additionally retains the final stage in
+    fp32 (the serve table builders want it for the drift reference)."""
+
+    def __init__(self, mode: str, keep_fp32_last: bool = False):
+        self.mode = check_mode(mode)
+        if self.mode == "off":
+            raise ValueError("QuantizingCapture needs a quantized "
+                             "mode; pass a plain list for fp32")
+        self.keep_fp32_last = keep_fp32_last
+        self.stages: list = []          # (q, scale) per stage
+        self.last_fp32: Optional[np.ndarray] = None
+
+    def append(self, x: np.ndarray) -> None:
+        self.stages.append(quantize_rows(x, self.mode))
+        if self.keep_fp32_last:
+            self.last_fp32 = x
+
+    def dequantized(self) -> list:
+        return [dequantize_rows(q, s) for q, s in self.stages]

@@ -122,7 +122,8 @@ def serve_loop(server, wire: _Wire, replica: int,
                 wire.send({"kind": "res", "id": req_id, "ok": True,
                            "rows": rows.tolist(),
                            "version": int(getattr(rows, "version",
-                                                  0))})
+                                                  0)),
+                           "qmode": getattr(rows, "qmode", "off")})
             except BaseException as e:  # noqa: BLE001 - wire it back
                 wire.send(_error_payload(req_id, e))
         return cb
@@ -244,7 +245,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                "num_nodes": int(pred.num_nodes),
                "num_classes": pred.num_classes,
                "buckets": list(pred.buckets),
-               "backend": pred.backend, "shard": shard})
+               "backend": pred.backend, "shard": shard,
+               "quant": pred.quant})
     serve_loop(server, wire, args.replica,
                drain_timeout_s=args.drain_timeout)
     return 0

@@ -100,19 +100,25 @@ class ServeResult(np.ndarray):
     existing consumer keeps treating results as plain arrays.  The
     trace stamps ride along: ``queue_ms`` (admission → dispatch
     start) and ``device_ms`` (the microbatch's device wall) decompose
-    the request's server-side latency."""
+    the request's server-side latency.  ``qmode`` is the captured
+    version's quantization spec (the wire's ``res.qmode`` field reads
+    it) — during a mid-rollout quant swap it says which encoding
+    actually answered."""
     version: int = 0
     queue_ms: Optional[float] = None
     device_ms: Optional[float] = None
+    qmode: str = "off"
 
 
 def _result(rows: np.ndarray, version: int,
             queue_ms: Optional[float] = None,
-            device_ms: Optional[float] = None) -> ServeResult:
+            device_ms: Optional[float] = None,
+            qmode: str = "off") -> ServeResult:
     out = rows.view(ServeResult)
     out.version = int(version)
     out.queue_ms = queue_ms
     out.device_ms = device_ms
+    out.qmode = qmode
     return out
 
 
@@ -485,7 +491,8 @@ class Server:
                 r.fut.set_result(
                     _result(rows[lo:lo + r.ids.size], pub.version,
                             queue_ms=round(qms, 3),
-                            device_ms=round(ms, 3)))
+                            device_ms=round(ms, 3),
+                            qmode=pub.qmode))
             lo += r.ids.size
 
     def _flush_spans(self, final: bool = False) -> None:

@@ -77,6 +77,16 @@ python benchmarks/micro_serve.py --cpu --queries 100 --drill \
 #     the serve stage's headline numbers.
 python benchmarks/micro_serve.py --slo-smoke --cpu \
   --queries 100 --nodes 2000 > /dev/null || exit 1
+#     quantized-serving smoke (PR 19): export the precomputed backend
+#     at int8 — the measured drift gate must pass (argmax agreement +
+#     relative max |Δlogit| vs the fp32 reference; export REFUSES
+#     past threshold) — then cold-load the artifact and drive a
+#     100-query load gen whose served answers must match the gated
+#     values bit-exactly.  Gate ENFORCED: a quantization that drifts,
+#     or a cold load that serves different values than were gated,
+#     must not reach the chip stages.
+python benchmarks/micro_serve.py --quant-smoke --cpu \
+  --queries 100 --nodes 2000 > /dev/null || exit 1
 # 1. staged headline refresh (regression guard before the new rows;
 #    now includes the serve stage — serve_p50_ms/p99/qps land in the
 #    headline line and the sentinel trajectory)

@@ -58,6 +58,13 @@ python -m roc_tpu.sentinel --json
 # (set -e makes the nonzero exit fatal)
 python benchmarks/micro_serve.py --slo-smoke --cpu \
     --queries 100 --nodes 2000 > /dev/null
+# quantized-serving drift-gate preflight (PR 19): export int8 (the
+# measured drift gate must pass — export refuses past threshold),
+# cold-load, 100-query load gen, served answers bit-equal to the
+# gated values — a drifting quantization must not reach chip time
+# (set -e makes the nonzero exit fatal)
+python benchmarks/micro_serve.py --quant-smoke --cpu \
+    --queries 100 --nodes 2000 > /dev/null
 exec python -m roc_tpu.train.cli \
     -lr "$LR" -decay "$WD" -decay-rate "$DR" -dropout "$DROP" \
     -layers "$LAYERS" -e "$EPOCHS" -file dataset/reddit-dgl "$@"

@@ -100,6 +100,34 @@ def test_sync_h2d_in_loop_fires(tmp_path):
     assert all(f.unit == "roc_tpu/core/streaming.py" for f in got)
 
 
+def test_dequant_hot_path_fires(tmp_path):
+    """PR-19 rule: a float32 materialization of a tableish value
+    inside roc_tpu/serve/ (astype, asarray(dtype=), or a float32()
+    cast) is a finding — the dequantize must stay fused in-register
+    — while the pragma'd sanctioned site and non-table values stay
+    quiet, and serve-external code is not this rule's business."""
+    _plant(tmp_path, "roc_tpu/serve/hot.py",
+           "import jax.numpy as jnp\n"
+           "import numpy as np\n"
+           "def f(q_table, stage0, ids, x):\n"
+           "    a = q_table.astype(jnp.float32)\n"
+           "    b = np.asarray(stage0, dtype=np.float32)\n"
+           "    c = jnp.float32(q_table)\n"
+           "    d = x.astype(jnp.float32)\n"          # not tableish
+           "    # export-time: roc-lint: ok=dequant-hot-path\n"
+           "    e = q_table.astype(jnp.float32)\n"
+           "    return a, b, c, d, e\n")
+    _plant(tmp_path, "roc_tpu/core/cold.py",
+           "import numpy as np\n"
+           "def f(table):\n"
+           "    return np.asarray(table, dtype=np.float32)\n")
+    got = run_ast_lint(str(tmp_path), select=["dequant-hot-path"])
+    assert [(f.rule, f.line) for f in got] == \
+        [("dequant-hot-path", 4), ("dequant-hot-path", 5),
+         ("dequant-hot-path", 6)]
+    assert all(f.unit == "roc_tpu/serve/hot.py" for f in got)
+
+
 def test_bare_jit_fires_and_observed_form_allowed(tmp_path):
     _plant(tmp_path, "roc_tpu/train/steps.py",
            "import jax\n"

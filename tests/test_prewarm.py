@@ -75,7 +75,7 @@ def test_prewarm_cli_reports_and_state(warmed):
     assert warmed["elapsed"] < 90.0
     reps = warmed["reports"]
     assert set(reps) == {"gin_flat8", "sgc_stream", "sgc_serve",
-                         "gin_mesh2d"}
+                         "sgc_serve_q8", "gin_mesh2d"}
     for name, rep in reps.items():
         assert rep["programs"] > 0
         assert rep["compile_cold"] == rep["programs"], name
@@ -83,7 +83,7 @@ def test_prewarm_cli_reports_and_state(warmed):
         assert rep["failed"] == 0
     state = json.load(open(warmed["state"]))
     assert set(state) == {"gin_flat8", "sgc_stream", "sgc_serve",
-                          "gin_mesh2d"}
+                          "sgc_serve_q8", "gin_mesh2d"}
     for name in state:
         assert state[name]["programs"] == reps[name]["programs"]
         assert len(state[name]["keys"]) == reps[name]["programs"]

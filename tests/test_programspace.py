@@ -625,7 +625,7 @@ def test_cli_strict_fails_on_budget_slack(tmp_path):
     assert r3.returncode == 0, r3.stdout + r3.stderr
     assert json.loads(bp.read_text())["program_budget"] == \
         {"gin_flat8": 2, "sgc_stream": 6, "sgc_serve": 4,
-         "gin_mesh2d": 2}
+         "sgc_serve_q8": 4, "gin_mesh2d": 2}
 
 
 def test_cli_json_reports_program_space():
@@ -645,7 +645,7 @@ def test_cli_json_reports_program_space():
     assert payload["summary"]["new"] == 0
     reports = {p["config"]: p for p in payload["program_space"]}
     assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve",
-                            "gin_mesh2d"}
+                            "sgc_serve_q8", "gin_mesh2d"}
     for rep in reports.values():
         assert rep["programs"] == len(rep["keys"])
         assert rep["budget"] is not None

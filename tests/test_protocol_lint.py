@@ -269,6 +269,21 @@ def test_seeded_swap_mid_query_bites():
     assert bad == {"single-version-batch"}, rep.violations
 
 
+def test_seeded_live_qmode_bites():
+    """Selecting the dequant program by the LIVE published version's
+    quant spec instead of the captured one (the PR-19 seeded bug —
+    the mid-rollout fp32→int8 window) violates quant-spec-pinned and
+    ONLY that: the captured rows themselves are still consistent, so
+    single-version-batch must stay green."""
+    rep = run_model("table-swap", seed="live-qmode")
+    bad = {v["invariant"] for v in rep.violations}
+    assert bad == {"quant-spec-pinned"}, rep.violations
+    # the original swap bug is unchanged by the quant extension
+    rep2 = run_model("table-swap", seed=SEEDS["table-swap"])
+    assert {v["invariant"] for v in rep2.violations} == \
+        {"single-version-batch"}, rep2.violations
+
+
 def test_modelcheck_findings_carry_schedule_and_budget(tmp_path):
     """A violation report becomes a modelcheck-invariant finding
     carrying the counterexample schedule; an exhausted budget is

@@ -219,6 +219,39 @@ def test_ckpt_columns_gate_and_load(tmp_path):
     assert ok["ok"], ok
 
 
+def test_serve_quant_columns_bite(tmp_path):
+    """PR-19 satellite: the quantized-serving pair gates the
+    trajectory — a synthetic bad round (table bytes back at fp32
+    size → the shrink was lost; drift past the gate's floor) bites
+    lower-better on BOTH columns, healthy jitter passes, and
+    load_bench_round reads the columns back like serve_p50_ms."""
+    from roc_tpu.obs.sentinel import load_bench_round
+    doc = {"parsed": {"value": 100.0, "unit": "ms",
+                      "serve_table_bytes": 5280000.0,
+                      "serve_quant_drift": 0.011}}
+    p = tmp_path / "BENCH_r23.json"
+    p.write_text(json.dumps(doc))
+    r = load_bench_round(str(p))
+    assert r["serve_table_bytes"] == 5280000.0
+    assert r["serve_quant_drift"] == 0.011
+    rounds = [dict(r, path=f"r{i}") for i in range(4)]
+    bad = check_run(rounds, {"serve_table_bytes": 20480000.0,
+                             "serve_quant_drift": 0.3})
+    assert set(bad["regressed"]) == {"serve_table_bytes",
+                                     "serve_quant_drift"}
+    ok = check_run(rounds, {"serve_table_bytes": 5280000.0,
+                            "serve_quant_drift": 0.012})
+    assert ok["ok"], ok
+    # pre-PR-19 rounds lack the columns entirely: never an error
+    old = [{"path": f"r{i}", "serve_p50_ms": 0.5} for i in range(3)]
+    res = check_run(old, {"serve_p50_ms": 0.51,
+                          "serve_table_bytes": 5280000.0,
+                          "serve_quant_drift": 0.011})
+    assert res["ok"], res
+    assert res["checks"]["serve_table_bytes"]["verdict"] == \
+        "no_history"
+
+
 def test_check_run_filters_step_history_by_dtype():
     rounds = [{"path": "a", "step_ms": 7920.0, "compile_s": None,
                "overlap_frac": None, "dtype": "float32"},

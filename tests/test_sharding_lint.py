@@ -351,7 +351,7 @@ def test_mesh_portability_golden_sgc_stream(tree_audit):
 def test_reports_cover_all_rigs_and_budget(tree_audit):
     _, reports = tree_audit
     assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve",
-                            "gin_mesh2d"}
+                            "sgc_serve_q8", "gin_mesh2d"}
     from roc_tpu.analysis.findings import load_budget
     budget = load_budget(os.path.join(_REPO, "scripts",
                                       "lint_baseline.json"),
@@ -396,7 +396,8 @@ def test_sharding_events_emitted():
         bus.sinks.remove(cap)
     got = [r for r in cap.recs if r.get("cat") == "sharding"]
     assert {r["config"] for r in got} == \
-        {"gin_flat8", "sgc_stream", "sgc_serve", "gin_mesh2d"}
+        {"gin_flat8", "sgc_stream", "sgc_serve", "sgc_serve_q8",
+         "gin_mesh2d"}
     for r in got:
         assert "replicated_bytes" in r and "mesh_shapes" in r
 
@@ -453,7 +454,7 @@ def test_cli_strict_fails_on_replication_slack_and_unbounded(tmp_path):
     assert r3.returncode == 0, r3.stdout + r3.stderr
     budget = json.loads(bp.read_text())["replication_budget"]
     assert set(budget) == {"gin_flat8", "sgc_stream", "sgc_serve",
-                           "gin_mesh2d"}
+                           "sgc_serve_q8", "gin_mesh2d"}
     # slack now: inflate one bound by hand
     budget2 = dict(budget, gin_flat8=budget["gin_flat8"] + 5)
     bp.write_text(json.dumps({"version": 1, "findings": [],
@@ -483,7 +484,7 @@ def test_cli_json_carries_ledger_and_sites():
     payload = json.loads(r.stdout)
     reports = {p["config"]: p for p in payload["sharding"]}
     assert set(reports) == {"gin_flat8", "sgc_stream", "sgc_serve",
-                            "gin_mesh2d"}
+                            "sgc_serve_q8", "gin_mesh2d"}
     rep = reports["gin_flat8"]
     assert rep["delta"] == 0
     assert rep["ledger"] and rep["mesh_shapes"]
