@@ -1083,10 +1083,22 @@ def child_serve(args) -> dict:
                 queries=100, batch=4)
         except Exception as e:  # noqa: BLE001 - latency rows survive
             slo_smoke = {"error": _errstr(e)}
+        # the sharded-capacity row (PR 20): total table above one
+        # replica's byte cap, slices gathered across the fleet at
+        # availability 1.0 bit-exact — feeds the
+        # serve_shard_table_bytes / serve_gather_p50_ms columns
+        try:
+            from roc_tpu.models.builder import Model
+            shard_cap = ms.run_shard_capacity(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                queries=60, batch=4)
+        except Exception as e:  # noqa: BLE001 - latency rows survive
+            shard_cap = {"error": _errstr(e)}
     out = {"platform": dev.platform, "device_kind": dev.device_kind,
            "V": int(ds.graph.num_nodes), "E": int(ds.graph.num_edges),
            "queries": 200, "batch": 4, "backends": rows,
-           "router_drill": drill, "slo_smoke": slo_smoke}
+           "router_drill": drill, "slo_smoke": slo_smoke,
+           "shard_capacity": shard_cap}
     pre, full = rows.get("precomputed"), rows.get("full")
     if pre and full:
         out["speedup_p50"] = round(
@@ -1635,6 +1647,17 @@ def parent(args, argv) -> int:
                 serve_availability=drill.get("availability"),
                 serve_failover=drill.get("failover"),
                 serve_wrong=drill.get("wrong"))
+        # sharded serving (PR 20): per-replica slice bytes (lower-
+        # better: a regression means the slicing stopped shrinking
+        # the per-replica footprint) + the cross-shard gather leg's
+        # p50 (lower-better: the request-path cost of not holding
+        # the whole table), mined from the capacity row
+        cap = sv["result"].get("shard_capacity") or {}
+        if cap.get("serve_shard_table_bytes") is not None:
+            serve_fields["serve_shard_table_bytes"] = cap.get(
+                "serve_shard_table_bytes")
+            serve_fields["serve_gather_p50_ms"] = cap.get(
+                "serve_gather_p50_ms")
     for name, metric in (("full", METRIC_FULL), ("small", METRIC_SMALL)):
         rec = results.get(name)
         if rec and rec.get("ok"):

@@ -504,6 +504,183 @@ def run_router_drill(ds, model, cfg, art_root, queries=120,
     return row
 
 
+def run_shard_capacity(ds, model, cfg, art_root, queries=200,
+                       batch=4, n_shards=2, mode="int8", trials=4,
+                       seed=0):
+    """The sharded-serving capacity proof (ISSUE 20 acceptance): the
+    TOTAL propagation table exceeds one replica's enforced byte cap,
+    yet the sharded fleet serves every query at availability 1.0 with
+    answers bit-exact vs the full-table fleet.  Export ``--shards N``
+    at ``mode``, front the slices with ``Router(sharded=True)`` under
+    a ``table_budget_bytes`` cap BELOW the full table (a full-table
+    replica would refuse to boot), drive load-gen with batches forced
+    across the shard boundary, and pair an interleaved p50 A/B
+    against a budget-free full-table router over the same artifact.
+
+    The byte acceptance: per-replica bytes ≤ full/N + slack, where
+    slack = halo rows + the pad row + the edge-balanced partition's
+    imbalance over a perfect V/N split — the gather halo is the ONLY
+    structural overhead a slice carries."""
+    from roc_tpu.serve.export import build_predictor, export_predictor
+    from roc_tpu.serve.quant import table_bytes
+    from roc_tpu.serve.router import Router
+    out_dir = os.path.join(art_root, "shard_capacity")
+    t_start = time.perf_counter()
+    pred = build_predictor(model, ds, cfg, backend="precomputed",
+                           quant=mode)
+    manifest = export_predictor(
+        pred, out_dir,
+        dataset_meta={"V": ds.graph.num_nodes,
+                      "E": ds.graph.num_edges},
+        shards=n_shards)
+    sb = manifest["shards"]
+    shard_bytes = int(sb["bytes_per_replica"])
+    full_bytes = int(sb["bytes_full"])
+    V, F = ds.graph.num_nodes, int(pred.cache.table.shape[1])
+    # the cap: midway between one slice and the full table — a
+    # full-table replica CANNOT boot under it, a slice fits
+    budget = (shard_bytes + full_bytes) // 2
+    slack = int(table_bytes(
+        (int(sb["halo"]) + 1 + (int(sb["rows_padded"]) - V // n_shards),
+         F), mode))
+    bytes_ok = (shard_bytes <= budget < full_bytes
+                and shard_bytes <= full_bytes // n_shards + slack)
+    rng = np.random.RandomState(seed)
+    ids_seq = [rng.randint(0, V, size=batch).astype(np.int32)
+               for _ in range(queries)]
+    # force a third of the batches across the first shard boundary —
+    # a capacity row that never gathers proves nothing
+    b = int(sb["plan"][0][1])
+    for i in range(0, len(ids_seq), 3):
+        ids_seq[i][:2] = (b - 1, b)
+    want = [np.asarray(pred.query(ids)) for ids in ids_seq]
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("ROC_TPU_FAULT", None)
+    wrong = 0
+    p50s = {"full": [], "sharded": []}
+    with Router(out_dir, n_replicas=n_shards, cpu=True, env=env,
+                default_deadline_ms=60_000.0) as r_full, \
+         Router(out_dir, n_replicas=n_shards, cpu=True, env=env,
+                sharded=True, table_budget_bytes=budget,
+                default_deadline_ms=60_000.0) as r_shard:
+        # correctness + availability on the sharded arm first
+        futs = [r_shard.submit(ids) for ids in ids_seq]
+        for f, ref in zip(futs, want):
+            if np.abs(np.asarray(f.result(timeout=120))
+                      - ref).max() > 0.0:
+                wrong += 1
+        shard_stats = r_shard.stats()
+        # paired interleaved p50 A/B (run_obs_ab precedent): both
+        # routers warm, alternate arm order per trial
+        arms = {"full": r_full, "sharded": r_shard}
+        for trial in range(trials):
+            order = (("full", "sharded") if trial % 2 == 0
+                     else ("sharded", "full"))
+            for name in order:
+                lat = []
+                for ids in ids_seq:
+                    t0 = time.perf_counter()
+                    arms[name].query(ids, deadline_ms=60_000.0)
+                    lat.append((time.perf_counter() - t0) * 1e3)
+                p50s[name].append(_pcts(lat)["p50_ms"])
+
+    def _med(vs):
+        vs = sorted(vs)
+        n = len(vs)
+        return vs[n // 2] if n % 2 else 0.5 * (vs[n // 2 - 1]
+                                               + vs[n // 2])
+    p50_full, p50_shard = _med(p50s["full"]), _med(p50s["sharded"])
+    avail = shard_stats.get("availability")
+    ok = bool(bytes_ok and wrong == 0 and avail == 1.0)
+    return {"mode": mode, "n_shards": n_shards, "queries": queries,
+            "ok": ok, "wrong": wrong, "availability": avail,
+            "table_budget_bytes": budget,
+            "serve_shard_table_bytes": shard_bytes,
+            "full_table_bytes": full_bytes,
+            "bytes_slack": slack, "bytes_ok": bytes_ok,
+            "halo": int(sb["halo"]),
+            "serve_gather_p50_ms": shard_stats.get("gather_p50_ms"),
+            "p50_full_ms": round(p50_full, 4),
+            "p50_sharded_ms": round(p50_shard, 4),
+            "p50_full_all": [round(v, 4) for v in p50s["full"]],
+            "p50_sharded_all": [round(v, 4)
+                                for v in p50s["sharded"]],
+            "delta_pct": round(100.0 * (p50_shard - p50_full)
+                               / max(p50_full, 1e-9), 1),
+            "wall_s": round(time.perf_counter() - t_start, 2)}
+
+
+def run_shard_smoke(ds, model, cfg, art_root, queries=100,
+                    batch=4, n_shards=2, mode="int8", seed=0):
+    """The sharded-serving smoke (ISSUE 20 CI gate): export
+    ``--shards 2``, cold-load ONE slice directly (the zero-new-
+    compiles parity check inside ``load_predictor`` must pass), then
+    front the slices with a 2-replica sharded Router under a byte cap
+    below the full table and drive a load-gen pass whose batches
+    straddle the shard boundary.  Every answer must match the
+    export-process predictor bit-exactly.  Exit-enforced by
+    scripts/test.sh preflight and round6_chain step 0: a fleet that
+    cannot gather across its own shards never reaches a round."""
+    from roc_tpu.serve.export import (build_predictor, export_predictor,
+                                      load_predictor)
+    from roc_tpu.serve.router import Router
+    out_dir = os.path.join(art_root, "shard_smoke")
+    t_start = time.perf_counter()
+    pred = build_predictor(model, ds, cfg, backend="precomputed",
+                           quant=mode)
+    manifest = export_predictor(
+        pred, out_dir,
+        dataset_meta={"V": ds.graph.num_nodes,
+                      "E": ds.graph.num_edges},
+        shards=n_shards)
+    sb = manifest["shards"]
+    shard_bytes = int(sb["bytes_per_replica"])
+    full_bytes = int(sb["bytes_full"])
+    budget = (shard_bytes + full_bytes) // 2
+    # cold slice load: program-key parity vs the manifest's shard warm
+    # set is asserted inside load_predictor (raises on mismatch), and
+    # a slice answers its OWNED ids bit-exactly with no gather path
+    cold0 = load_predictor(out_dir, shard=0)
+    lo0, hi0 = cold0.shard
+    own_ids = np.arange(lo0, min(hi0, lo0 + batch), dtype=np.int32)
+    cold_wrong = int(np.abs(np.asarray(cold0.query(own_ids))
+                            - np.asarray(pred.query(own_ids))
+                            ).max() > 0.0)
+    rng = np.random.RandomState(seed)
+    V = ds.graph.num_nodes
+    ids_seq = [rng.randint(0, V, size=batch).astype(np.int32)
+               for _ in range(queries)]
+    b = int(sb["plan"][0][1])
+    for i in range(0, len(ids_seq), 3):
+        ids_seq[i][:2] = (b - 1, b)   # cross-shard ids, every 3rd
+    want = [np.asarray(pred.query(ids)) for ids in ids_seq]
+    env = os.environ.copy()
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("ROC_TPU_FAULT", None)   # a smoke is quiet by definition
+    wrong = 0
+    with Router(out_dir, n_replicas=n_shards, cpu=True, env=env,
+                sharded=True, table_budget_bytes=budget,
+                default_deadline_ms=60_000.0) as router:
+        futs = [router.submit(ids) for ids in ids_seq]
+        for f, ref in zip(futs, want):
+            if np.abs(np.asarray(f.result(timeout=120))
+                      - ref).max() > 0.0:
+                wrong += 1
+        stats = router.stats()
+    avail = stats.get("availability")
+    ok = bool(wrong == 0 and cold_wrong == 0 and avail == 1.0
+              and shard_bytes <= budget < full_bytes)
+    return {"mode": mode, "n_shards": n_shards, "queries": queries,
+            "ok": ok, "wrong": wrong, "cold_slice_wrong": cold_wrong,
+            "availability": avail,
+            "table_budget_bytes": budget,
+            "shard_table_bytes": shard_bytes,
+            "full_table_bytes": full_bytes,
+            "gather_p50_ms": stats.get("gather_p50_ms"),
+            "wall_s": round(time.perf_counter() - t_start, 2)}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--nodes", type=int, default=20_000)
@@ -537,6 +714,18 @@ def main(argv=None):
                          "cold-load → load-gen → served answers must "
                          "match the gated values bit-exactly (exit 1 "
                          "otherwise) — the PR-19 CI gate")
+    ap.add_argument("--shard-smoke", action="store_true",
+                    help="run ONLY the sharded-serving smoke: export "
+                         "--shards 2 → cold-load one slice → sharded "
+                         "Router under a byte cap below the full "
+                         "table → load-gen with cross-shard ids, "
+                         "bit-exact answers required (exit 1 "
+                         "otherwise) — the PR-20 CI gate")
+    ap.add_argument("--no-shard-ab", action="store_true",
+                    help="skip the sharded-capacity row (2-shard "
+                         "int8 export behind a byte-capped sharded "
+                         "Router vs a full-table fleet; the "
+                         "shard-bytes/gather acceptance)")
     ap.add_argument("--no-quant-ab", action="store_true",
                     help="skip the quant:int8 A/B row (precomputed "
                          "backend re-exported with --quantize int8; "
@@ -572,6 +761,22 @@ def main(argv=None):
               f"shrink {row.get('table_shrink')}x, "
               f"{row.get('wrong', '?')} served mismatches)",
               file=sys.stderr)
+        print(json.dumps(row))
+        return 0 if row["ok"] else 1
+    if args.shard_smoke:
+        from roc_tpu.models.builder import Model
+        with tempfile.TemporaryDirectory(prefix="roc_shard_") as art:
+            row = run_shard_smoke(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                queries=args.queries, batch=args.batch)
+        print(f"# shard smoke: {'GREEN' if row['ok'] else 'RED'} "
+              f"({row['queries']} queries over {row['n_shards']} "
+              f"shards, {row['wrong']} wrong, availability "
+              f"{row['availability']}, slice "
+              f"{row['shard_table_bytes']} B ≤ cap "
+              f"{row['table_budget_bytes']} B < full "
+              f"{row['full_table_bytes']} B, gather p50 "
+              f"{row['gather_p50_ms']} ms)", file=sys.stderr)
         print(json.dumps(row))
         return 0 if row["ok"] else 1
     if args.slo_smoke:
@@ -676,6 +881,25 @@ def main(argv=None):
                   f"{ab['trials']}): instrumented p50 "
                   f"{ab['p50_on_ms']} ms vs off {ab['p50_off_ms']} ms "
                   f"({ab['overhead_pct']:+.1f}%)", file=sys.stderr)
+        if not args.no_shard_ab:
+            # the sharded-capacity row (PR 20): total table above one
+            # replica's byte cap, served sharded at availability 1.0
+            # bit-exact, paired p50 vs the full-table fleet
+            from roc_tpu.models.builder import Model
+            row = run_shard_capacity(
+                ds, Model.from_spec(model.to_spec()), cfg, art,
+                queries=min(args.queries, 60), batch=args.batch)
+            out["shard_capacity"] = row
+            print(f"# shard capacity: {'OK' if row['ok'] else 'RED'} "
+                  f"slice {row['serve_shard_table_bytes']} B ≤ cap "
+                  f"{row['table_budget_bytes']} B < full "
+                  f"{row['full_table_bytes']} B, {row['wrong']} "
+                  f"wrong, availability {row['availability']}, "
+                  f"paired p50 {row['p50_full_ms']} → "
+                  f"{row['p50_sharded_ms']} ms "
+                  f"({row['delta_pct']:+.1f}%), gather p50 "
+                  f"{row['serve_gather_p50_ms']} ms",
+                  file=sys.stderr)
         if args.drill:
             from roc_tpu.models.builder import Model
             row = run_router_drill(

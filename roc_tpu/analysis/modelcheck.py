@@ -32,16 +32,24 @@ The three models:
   versioned-table publish.  The dispatcher captures ``published()``
   ONCE per microbatch; the invariant is that every row of the batch
   is served from exactly that one version, under any interleaving of
-  the swap.
+  the swap.  PR 20 adds the sharded-serving gather leg: the batch's
+  second row is FOREIGN (owned by another shard) and must be fetched
+  from the owner — the correct protocol pins the fetch to the
+  captured version (a mismatched answer is re-gathered, never
+  served), so a mid-rollout gather can't stage rows from a version
+  the batch didn't capture.
 
 Each model carries seedable bugs (``seed=`` names one) so the test
 tier can prove the checker actually bites: ``double-requeue`` drops
 the per-corpse requeue guard, ``manifest-first`` publishes the
 manifest before the shard renames, ``swap-mid-query`` reads the live
-published version per row instead of the captured one, and
+published version per row instead of the captured one,
 ``live-qmode`` (PR 19) keeps the captured rows but picks the dequant
 program from the live published version's quant spec — the
-mid-rollout fp32→int8 window ``quant-spec-pinned`` exists for.
+mid-rollout fp32→int8 window ``quant-spec-pinned`` exists for — and
+``shard-gather`` (PR 20) drops the gather's version pin and serves
+whatever the owner's live table answered, the cross-shard
+version-mixing window ``gather-version-pinned`` exists for.
 """
 
 from __future__ import annotations
@@ -71,7 +79,11 @@ SEEDS = {
 # dequant program by the LIVE published version's quant spec, the
 # mid-rollout bug class quant-spec-pinned exists to catch
 EXTRA_SEEDS = {
-    "table-swap": ("live-qmode",),
+    # "shard-gather" (PR 20): the cross-shard gather serves whatever
+    # version the owner's live table answered instead of refusing a
+    # version != the microbatch's capture — the mixing window
+    # gather-version-pinned exists to catch
+    "table-swap": ("live-qmode", "shard-gather"),
 }
 
 
@@ -393,7 +405,13 @@ def _ckpt_model(seed: Optional[str], budget: int) -> ModelReport:
 # decode-mode) pair and quant-spec-pinned can distinguish "read the
 # wrong version's rows" from "decoded the right rows with the wrong
 # version's program".
-_S = namedtuple("_S", "published captured served step")
+# PR 20: row 1 is FOREIGN (owned by another shard) — serving it
+# requires a gather first, which stages rows read from the owner's
+# LIVE published table (``gathered`` records that version).  The
+# correct protocol only serves the staged rows when the gathered
+# version equals the capture (a mismatch is re-gathered); the
+# shard-gather seed drops that pin.
+_S = namedtuple("_S", "published captured gathered served step")
 
 # the quant spec each published version carries (the mid-rollout
 # fp32→int8 swap the serve tier's versioned publish protocol covers)
@@ -404,6 +422,7 @@ def _swap_step(seed: Optional[str]
                ) -> Callable[[Any], List[Tuple[str, Any]]]:
     live_rows = seed == "swap-mid-query"
     live_mode = seed == "live-qmode"
+    unpinned_gather = seed == "shard-gather"
 
     def step(s: _S) -> List[Tuple[str, Any]]:
         out: List[Tuple[str, Any]] = []
@@ -422,8 +441,26 @@ def _swap_step(seed: Optional[str]
             # seeded bug 2 keeps the captured rows but selects the
             # dequant program by the LIVE version's quant spec
             m = _QMODE[s.published if live_mode else v]
-            out.append((f"serve_row{row}@v{v}:{m}", s._replace(
-                served=_set(s.served, row, (v, m)), step=s.step + 1)))
+            if row == 0:
+                # row 0 is LOCAL: served straight from the capture
+                out.append((f"serve_row{row}@v{v}:{m}", s._replace(
+                    served=_set(s.served, row, (v, m)),
+                    step=s.step + 1)))
+            else:
+                # row 1 is FOREIGN: a gather (re-gather) reads the
+                # owner's live published table at any point...
+                out.append((f"gather@v{s.published}", s._replace(
+                    gathered=s.published)))
+                # ...and the staged rows are served only once the
+                # gathered version matches the pin — unless the
+                # shard-gather seed dropped the pin check
+                if s.gathered is not None and (
+                        unpinned_gather or s.gathered == s.captured):
+                    out.append((
+                        f"serve_row{row}@v{v}:{m}"
+                        f":staged@v{s.gathered}",
+                        s._replace(served=_set(s.served, row, (v, m)),
+                                   step=s.step + 1)))
         return out
 
     return step
@@ -453,11 +490,26 @@ def _swap_quant_invariant(s: _S) -> Optional[str]:
     return None
 
 
+def _swap_gather_invariant(s: _S) -> Optional[str]:
+    # checked once the FOREIGN row was served: ``gathered`` is frozen
+    # after the serve (gathers are only offered before it), so it IS
+    # the version the staged rows came from
+    if s.served[1] is not None and s.gathered != s.captured:
+        return (f"foreign row served from rows gathered at "
+                f"v{s.gathered} into a batch that captured "
+                f"v{s.captured} — a cross-shard gather must be "
+                f"pinned to the captured version (mismatched answers "
+                f"are re-gathered, never served)")
+    return None
+
+
 def _swap_model(seed: Optional[str], budget: int) -> ModelReport:
-    init = _S(published=0, captured=None, served=(None, None), step=0)
+    init = _S(published=0, captured=None, gathered=None,
+              served=(None, None), step=0)
     return _bfs("table-swap", init, _swap_step(seed),
                 [("single-version-batch", _swap_invariant),
-                 ("quant-spec-pinned", _swap_quant_invariant)],
+                 ("quant-spec-pinned", _swap_quant_invariant),
+                 ("gather-version-pinned", _swap_gather_invariant)],
                 budget=budget)
 
 

@@ -62,6 +62,33 @@ WIRE_CHANNELS: List[Dict[str, Any]] = [
                 "note": "stdin EOF is the close signal "
                         "(Router.close closes the pipe)",
             },
+            # sharded-table gather leg (PR 20), declared HERE first
+            # per the spec-first workflow: a replica serving a table
+            # SLICE fetches rows it does not own from the owning
+            # replica, via the router.  The router forwards the
+            # requester's fetch_rows to the owner and relays the
+            # owner's rows answer back — both kinds therefore exist on
+            # BOTH channels.  "version" is the requester's captured
+            # TableVersion: the gather is version-PINNED (the owner
+            # refuses to answer from a different table version, so a
+            # mid-rollout gather can never mix versions — the
+            # gather-version-pinned model invariant below).
+            "fetch_rows": {
+                "required": ("kind", "gid", "ids", "version"),
+                "optional": (),
+                "sent": True,
+            },
+            "rows": {
+                # ok answers carry the owned rows (raw stored values:
+                # fp32 rows, or int8/fp8 codes + per-row scales — the
+                # requester stages them verbatim, bit-exact); refusals
+                # (version mismatch, un-owned ids) carry "error" with
+                # rows empty
+                "required": ("kind", "gid", "ids", "rows", "version",
+                             "qmode"),
+                "optional": ("scales", "replica", "error"),
+                "sent": True,
+            },
         },
     },
     {
@@ -69,6 +96,23 @@ WIRE_CHANNELS: List[Dict[str, Any]] = [
         "sender": "roc_tpu/serve/replica.py",
         "receiver": "roc_tpu/serve/router.py",
         "kinds": {
+            # the gather leg's other half (PR 20): the REQUESTER
+            # replica originates fetch_rows (router forwards it to the
+            # owner), and the OWNER replica answers with rows (router
+            # relays it back by gid) — same field contracts as the
+            # router->replica declarations above, because the router
+            # is a pure forwarder that re-builds the line verbatim
+            "fetch_rows": {
+                "required": ("kind", "gid", "ids", "version"),
+                "optional": (),
+                "sent": True,
+            },
+            "rows": {
+                "required": ("kind", "gid", "ids", "rows", "version",
+                             "qmode"),
+                "optional": ("scales", "replica", "error"),
+                "sent": True,
+            },
             "ready": {
                 # "quant" (PR 19): the replica advertises its serving
                 # tables' quantization mode (off/int8/fp8) so the
@@ -76,10 +120,16 @@ WIRE_CHANNELS: List[Dict[str, Any]] = [
                 # it did not ask for — declared HERE first, per the
                 # spec-first workflow: the wire-field-contract rule
                 # then reports every send site still owed the field
+                # "table_version" (PR 20): the published TableVersion
+                # the replica cold-loaded — the router's fleet view of
+                # version skew, and the epoch gathers pin against;
+                # "table_bytes" rides along so the capacity scenario
+                # can assert the per-replica byte budget from the
+                # fleet view (sliced loads advertise O(V/N) bytes)
                 "required": ("kind", "replica", "pid", "num_nodes",
                              "num_classes", "buckets", "backend",
-                             "shard", "quant"),
-                "optional": (),
+                             "shard", "quant", "table_version"),
+                "optional": ("table_bytes",),
                 "sent": True,
             },
             "hb": {
@@ -94,9 +144,13 @@ WIRE_CHANNELS: List[Dict[str, Any]] = [
                 # pinned to — a mid-rollout fp32→int8 swap answers
                 # with the captured version's mode, and the wire says
                 # so); ok=false carries the typed error triple — both
-                # shapes are ``res``
+                # shapes are ``res``.  PR 20 adds the answering
+                # replica's owned shard range ("shard") and the
+                # microbatch's cross-shard gather wall ("gather_ms",
+                # None when every id was owned) — the request-path
+                # evidence behind the serve_gather_p50_ms column
                 "optional": ("rows", "version", "qmode", "error",
-                             "msg", "retryable"),
+                             "msg", "retryable", "shard", "gather_ms"),
                 "sent": True,
             },
             "drained": {
@@ -171,6 +225,13 @@ MODEL_INVARIANTS: Dict[str, tuple] = {
         # dequant program (or vice versa, mid-rollout) is garbage even
         # when the version ids agree.  Seedable as "live-qmode".
         "quant-spec-pinned",
+        # PR 20: a sharded replica's cross-shard gather must return
+        # rows from exactly the version the microbatch captured — a
+        # gather answered from the owner's LIVE published version
+        # mid-rollout would mix two table versions inside one batch
+        # even though every locally-served row is pinned.  Seedable as
+        # "shard-gather".
+        "gather-version-pinned",
     ),
 }
 

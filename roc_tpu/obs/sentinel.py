@@ -127,6 +127,8 @@ def load_bench_round(path: str) -> Dict[str, Any]:
                            "serve_slo_ok": None,
                            "serve_table_bytes": None,
                            "serve_quant_drift": None,
+                           "serve_shard_table_bytes": None,
+                           "serve_gather_p50_ms": None,
                            "ckpt_save_ms": None,
                            "ckpt_block_ms": None,
                            "mesh_epoch_ratio": None,
@@ -162,10 +164,16 @@ def load_bench_round(path: str) -> Dict[str, Any]:
     # int8 artifact's propagation-table bytes, lower-better — a
     # regression means the shrink was lost) and serve_quant_drift
     # (the gate's relative max |Δlogit|, lower-better)
+    # PR 20 adds the sharded-serving pair: serve_shard_table_bytes
+    # (one replica's slice bytes, lower-better — a regression means
+    # the slicing stopped shrinking the per-replica footprint) and
+    # serve_gather_p50_ms (the cross-shard gather leg's p50,
+    # lower-better — the request-path cost of the slicing)
     for k in ("serve_p50_ms", "serve_p99_ms", "serve_qps",
               "serve_shed_rate", "serve_error_rate",
               "serve_availability", "serve_slo_ok",
               "serve_table_bytes", "serve_quant_drift",
+              "serve_shard_table_bytes", "serve_gather_p50_ms",
               "ckpt_save_ms", "ckpt_block_ms"):
         if isinstance(parsed.get(k), (int, float)):
             out[k] = float(parsed[k])
@@ -319,6 +327,19 @@ def check_run(rounds: List[Dict[str, Any]],
             [r.get("serve_quant_drift") for r in rounds],
             current.get("serve_quant_drift"), allow_zero=True,
             abs_floor=RATE_ABS_FLOOR),
+        # sharded serving (PR 20): one replica's slice bytes,
+        # lower-better — a regression means the shard plan stopped
+        # shrinking the per-replica footprint (halo bloat, a slice
+        # that silently fell back to the full table)
+        "serve_shard_table_bytes": detect(
+            [r.get("serve_shard_table_bytes") for r in rounds],
+            current.get("serve_shard_table_bytes")),
+        # ... and the cross-shard gather leg's p50, lower-better —
+        # the request-path price of not holding the whole table,
+        # gated exactly like the request p50
+        "serve_gather_p50_ms": detect(
+            [r.get("serve_gather_p50_ms") for r in rounds],
+            current.get("serve_gather_p50_ms")),
         # checkpoint v3 (ISSUE 15): async save wall + step-path
         # blocked time, lower-better — a PR that re-synchronizes the
         # save path (or bloats the snapshot) regresses here first
@@ -440,6 +461,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    "serve_slo_ok": cur.get("serve_slo_ok"),
                    "serve_table_bytes": cur.get("serve_table_bytes"),
                    "serve_quant_drift": cur.get("serve_quant_drift"),
+                   "serve_shard_table_bytes":
+                       cur.get("serve_shard_table_bytes"),
+                   "serve_gather_p50_ms":
+                       cur.get("serve_gather_p50_ms"),
                    "ckpt_save_ms": cur.get("ckpt_save_ms"),
                    "ckpt_block_ms": cur.get("ckpt_block_ms"),
                    "dtype": args.dtype or cur.get("dtype"),

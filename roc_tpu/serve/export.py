@@ -37,12 +37,14 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs.events import emit
-from .predictor import SERVE_BUCKETS, Predictor
+from .predictor import SERVE_BUCKETS, Predictor, ShardSlice
 from .propagation import (PropagationCache, logits_table_cache,
                           prefix_descriptors)
 
 MANIFEST_NAME = "serve_manifest.json"
 MANIFEST_VERSION = 1
+
+SHARD_FILE = "propagation_shard{k}.npz"
 
 
 def _host_params(params) -> Dict[str, np.ndarray]:
@@ -159,6 +161,108 @@ def build_predictor(model, dataset, config, params=None,
                      quant=quant, verbose=verbose)
 
 
+# ------------------------------------------------------- sharded slices
+
+def make_shard_slices(cache: PropagationCache, num_shards: int,
+                      buckets: Sequence[int],
+                      quant: str = "off") -> List[ShardSlice]:
+    """The export-time shard PLAN (PR 20): contiguous ``[lo, hi)``
+    vertex ranges from the trainer's own edge-balanced sweep
+    (``core/partition.edge_balanced_bounds`` — serve slices inherit
+    training's partition law), under ONE fleet-uniform padded layout:
+    ``rows_padded`` = max owned rows snapped to NODE_MULTIPLE, ``halo``
+    = the largest serve bucket (a microbatch's foreign rows always
+    fit).  Quantized slices are cut from the FULL table's ``(codes,
+    scales)`` — per-row symmetric quantization is row-local, so slice
+    codes are bit-identical to the unsharded artifact's — and every
+    slice carries the full-table scale envelope so refresh guarding
+    matches the export drift gate's measurement."""
+    from ..core.partition import NODE_MULTIPLE, edge_balanced_bounds
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    V = cache.num_nodes
+    plan: List[Tuple[int, int]] = []
+    for left, right in edge_balanced_bounds(cache.row_ptr, num_shards):
+        plan.append((int(left), int(right) + 1) if right >= left
+                    else (V, V))
+    own_max = max(hi - lo for lo, hi in plan)
+    rows_padded = -(-max(own_max, 1)
+                    // NODE_MULTIPLE) * NODE_MULTIPLE
+    halo = max(int(b) for b in buckets)
+    if quant != "off":
+        from .quant import quantize_rows
+        q, sc = quantize_rows(cache.table, quant)
+        # host numpy scale max at EXPORT time, not a device fetch
+        guard = float(sc.max())  # roc-lint: ok=host-sync-hot-path
+        return [ShardSlice(lo, hi, V, rows_padded, halo,
+                           codes=q[lo:hi], scales=sc[lo:hi],
+                           scale_guard=guard) for lo, hi in plan]
+    return [ShardSlice(lo, hi, V, rows_padded, halo,
+                       rows=cache.table[lo:hi]) for lo, hi in plan]
+
+
+def _write_shard_slice(out_dir: str, k: int, sl: ShardSlice,
+                       quant: str) -> str:
+    import tempfile
+    data: Dict[str, Any] = {
+        "lo": np.int64(sl.lo), "hi": np.int64(sl.hi),
+        "num_nodes": np.int64(sl.num_nodes),
+        "rows_padded": np.int64(sl.rows_padded),
+        "halo": np.int64(sl.halo)}
+    if quant != "off":
+        from .quant import to_storage_bytes
+        data["rows_q"] = to_storage_bytes(sl.codes)
+        data["rows_scale"] = sl.scales
+        data["scale_guard"] = np.float64(sl.scale_guard)
+    else:
+        data["rows"] = sl.rows
+    path = os.path.join(out_dir, SHARD_FILE.format(k=k))
+    fd, tmp = tempfile.mkstemp(dir=out_dir, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **data)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_shard_slice(artifact_dir: str, k: int,
+                     quant: str = "off") -> ShardSlice:
+    """One persisted table slice → :class:`ShardSlice` (quantized
+    slices rebuild codes from storage-byte views, bit-exact)."""
+    path = os.path.join(artifact_dir, SHARD_FILE.format(k=k))
+    with np.load(path) as z:
+        lo, hi = int(z["lo"]), int(z["hi"])
+        num_nodes = int(z["num_nodes"])
+        rows_padded, halo = int(z["rows_padded"]), int(z["halo"])
+        if quant != "off":
+            from .quant import from_storage_bytes
+            return ShardSlice(
+                lo, hi, num_nodes, rows_padded, halo,
+                codes=from_storage_bytes(z["rows_q"], quant),
+                scales=np.asarray(z["rows_scale"], dtype=np.float32),
+                # npz scalar at cold-load time, not a device fetch
+                scale_guard=float(z["scale_guard"]))  # roc-lint: ok=host-sync-hot-path
+        return ShardSlice(lo, hi, num_nodes, rows_padded, halo,
+                          rows=np.asarray(z["rows"],
+                                          dtype=np.float32))
+
+
+def _shard_view_predictor(pred: Predictor,
+                          sl: ShardSlice) -> Predictor:
+    """A shard-view Predictor over the SAME resolved model/params —
+    export warms its bucket programs once (one fleet-uniform table
+    shape → one program set shared by every shard), and
+    ``load_predictor(shard=k)`` rebuilds the identical keys."""
+    return Predictor(pred.model, pred.config, pred.params,
+                     "precomputed", pred.buckets, cache=None,
+                     head_model=pred.head_model, flavor=pred.flavor,
+                     num_classes=pred.num_classes, quant=pred.quant,
+                     shard=sl, verbose=pred.verbose)
+
+
 # ------------------------------------------------------------ artifact
 
 def _quant_ref_logits(pred: Predictor, params, sample) -> np.ndarray:
@@ -188,7 +292,8 @@ def export_predictor(pred: Predictor, out_dir: str,
                      cache_dir: Optional[str] = None,
                      verify_warm: bool = True,
                      drift_argmax_min: Optional[float] = None,
-                     drift_dlogit_max: Optional[float] = None
+                     drift_dlogit_max: Optional[float] = None,
+                     shards: int = 0
                      ) -> Dict[str, Any]:
     """Persist ``pred`` as a serving artifact and pre-pay its compile
     wall: params + propagation tables + manifest on disk, every bucket
@@ -250,6 +355,49 @@ def export_predictor(pred: Predictor, out_dir: str,
     if pred.cache is not None:
         pred.cache.save(os.path.join(out_dir, "propagation.npz"),
                         quant=pred.quant)
+    shard_block: Optional[Dict[str, Any]] = None
+    if shards:
+        # sliced artifacts (PR 20): per-shard table slices under one
+        # fleet-uniform padded shape, warmed ONCE through a shard-view
+        # predictor — every shard's cold load then hits the same
+        # program set with zero new compiles
+        if pred.backend != "precomputed" or pred.cache is None:
+            raise ValueError("sharded export applies to the "
+                             "precomputed table backend")
+        from .quant import table_bytes
+        slices = make_shard_slices(pred.cache, shards, pred.buckets,
+                                   pred.quant)
+        files = [os.path.basename(
+            _write_shard_slice(out_dir, k, sl, pred.quant))
+            for k, sl in enumerate(slices)]
+        spred = _shard_view_predictor(pred, slices[0])
+        swarm = spred.warm(cache_dir=cache_dir,
+                           name="serve_export_shard")
+        if swarm.get("failed"):
+            raise RuntimeError(
+                f"sharded export: {swarm['failed']} shard-view "
+                f"program(s) failed to AOT-compile — a sliced cold "
+                f"load would compile at first query")
+        F = int(pred.cache.table.shape[1])
+        shard_block = {
+            "n": int(shards),
+            "plan": [[int(sl.lo), int(sl.hi)] for sl in slices],
+            "rows_padded": int(slices[0].rows_padded),
+            "halo": int(slices[0].halo),
+            "files": files,
+            # the capacity math the fleet view / sentinel column reads:
+            # per-replica bytes are O(V/N) + halo, vs O(V) full
+            "bytes_per_replica": int(table_bytes(
+                (slices[0].rows_padded + slices[0].halo + 1, F),
+                pred.quant)),
+            "bytes_full": int(table_bytes(
+                (pred.num_nodes + 1, F), pred.quant)),
+            "program_keys": spred.program_keys(),
+            "prewarm": {k: swarm.get(k) for k in
+                        ("programs", "compile_warm_hits",
+                         "compile_cold", "failed", "prewarm_s",
+                         "cache_unavailable")},
+        }
     cfg = pred.config
     manifest: Dict[str, Any] = {
         "version": MANIFEST_VERSION,
@@ -282,6 +430,7 @@ def export_predictor(pred: Predictor, out_dir: str,
         "num_nodes": pred.num_nodes,
         "program_keys": pred.program_keys(),
         "quant": qblock,
+        "shards": shard_block,
         "created": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
     }
     warm = pred.warm(cache_dir=cache_dir, name="serve_export")
@@ -343,13 +492,21 @@ def export_trainer(trainer, dataset, out_dir: str,
 
 
 def load_predictor(artifact_dir: str, dataset=None,
-                   verbose: bool = False) -> Predictor:
+                   verbose: bool = False,
+                   shard: Optional[int] = None) -> Predictor:
     """Rebuild a Predictor from an exported artifact — the cold-server
     path.  No resolve pass runs here: the manifest carries the
     RESOLVED model op list and config fields, so the programs built
     are keyed identically to the export-time warm set.  ``dataset`` is
     required for the full-graph backend only (precomputed artifacts
-    are self-contained)."""
+    are self-contained).
+
+    ``shard=k`` cold-loads ONE table slice of a sharded artifact
+    (``export --shards N``): O(V/N)+halo table bytes instead of O(V),
+    same global id space, program keys identical to the export-time
+    shard-view warm set (zero new compiles on any shard) — ids the
+    slice does not own are served through the cross-shard gather leg
+    once the caller wires ``pred.gather_fn``."""
     import jax.numpy as jnp
 
     from ..models.builder import Model
@@ -397,7 +554,21 @@ def load_predictor(artifact_dir: str, dataset=None,
     cache = None
     head_model = None
     gctx = None
-    if backend == "precomputed":
+    slice_ = None
+    if shard is not None:
+        sb = manifest.get("shards")
+        if not sb:
+            raise ValueError(
+                f"{artifact_dir}: shard={shard} requested but the "
+                f"artifact was not exported with --shards")
+        if not (0 <= int(shard) < int(sb["n"])):
+            raise ValueError(
+                f"{artifact_dir}: shard {shard} out of range "
+                f"[0, {sb['n']})")
+        slice_ = load_shard_slice(artifact_dir, int(shard), qmode)
+        if flavor == "akx":
+            head_model = model.precompute_split()[1]
+    elif backend == "precomputed":
         cache = PropagationCache.load(
             os.path.join(artifact_dir, "propagation.npz"))
         if flavor == "akx":
@@ -425,13 +596,19 @@ def load_predictor(artifact_dir: str, dataset=None,
                      dataset=dataset if backend == "full" else None,
                      gctx=gctx,
                      num_classes=manifest.get("num_classes"),
-                     quant=qmode, verbose=verbose)
+                     quant=qmode, shard=slice_, verbose=verbose)
+    # a sliced load's programs must match the export-time SHARD-VIEW
+    # warm set (one fleet-uniform table shape → one key set shared by
+    # every shard); full loads match the top-level keys
+    want_keys = (manifest["shards"]["program_keys"]
+                 if shard is not None
+                 else manifest.get("program_keys"))
     live = pred.program_keys()
-    if sorted(manifest.get("program_keys") or []) != live:
+    if sorted(want_keys or []) != live:
         raise ValueError(
             f"{artifact_dir}: rebuilt program keys differ from the "
             f"manifest — this server would cold-compile; re-export "
-            f"(manifest {len(manifest.get('program_keys') or [])} vs "
+            f"(manifest {len(want_keys or [])} vs "
             f"live {len(live)})")
     return pred
 
@@ -491,6 +668,13 @@ def parse_args(argv: Optional[List[str]] = None):
     ap.add_argument("--drift-dlogit-max", type=float, default=None,
                     help="drift gate: maximum |Δlogit| vs the fp32 "
                          "reference (default in serve/quant.py)")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="also write N per-shard propagation slices "
+                         "+ a shard manifest block (edge-balanced "
+                         "[lo,hi) plan, fleet-uniform padded shape); "
+                         "a replica then cold-loads ONE slice "
+                         "(load_predictor(shard=k)) at O(V/N)+halo "
+                         "table bytes")
     ap.add_argument("--cache-dir", default=None,
                     help="persistent compile cache dir (default: "
                          "$ROC_TPU_CACHE_DIR or ~/.cache/roc_tpu/xla)")
@@ -575,13 +759,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 cache_dir=args.cache_dir,
                                 verify_warm=not args.no_verify_warm,
                                 drift_argmax_min=args.drift_argmax_min,
-                                drift_dlogit_max=args.drift_dlogit_max)
+                                drift_dlogit_max=args.drift_dlogit_max,
+                                shards=args.shards)
     print(json.dumps({
         "artifact": args.out, "backend": manifest["backend"],
         "flavor": manifest["flavor"],
         "programs": len(manifest["program_keys"]),
         "buckets": manifest["buckets"],
         "quant": manifest["quant"],
+        "shards": (None if not manifest.get("shards") else
+                   {k: manifest["shards"][k] for k in
+                    ("n", "plan", "bytes_per_replica",
+                     "bytes_full")}),
         "prewarm": manifest["prewarm"]}))
     return 0
 

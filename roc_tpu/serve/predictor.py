@@ -81,6 +81,33 @@ class TableVersion(NamedTuple):
 SERVE_BUCKETS: Tuple[int, ...] = (1, 8, 64, 512)
 
 
+class ShardSlice(NamedTuple):
+    """One exported propagation-table SLICE (PR 20): the final-stage
+    rows a shard owns, plus the fleet-uniform padded layout every
+    shard shares.  ``rows_padded`` is max-over-shards owned rows
+    rounded up to the partition NODE_MULTIPLE and ``halo`` the staging
+    region for cross-shard gathered rows (= the largest serve bucket,
+    so one microbatch's foreign rows always fit) — ONE table shape
+    ``(rows_padded + halo + 1, F)`` across the fleet means ONE serve
+    program set per (qmode, bucket), AOT-warmed once at export.
+
+    ``rows`` carries fp32 values (qmode off) or None; quantized slices
+    carry ``codes`` + per-row ``scales`` instead — per-row symmetric
+    quantization is row-local, so sliced codes are bit-identical to
+    the full table's.  ``scale_guard`` is the EXPORT-gated envelope
+    (full-table max scale × slack), not the slice-local max, so
+    refresh guarding matches the drift gate's measurement."""
+    lo: int
+    hi: int
+    num_nodes: int
+    rows_padded: int
+    halo: int
+    rows: Optional[np.ndarray] = None
+    codes: Optional[np.ndarray] = None
+    scales: Optional[np.ndarray] = None
+    scale_guard: Optional[float] = None
+
+
 def bucket_for(n: int, buckets: Sequence[int]) -> int:
     """Smallest bucket >= n (the padded dispatch size); requests past
     the largest bucket split into largest-bucket chunks upstream."""
@@ -107,6 +134,7 @@ class Predictor:
                  dataset=None, gctx=None,
                  num_classes: Optional[int] = None,
                  quant: str = "off",
+                 shard: Optional[ShardSlice] = None,
                  verbose: bool = False):
         import jax.numpy as jnp
 
@@ -132,14 +160,34 @@ class Predictor:
                 "shrink)")
         self._jits: Dict[Tuple[str, int], Any] = {}
         self.scale = None
+        # sharded-serving surface (PR 20): None/unset on full-table
+        # predictors; the Server reads these via getattr so the two
+        # shapes share one dispatch path
+        self.shard = None
+        self.gather_fn = None
+        self.last_gather_ms: Optional[float] = None
         if backend == "precomputed":
-            if cache is None:
+            if shard is not None:
+                # table-SLICE serving: this predictor owns global ids
+                # [lo, hi); every other id is fetched through
+                # ``gather_fn`` at query time and staged into the halo
+                # region of a batch-local table copy
+                self.shard = (int(shard.lo), int(shard.hi))
+                self.num_nodes = int(shard.num_nodes)
+                self._rows_padded = int(shard.rows_padded)
+                self.halo = int(shard.halo)
+                self.pad_id = self._rows_padded + self.halo
+                self.table, self.scale = self._device_table_shard(
+                    shard)
+                self._gctx = self._trivial_gctx()
+            elif cache is None:
                 raise ValueError("precomputed backend needs a "
-                                 "PropagationCache")
-            self.num_nodes = cache.num_nodes
-            self.table, self.scale = self._device_table(self.quant)
-            self.pad_id = self.num_nodes
-            self._gctx = self._trivial_gctx()
+                                 "PropagationCache (or a ShardSlice)")
+            else:
+                self.num_nodes = cache.num_nodes
+                self.table, self.scale = self._device_table(self.quant)
+                self.pad_id = self.num_nodes
+                self._gctx = self._trivial_gctx()
         elif backend == "full":
             if dataset is None or gctx is None:
                 raise ValueError("full backend needs dataset + gctx "
@@ -189,6 +237,44 @@ class Predictor:
             [q, np.zeros((1, q.shape[1]), dtype=q.dtype)])
         spad = np.concatenate([sc, np.ones(1, np.float32)])
         return jnp.asarray(qpad), jnp.asarray(spad)
+
+    def _device_table_shard(self, sl: ShardSlice):
+        """Upload one table SLICE under the fleet-uniform padded
+        layout: owned rows at ``[0, hi-lo)``, zeros through
+        ``rows_padded`` (NODE_MULTIPLE rounding), ``halo`` staging
+        slots for gathered foreign rows, and the pad row last — one
+        shape for every shard, so the bucket programs AOT-warmed at
+        export cold-load with zero new compiles on any shard.  Also
+        keeps the slice's host mirror: :meth:`read_rows` (the gather
+        OWNER side) answers from it without a device round trip."""
+        import jax.numpy as jnp
+        own = sl.hi - sl.lo
+        n = self._rows_padded + self.halo + 1
+        if self.quant == "off":
+            if sl.rows is None:
+                raise ValueError("fp32 shard slice carries no rows")
+            self._host_rows = np.asarray(sl.rows, dtype=np.float32)
+            t = np.zeros((n, self._host_rows.shape[1]), np.float32)
+            t[:own] = self._host_rows
+            return jnp.asarray(t, dtype=self.compute), None
+        from .quant import SCALE_GUARD_SLACK
+        if sl.codes is None or sl.scales is None:
+            raise ValueError("quantized shard slice needs codes "
+                             "+ scales")
+        self._host_codes = np.asarray(sl.codes)
+        self._host_scales = np.asarray(sl.scales, dtype=np.float32)
+        guard = sl.scale_guard
+        if guard is None and own:
+            # fallback: the slice-local envelope (exports always
+            # persist the full-table one)
+            guard = float(self._host_scales.max())  # roc-lint: ok=host-sync-hot-path
+        self._scale_guard = float(guard or 1.0) * SCALE_GUARD_SLACK
+        q = np.zeros((n, self._host_codes.shape[1]),
+                     dtype=self._host_codes.dtype)
+        q[:own] = self._host_codes
+        s = np.ones(n, np.float32)
+        s[:own] = self._host_scales
+        return jnp.asarray(q), jnp.asarray(s)
 
     def _trivial_gctx(self):
         """A graph-free context for the dense head: precompute_split
@@ -318,6 +404,17 @@ class Predictor:
 
     # --------------------------------------------------------- queries
 
+    def table_bytes(self) -> int:
+        """Device bytes of the CURRENT published serving table
+        (codes + per-row scales when quantized) — what a replica
+        advertises on ``ready`` and the per-replica byte budget is
+        enforced against.  Sharded predictors report the slice's
+        padded O(V/N)+halo footprint, full ones O(V)."""
+        from .quant import table_bytes as _tb
+        pub = self._published
+        return int(_tb(tuple(int(d) for d in pub.table.shape),
+                       pub.qmode))
+
     def published(self) -> TableVersion:
         """A consistent snapshot of the current table version (one
         atomic attribute read).  Dispatch paths capture this ONCE per
@@ -348,7 +445,15 @@ class Predictor:
         bucket, dispatch, fetch, slice.  The microbatch server
         (``serve/server.py``) is the production entry — it coalesces
         concurrent requests into one dispatch; this method is the
-        single-caller form the parity tests pin."""
+        single-caller form the parity tests pin.
+
+        Sharded predictors accept the SAME global id space: ids this
+        shard owns remap to local table rows; foreign ids are fetched
+        through ``gather_fn`` (coalesced per chunk — one gather per
+        microbatch, version-pinned to ``pub``) and staged into the
+        halo slots of a batch-local table copy.  ``last_gather_ms``
+        records the chunk-summed gather wall (None when every id was
+        owned)."""
         import jax
         import jax.numpy as jnp
         ids = np.asarray(node_ids, dtype=np.int32).ravel()
@@ -357,20 +462,99 @@ class Predictor:
                 f"node ids out of range [0, {self.num_nodes})")
         if pub is None:
             pub = self.published()  # one version for every chunk
+        self.last_gather_ms = None
         out: List[np.ndarray] = []
         cap = max(self.buckets)
         for lo in range(0, ids.size, cap):
             chunk = ids[lo:lo + cap]
+            if self.shard is not None:
+                chunk, pub_c = self._remap_chunk(chunk, pub)
+            else:
+                pub_c = pub
             b = bucket_for(chunk.size, self.buckets)
             padded = np.full(b, self.pad_id, dtype=np.int32)
             padded[:chunk.size] = chunk
-            logits = self.query_device(jnp.asarray(padded), pub)
+            logits = self.query_device(jnp.asarray(padded), pub_c)
             # the result fetch IS this tier's product — the one
             # sanctioned host sync on the serve path
             got = jax.device_get(logits)  # roc-lint: ok=host-sync-hot-path
             out.append(np.asarray(got[:chunk.size], dtype=np.float32))
         return (np.concatenate(out) if out
                 else np.zeros((0, self.num_classes or 0), np.float32))
+
+    # ------------------------------------------- sharded tables (PR 20)
+
+    def _remap_chunk(self, chunk: np.ndarray,
+                     pub: TableVersion
+                     ) -> Tuple[np.ndarray, TableVersion]:
+        """Global chunk ids → local table rows.  Owned ids offset into
+        ``[0, hi-lo)``; foreign ids are gathered (unique, one call)
+        and remapped onto their staged halo slots."""
+        lo, hi = self.shard
+        local = chunk.astype(np.int64) - lo
+        foreign = (chunk < lo) | (chunk >= hi)
+        if foreign.any():
+            uniq = np.unique(chunk[foreign])
+            pub, slot_of = self._stage_foreign(uniq, pub)
+            local[foreign] = [slot_of[int(g)] for g in chunk[foreign]]
+        return local.astype(np.int32), pub
+
+    def _stage_foreign(self, uniq: np.ndarray, pub: TableVersion
+                       ) -> Tuple[TableVersion, Dict[int, int]]:
+        """Fetch ``uniq`` foreign rows at exactly ``pub.version`` and
+        stage them into the halo slots of a batch-local copy-on-write
+        table (the published version is never mutated).  The gather is
+        PINNED: an answer from any other version or qmode is retried
+        once (the owner may be mid-publish) and then refused — the
+        model checker's ``gather-version-pinned`` invariant, with the
+        ``shard-gather`` seed showing what an unpinned gather mixes."""
+        import time as _time
+
+        import jax.numpy as jnp
+
+        from .errors import GatherError
+        if self.gather_fn is None:
+            raise GatherError(
+                f"shard [{self.shard[0]}, {self.shard[1]}) was asked "
+                f"for {uniq.size} foreign row(s) but has no gather_fn "
+                f"— sharded serving needs the cross-shard gather leg")
+        if uniq.size > self.halo:
+            raise GatherError(
+                f"{uniq.size} unique foreign rows exceed the halo "
+                f"staging region ({self.halo}); chunking must cap a "
+                f"microbatch at the largest bucket")
+        t0 = _time.perf_counter()
+        vals, scales, ver, qmode = self.gather_fn(uniq, pub.version)
+        if ver != pub.version or qmode != pub.qmode:
+            vals, scales, ver, qmode = self.gather_fn(uniq,
+                                                      pub.version)
+        if ver != pub.version or qmode != pub.qmode:
+            raise GatherError(
+                f"gather pinned to v{pub.version}:{pub.qmode} was "
+                f"answered from v{ver}:{qmode} twice — refusing to "
+                f"mix table versions in one microbatch")
+        slots = self._rows_padded + np.arange(uniq.size)
+        idx = jnp.asarray(slots.astype(np.int32))
+        if pub.qmode != "off":
+            # quantized gathers ship the owner's stored CODES + per-row
+            # scales verbatim — staging them is bit-exact by
+            # construction (per-row symmetric quantization is row-local)
+            table = pub.table.at[idx].set(
+                jnp.asarray(np.asarray(vals),
+                            dtype=pub.table.dtype))
+            scale = pub.scale.at[idx].set(
+                jnp.asarray(np.asarray(scales, dtype=np.float32)))
+            staged = TableVersion(pub.version, table, scale,
+                                  pub.qmode)
+        else:
+            table = pub.table.at[idx].set(
+                jnp.asarray(np.asarray(vals, dtype=np.float32),
+                            dtype=self.compute))
+            staged = TableVersion(pub.version, table, None, "off")
+        ms = (_time.perf_counter() - t0) * 1e3
+        self.last_gather_ms = (self.last_gather_ms or 0.0) + ms
+        slot_of = {int(g): int(s) for g, s in zip(uniq, slots)}
+        return staged, slot_of
 
     # ---------------------------------------------------- invalidation
 
@@ -401,9 +585,117 @@ class Predictor:
         untouched — in-flight dispatches pinned to it finish
         bit-exact (``.at[rows].set`` materializes a fresh buffer:
         copy-on-write at the device boundary)."""
+        if self.shard is not None:
+            raise NotImplementedError(
+                "sharded predictors have no full host cache — "
+                "refreshes arrive as (rows, values) via apply_refresh")
         with self._pub_lock:
             version = self._publish_rows_locked(rows)
         self._emit_publish(version, rows)
+
+    def read_rows(self, ids, version: int):
+        """The gather OWNER side: raw stored rows for ``ids`` (which
+        this predictor must own) at exactly table ``version``.
+        Returns ``(values, scales, version, qmode)`` — fp32 rows with
+        ``scales=None`` for qmode off, else stored codes + per-row
+        scales, both host-side (sharded predictors answer from the
+        slice's host mirror; full-table ones re-encode from the host
+        cache, bit-identical per-row).  A version other than the live
+        publication is refused — the requester's pin decides what to
+        do (retry, then fail typed), never this side."""
+        from .errors import GatherError
+        if self.backend != "precomputed":
+            raise GatherError("row fetches need the precomputed "
+                              "table backend")
+        pub = self._published
+        if int(version) != pub.version:
+            raise GatherError(
+                f"row fetch pinned to v{version} refused: this "
+                f"replica publishes v{pub.version}")
+        ids = np.asarray(ids, dtype=np.int64).ravel()
+        lo, hi = self.shard if self.shard is not None \
+            else (0, self.num_nodes)
+        if ids.size and (ids.min() < lo or ids.max() >= hi):
+            raise GatherError(
+                f"row fetch for ids outside owned range [{lo}, {hi})")
+        local = ids - lo
+        with self._pub_lock:
+            if self.shard is not None:
+                if pub.qmode != "off":
+                    return (self._host_codes[local].copy(),
+                            self._host_scales[local].copy(),
+                            pub.version, pub.qmode)
+                return (self._host_rows[local].copy(), None,
+                        pub.version, pub.qmode)
+            # the REQUESTED rows only (a gather is ≤ halo rows), from
+            # the host cache — never a full fp32 table materialization
+            vals = np.asarray(self.cache.table[local],  # roc-lint: ok=dequant-hot-path
+                              dtype=np.float32)
+        if pub.qmode != "off":
+            from .quant import quantize_rows
+            q, sc = quantize_rows(vals, pub.qmode)
+            return q, sc, pub.version, pub.qmode
+        return vals, None, pub.version, pub.qmode
+
+    def apply_refresh(self, rows: np.ndarray,
+                      values: np.ndarray) -> int:
+        """Sharded half of the ``add_edges`` invalidation fan-out: the
+        update originator (which holds the FULL PropagationCache) runs
+        the k-hop recompute centrally and ships every shard the
+        affected (global rows, fp32 values); each shard applies only
+        the rows it OWNS and bumps its version either way — data
+        lands on owning shards only, while version counters stay
+        comparable across the fleet (an epoch-only bump on
+        non-owners), which is what keeps cross-shard gathers pinnable
+        mid-rollout.  Returns the number of rows this shard applied."""
+        import jax.numpy as jnp
+        if self.shard is None:
+            raise NotImplementedError(
+                "apply_refresh is the sharded refresh path; full-"
+                "table predictors use invalidate()/refresh_rows()")
+        rows = np.asarray(rows, dtype=np.int64).ravel()
+        values = np.asarray(values, dtype=np.float32)
+        lo, hi = self.shard
+        mask = (rows >= lo) & (rows < hi)
+        own = rows[mask] - lo
+        vals = values[mask]
+        with self._pub_lock:
+            old = self._published
+            version = old.version + 1
+            if own.size == 0:
+                # epoch-only bump: no owned data changed, but the
+                # fleet-wide version counter must advance in lockstep
+                self._published = TableVersion(
+                    version, old.table, old.scale, old.qmode)
+            elif old.qmode != "off":
+                from .quant import QuantDriftError, quantize_rows
+                q, sc = quantize_rows(vals, old.qmode)
+                guard = getattr(self, "_scale_guard", None)
+                smax = float(sc.max())  # roc-lint: ok=host-sync-hot-path
+                if guard is not None and smax > guard:
+                    raise QuantDriftError(
+                        f"sharded refresh refused: row scale "
+                        f"{smax:.6g} exceeds the gated envelope "
+                        f"{guard:.6g}; serving stays on "
+                        f"v{old.version}")
+                self._host_codes[own] = q
+                self._host_scales[own] = sc
+                idx = jnp.asarray(own.astype(np.int32))
+                table = old.table.at[idx].set(jnp.asarray(q))
+                scale = old.scale.at[idx].set(jnp.asarray(sc))
+                self.table, self.scale = table, scale
+                self._published = TableVersion(version, table, scale,
+                                               old.qmode)
+            else:
+                self._host_rows[own] = vals
+                idx = jnp.asarray(own.astype(np.int32))
+                table = old.table.at[idx].set(
+                    jnp.asarray(vals, dtype=self.compute))
+                self.table = table
+                self._published = TableVersion(version, table, None,
+                                               "off")
+        self._emit_publish(version, own)
+        return int(own.size)
 
     def _publish_rows_locked(self, rows: np.ndarray) -> Optional[int]:
         import jax.numpy as jnp

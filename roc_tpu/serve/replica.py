@@ -58,7 +58,13 @@ from . import errors as serve_errors
 # names this replica's internal state rather than the request.
 # Deadline/shed/closed failures are the CONTRACT — they propagate
 # typed to the client, never retried into a second replica's queue.
-RETRYABLE = (OSError,)
+# GatherError (PR 20) is replica-internal too: a failed cross-shard
+# row fetch says nothing about the request — a re-dispatch captures a
+# fresh table version and gathers again.
+RETRYABLE = (OSError, serve_errors.GatherError)
+
+GATHER_TIMEOUT_ENV = "ROC_TPU_GATHER_TIMEOUT_S"
+DEFAULT_GATHER_TIMEOUT_S = 10.0
 
 HB_ENV = "ROC_TPU_SERVE_HB_S"
 DEFAULT_HB_S = 1.0
@@ -91,6 +97,154 @@ class _Wire:
             self._stream.flush()
 
 
+def _rows_payload(gid: Any, ids: List[int], rows: Any, version: int,
+                  qmode: str, scales: Any, replica: int,
+                  error: Optional[str]) -> Dict[str, Any]:
+    # ONE wire shape for both halves of a row-fetch answer: ok answers
+    # carry the stored rows (+ per-row scales when quantized, shipped
+    # as storage-byte lists), refusals carry "error" with rows empty —
+    # the requester's version pin decides what to do with a refusal
+    return {"kind": "rows", "gid": gid, "ids": ids, "rows": rows,
+            "version": version, "qmode": qmode, "scales": scales,
+            "replica": replica, "error": error}
+
+
+class _GatherClient:
+    """The REQUESTER half of the cross-shard gather leg (PR 20):
+    ``gather(ids, version)`` splits a microbatch's unique foreign ids
+    by the artifact's shard plan, sends one version-pinned
+    ``fetch_rows`` per owning shard (the router forwards each to the
+    owner and relays the ``rows`` answer back by gid), blocks until
+    every answer lands, and merges them into the
+    ``(values, scales, version, qmode)`` tuple
+    ``Predictor._stage_foreign`` stages.  Any refusal (version
+    mismatch at the owner, owner death, un-owned ids) reports version
+    -1 so the predictor's one-retry-then-``GatherError`` pin logic
+    drives the outcome — the gather never silently mixes versions."""
+
+    def __init__(self, wire: "_Wire", plan: List[List[int]],
+                 qmode: str, replica: int,
+                 timeout_s: Optional[float] = None):
+        self._wire = wire
+        self._plan = [(int(lo), int(hi)) for lo, hi in plan]
+        self._qmode = qmode
+        self._replica = replica
+        if timeout_s is None:
+            try:
+                # env-string parse, not a device fetch
+                timeout_s = float(os.environ.get(  # roc-lint: ok=host-sync-hot-path
+                    GATHER_TIMEOUT_ENV, DEFAULT_GATHER_TIMEOUT_S))
+            except ValueError:
+                timeout_s = DEFAULT_GATHER_TIMEOUT_S
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._pending: Dict[str, Dict[str, Any]] = {}
+
+    def on_rows(self, msg: Dict[str, Any]) -> None:
+        """stdin-reader delivery of one ``rows`` answer."""
+        with self._lock:
+            call = self._pending.pop(str(msg.get("gid")), None)
+        if call is None:
+            return      # late answer for a timed-out gather
+        call["got"][str(msg.get("gid"))] = msg
+        if set(call["got"]) >= call["need"]:
+            call["ev"].set()
+
+    def gather(self, ids, version: int):
+        import numpy as np
+        ids = np.asarray(ids, dtype=np.int64).ravel()
+        call: Dict[str, Any] = {"need": set(), "got": {},
+                                "ev": threading.Event()}
+        sends: List[Any] = []
+        with self._lock:
+            for lo, hi in self._plan:
+                m = (ids >= lo) & (ids < hi)
+                if not m.any():
+                    continue
+                gid = f"r{self._replica}g{self._seq}"
+                self._seq += 1
+                self._pending[gid] = call
+                call["need"].add(gid)
+                sends.append((gid, ids[m]))
+        for gid, sub in sends:
+            self._wire.send({"kind": "fetch_rows", "gid": gid,
+                             "ids": [int(i) for i in sub],
+                             "version": int(version)})
+        if not call["ev"].wait(self._timeout_s):
+            with self._lock:
+                for gid in call["need"]:
+                    self._pending.pop(gid, None)
+            raise serve_errors.GatherError(
+                f"cross-shard gather of {ids.size} row(s) timed out "
+                f"after {self._timeout_s}s (pinned to v{version})")
+        return self._merge(ids, list(call["got"].values()), version)
+
+    def _merge(self, ids, msgs: List[Dict[str, Any]], version: int):
+        import numpy as np
+
+        from ..obs.events import emit
+        from .quant import from_storage_bytes
+        for m in msgs:
+            if m.get("error") or int(m.get("version", -1)) != \
+                    int(version):
+                emit("serve", f"replica {self._replica}: gather "
+                     f"refused by owner: {m.get('error')!r} "
+                     f"(owner v{m.get('version')}, pinned "
+                     f"v{version})", console=False,
+                     kind="gather_refused", replica=self._replica)
+                return None, None, -1, str(m.get("qmode", "off"))
+        qmode = str(msgs[0].get("qmode", "off"))
+        byid: Dict[int, Any] = {}
+        sbyid: Dict[int, float] = {}
+        for m in msgs:
+            if qmode == "off":
+                for i, r in zip(m["ids"], m["rows"]):
+                    byid[int(i)] = np.asarray(r, dtype=np.float32)
+            else:
+                codes = from_storage_bytes(
+                    np.asarray(m["rows"], dtype=np.uint8), qmode)
+                for j, i in enumerate(m["ids"]):
+                    byid[int(i)] = codes[j]
+                    # wire-JSON scalar, not a device fetch
+                    sbyid[int(i)] = float(m["scales"][j])  # roc-lint: ok=host-sync-hot-path
+        vals = np.stack([byid[int(i)] for i in ids])
+        scales = (None if qmode == "off" else
+                  np.asarray([sbyid[int(i)] for i in ids],
+                             dtype=np.float32))
+        return vals, scales, int(version), qmode
+
+
+def _answer_fetch(server, wire: "_Wire", replica: int,
+                  msg: Dict[str, Any]) -> None:
+    """The OWNER half: serve a version-pinned row fetch from the
+    predictor's host mirror (reader-thread work — a host copy, never a
+    device round trip).  Refusals (version mismatch, un-owned ids, no
+    predictor) answer with the error variant of ``rows``."""
+    gid = msg.get("gid")
+    ids = [int(i) for i in (msg.get("ids") or [])]
+    version = int(msg.get("version") or 0)
+    pred = getattr(server, "pred", None)
+    try:
+        if pred is None or not hasattr(pred, "read_rows"):
+            raise serve_errors.GatherError(
+                "this replica has no row-fetch surface")
+        vals, scales, ver, qmode = pred.read_rows(ids, version)
+        if qmode != "off":
+            from .quant import to_storage_bytes
+            rows_w = to_storage_bytes(vals).tolist()
+            scales_w = [float(s) for s in scales]
+        else:
+            rows_w = [[float(x) for x in r] for r in vals]
+            scales_w = None
+        wire.send(_rows_payload(gid, ids, rows_w, int(ver), qmode,
+                                scales_w, replica, None))
+    except BaseException as e:  # noqa: BLE001 - wire the refusal back
+        wire.send(_rows_payload(gid, ids, [], version, "off", None,
+                                replica, f"{type(e).__name__}: "
+                                f"{str(e)[:300]}"))
+
+
 def _error_payload(req_id: int, e: BaseException) -> Dict[str, Any]:
     # the Server wraps dispatch failures in ServeError with the raw
     # exception chained — retryability reads through the chain, so an
@@ -119,11 +273,16 @@ def serve_loop(server, wire: _Wire, replica: int,
             try:
                 rows = fut.result()
                 served[0] += 1
+                shard = getattr(rows, "shard", None)
+                gms = getattr(rows, "gather_ms", None)
                 wire.send({"kind": "res", "id": req_id, "ok": True,
                            "rows": rows.tolist(),
                            "version": int(getattr(rows, "version",
                                                   0)),
-                           "qmode": getattr(rows, "qmode", "off")})
+                           "qmode": getattr(rows, "qmode", "off"),
+                           "shard": (None if shard is None
+                                     else list(shard)),
+                           "gather_ms": gms})
             except BaseException as e:  # noqa: BLE001 - wire it back
                 wire.send(_error_payload(req_id, e))
         return cb
@@ -149,8 +308,23 @@ def serve_loop(server, wire: _Wire, replica: int,
             kind = msg.get("kind")
             if kind == "close":
                 break
+            if kind == "fetch_rows":
+                # the gather leg's OWNER side: answer a version-pinned
+                # row fetch from the host mirror, right here on the
+                # reader thread (host copy, no device work)
+                _answer_fetch(server, wire, replica, msg)
+                continue
+            if kind == "rows":
+                # the gather leg's REQUESTER side: a relayed answer
+                # for one of OUR in-flight fetches — deliver it to the
+                # blocked gather call
+                client = getattr(getattr(server, "pred", None),
+                                 "_gather_client", None)
+                if client is not None:
+                    client.on_rows(msg)
+                continue
             req_id = msg.get("id")
-            if kind != "req":
+            if kind not in ("req", "fetch_rows", "rows"):
                 # explicit unknown-kind rejection: a typo'd or
                 # future kind must fail LOUD, not be silently
                 # treated as a request (the wire-vocabulary bug
@@ -204,8 +378,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "arm of serve fault drills)")
     ap.add_argument("--shard", default=None,
                     help="lo:hi node range this replica ADVERTISES "
-                         "(routing metadata for the future 2-D mesh; "
-                         "the artifact still carries the full table)")
+                         "(routing metadata only; --shard-index is "
+                         "the real sliced-table load)")
+    ap.add_argument("--shard-index", type=int, default=None,
+                    help="cold-load table slice K of a sharded "
+                         "artifact (export --shards N): O(V/N)+halo "
+                         "table bytes, foreign ids served through the "
+                         "cross-shard gather leg")
+    ap.add_argument("--table-budget-bytes", type=int, default=0,
+                    help="per-replica serving-table byte cap: REFUSE "
+                         "to serve (exit 3) when the loaded table "
+                         "exceeds it — the capacity-proof enforcement "
+                         "that makes 'the full table does not fit one "
+                         "replica' a checkable fact, not a claim")
     ap.add_argument("--max-wait-ms", type=float, default=0.2)
     ap.add_argument("--max-queue", type=int, default=None)
     ap.add_argument("--drain-timeout", type=float, default=30.0)
@@ -229,12 +414,41 @@ def main(argv: Optional[List[str]] = None) -> int:
     from .server import DEFAULT_MAX_QUEUE, Server
     enable_compile_cache()
     with Heartbeat(f"replica{args.replica} loading artifact"):
-        pred = load_predictor(args.artifact)
+        pred = load_predictor(args.artifact, shard=args.shard_index)
+    table_bytes = int(pred.table_bytes())
+    if args.table_budget_bytes and table_bytes > args.table_budget_bytes:
+        # the capacity enforcement: an oversize table must refuse
+        # LOUDLY before ready, never silently eat fleet memory — the
+        # micro_serve capacity scenario proves a full-table load
+        # trips this while the sliced loads fit
+        from ..obs.events import emit
+        emit("serve", f"replica {args.replica}: table "
+             f"{table_bytes} B exceeds --table-budget-bytes "
+             f"{args.table_budget_bytes} — refusing to serve",
+             kind="table_budget_refused", replica=args.replica,
+             table_bytes=table_bytes,
+             budget=args.table_budget_bytes)
+        print(f"error: serving table {table_bytes} B exceeds the "
+              f"per-replica budget {args.table_budget_bytes} B "
+              f"(export with --shards to slice it)", file=sys.stderr)
+        return 3
     shard = None
-    if args.shard:
+    if pred.shard is not None:
+        shard = [int(pred.shard[0]), int(pred.shard[1])]
+    elif args.shard:
         lo, hi = args.shard.split(":")
         shard = [int(lo), int(hi)]
     wire = _Wire(sys.stdout)
+    if pred.shard is not None:
+        # wire the gather leg: the shard plan comes from this
+        # replica's own loaded manifest, so it addresses owners by
+        # range without any extra discovery round
+        from .export import MANIFEST_NAME
+        with open(os.path.join(args.artifact, MANIFEST_NAME)) as f:
+            plan = (json.load(f).get("shards") or {}).get("plan") or []
+        client = _GatherClient(wire, plan, pred.quant, args.replica)
+        pred._gather_client = client
+        pred.gather_fn = client.gather
     server = Server(
         pred, max_wait_ms=args.max_wait_ms,
         name=f"replica{args.replica}",
@@ -246,7 +460,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                "num_classes": pred.num_classes,
                "buckets": list(pred.buckets),
                "backend": pred.backend, "shard": shard,
-               "quant": pred.quant})
+               "quant": pred.quant,
+               "table_version": int(pred.published().version),
+               "table_bytes": table_bytes})
     serve_loop(server, wire, args.replica,
                drain_timeout_s=args.drain_timeout)
     return 0

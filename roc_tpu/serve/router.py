@@ -180,11 +180,41 @@ class Router:
                  registry: Optional[MetricsRegistry] = None,
                  stats_window_s: float = 60.0,
                  slos: Optional[Sequence[Any]] = None,
-                 snapshot_path: Optional[str] = None):
+                 snapshot_path: Optional[str] = None,
+                 sharded: bool = False,
+                 table_budget_bytes: Optional[int] = None,
+                 gather_rider_cap: int = 8):
         if n_replicas < 1:
             raise ValueError("need at least one replica")
         if shards is not None and len(shards) != n_replicas:
             raise ValueError("one shard range per replica")
+        self._sharded = bool(sharded)
+        self.table_budget_bytes = table_budget_bytes
+        self.gather_rider_cap = int(gather_rider_cap)
+        # in-flight cross-shard gathers: gid -> (requester replica idx,
+        # owner replica idx); an owner dying mid-gather answers its
+        # outstanding gids with the error variant of ``rows`` so the
+        # requester's pinned gather fails typed instead of timing out
+        self._gathers: Dict[str, Tuple[int, int]] = {}
+        if sharded:
+            # derive one replica per exported table slice: each spawns
+            # with --shard-index K and cold-loads O(V/N)+halo bytes
+            from .export import MANIFEST_NAME
+            with open(os.path.join(artifact_dir, MANIFEST_NAME)) as f:
+                sb = json.load(f).get("shards") or {}
+            if not sb:
+                raise ValueError(
+                    f"{artifact_dir}: sharded=True but the artifact "
+                    f"was not exported with --shards")
+            if shards is not None:
+                raise ValueError("sharded=True derives the shard "
+                                 "ranges from the artifact; drop "
+                                 "shards=")
+            if n_replicas != int(sb["n"]):
+                raise ValueError(
+                    f"sharded artifact has {sb['n']} slice(s); "
+                    f"n_replicas={n_replicas} must match")
+            shards = [(int(lo), int(hi)) for lo, hi in sb["plan"]]
         self.artifact_dir = artifact_dir
         self.max_inflight = int(max_inflight)
         self.default_deadline_ms = default_deadline_ms
@@ -214,6 +244,9 @@ class Router:
         # p99 the latency SLO guards)
         self._h_wire = self.reg.histogram("wire_ms")
         self._h_request = self.reg.histogram("request_ms")
+        # per-microbatch cross-shard gather wall, from res.gather_ms —
+        # the request-path cost of serving O(V/N) tables
+        self._h_gather = self.reg.histogram("gather_ms")
         self._spans: List[Tuple[str, float, float,
                                 Dict[str, Any]]] = []
         self._slo: Optional[SloEngine] = None
@@ -248,8 +281,16 @@ class Router:
         cmd = [sys.executable, "-m", "roc_tpu.serve.replica",
                self.artifact_dir, "--replica", str(idx),
                "--max-wait-ms", str(max_wait_ms)]
-        if shard is not None:
+        if self._sharded:
+            # the real sliced-table load; the replica derives its
+            # owned [lo, hi) range (and the gather plan) from the
+            # artifact's shard manifest
+            cmd += ["--shard-index", str(idx)]
+        elif shard is not None:
             cmd += ["--shard", f"{shard[0]}:{shard[1]}"]
+        if self.table_budget_bytes:
+            cmd += ["--table-budget-bytes",
+                    str(self.table_budget_bytes)]
         if cpu:
             cmd += ["--cpu"]
         cmd += self._replica_args
@@ -399,10 +440,21 @@ class Router:
     def _shard_groups(self, ids: np.ndarray):
         """Split ``ids`` into per-shard-group sub-requests.  Returns
         ``[(gids, positions)]``; with full-range replicas this is one
-        group carrying everything."""
+        group carrying everything.
+
+        Sharded fleets (PR 20): requests at or under
+        ``gather_rider_cap`` ids stay ONE wire sub — the majority
+        owner serves them, fetching the foreign rows through its
+        cross-shard gather leg (splitting a tiny request across N
+        replicas would trade one gather for N wire round trips).
+        Larger requests split per owner range as before; ids outside
+        every advertised range no longer require a full-range
+        fallback replica — ANY replica serves them via gather."""
         ranges = sorted({r.shard for r in self.replicas
                          if r.shard is not None})
         if not ranges:
+            return [(ids, np.arange(ids.size))]
+        if ids.size <= self.gather_rider_cap:
             return [(ids, np.arange(ids.size))]
         groups = []
         claimed = np.zeros(ids.size, dtype=bool)
@@ -412,8 +464,10 @@ class Router:
                 claimed |= mask
                 groups.append((ids[mask], np.nonzero(mask)[0]))
         if not claimed.all():
-            # ids outside every advertised shard: any full-range
-            # replica takes them; else they ride the first group
+            # ids outside every advertised range ride one extra group;
+            # _pick_replica lands it on the least-loaded live replica
+            # and the gather leg makes that correct (the old "any
+            # full-range replica" fallback is gone)
             rest = ~claimed
             groups.append((ids[rest], np.nonzero(rest)[0]))
         return groups or [(ids, np.arange(ids.size))]
@@ -430,11 +484,22 @@ class Router:
             # would absorb its own hedge and defeat the bound), and a
             # broken-pipe exclude must never be re-picked mid-loop
             cands = [r for r in self.replicas
-                     if r.alive and r.ready and r.idx not in exclude
-                     and r.covers(lo, hi)]
+                     if r.alive and r.ready and r.idx not in exclude]
             if not cands:
                 return None
-            return min(cands, key=lambda r: r.inflight)
+            covering = [r for r in cands if r.covers(lo, hi)]
+            if covering:
+                return min(covering, key=lambda r: r.inflight)
+            # no single replica owns the whole sub (a gather-rider
+            # request, or uncovered ids after an owner died): route to
+            # the MAJORITY owner, least-loaded on ties — the foreign
+            # minority arrives through its gather leg
+            def owned(r: _Replica) -> int:
+                if r.shard is None:
+                    return int(sub.ids.size)
+                return int(((sub.ids >= r.shard[0])
+                            & (sub.ids < r.shard[1])).sum())
+            return max(cands, key=lambda r: (owned(r), -r.inflight))
 
     def _dispatch(self, sub: _Sub, hedge: bool = False) -> None:
         """Assign ``sub`` to the least-loaded eligible replica and put
@@ -523,6 +588,10 @@ class Router:
                         rep.silent_noted = False
                 elif kind == "res":
                     self._on_result(rep, msg)
+                elif kind == "fetch_rows":
+                    self._forward_fetch(rep, msg)
+                elif kind == "rows":
+                    self._relay_rows(rep, msg)
                 elif kind == "drained":
                     with self._lock:
                         rep.last_hb = time.monotonic()
@@ -539,6 +608,65 @@ class Router:
         finally:
             self._mark_dead(rep, "stdout EOF")
 
+    def _forward_fetch(self, rep: _Replica,
+                       msg: Dict[str, Any]) -> None:
+        """Gather leg, requester → owner: forward a version-pinned row
+        fetch to the live replica OWNING the ids' range (the line is
+        re-built, not relayed raw — the declared field contract is the
+        send site's shape on both channels).  No live owner → the
+        requester gets the error variant of ``rows`` immediately."""
+        gid = str(msg.get("gid"))
+        ids = [int(i) for i in (msg.get("ids") or [])]
+        version = int(msg.get("version") or 0)
+        lo = min(ids) if ids else 0
+        hi = (max(ids) + 1) if ids else 0
+        owner: Optional[_Replica] = None
+        with self._lock:
+            for r in self.replicas:
+                if (r.alive and r.ready and r.idx != rep.idx
+                        and r.shard is not None and r.covers(lo, hi)):
+                    owner = r
+                    break
+            if owner is not None:
+                self._gathers[gid] = (rep.idx, owner.idx)
+        if owner is not None:
+            ok = owner.send({"kind": "fetch_rows", "gid": gid,
+                             "ids": ids, "version": version})
+            if ok:
+                return
+            with self._lock:
+                self._gathers.pop(gid, None)
+            self._mark_dead(owner, "write failed")
+        rep.send({"kind": "rows", "gid": gid, "ids": ids, "rows": [],
+                  "version": version, "qmode": "off", "scales": None,
+                  "replica": None,
+                  "error": "ReplicaLost: no live replica owns these "
+                           "rows"})
+
+    def _relay_rows(self, rep: _Replica, msg: Dict[str, Any]) -> None:
+        """Gather leg, owner → requester: relay the owner's answer
+        back to the replica whose gid this is (re-built line, same
+        contract note as :meth:`_forward_fetch`)."""
+        gid = str(msg.get("gid"))
+        requester: Optional[_Replica] = None
+        with self._lock:
+            entry = self._gathers.pop(gid, None)
+            if entry is not None:
+                for r in self.replicas:
+                    if r.idx == entry[0]:
+                        requester = r
+                        break
+        if requester is None or not requester.alive:
+            return      # requester died mid-gather; nothing to do
+        requester.send({"kind": "rows", "gid": gid,
+                        "ids": msg.get("ids"),
+                        "rows": msg.get("rows"),
+                        "version": msg.get("version"),
+                        "qmode": msg.get("qmode"),
+                        "scales": msg.get("scales"),
+                        "replica": rep.idx,
+                        "error": msg.get("error")})
+
     def _on_result(self, rep: _Replica, msg: Dict[str, Any]) -> None:
         with self._lock:
             rep.inflight = max(0, rep.inflight - 1)
@@ -549,6 +677,9 @@ class Router:
                 wire_ms = (time.monotonic() - sub.t_sent) * 1e3
         if sub is not None and msg.get("ok"):
             self._h_wire.record(wire_ms)
+            gms = msg.get("gather_ms")
+            if gms is not None:
+                self._h_gather.record(float(gms))
         if sub is None:
             return   # hedge already won (or expired): late twin
         if msg.get("ok"):
@@ -634,6 +765,27 @@ class Router:
                                or s.hedge_replica == rep.idx)
                            and s is not skip]
             closed = self._closed
+            # gathers where the corpse was the OWNER get an error
+            # answer (the requester retries → GatherError → retryable
+            # res → re-dispatch); requester-side entries just drop.
+            owed = [(gid, req_idx) for gid, (req_idx, own_idx)
+                    in self._gathers.items()
+                    if own_idx == rep.idx or req_idx == rep.idx]
+            notify = []
+            for gid, req_idx in owed:
+                del self._gathers[gid]
+                if req_idx == rep.idx:
+                    continue
+                for r in self.replicas:
+                    if r.idx == req_idx and r.alive:
+                        notify.append((gid, r))
+                        break
+        for gid, requester in notify:
+            requester.send({"kind": "rows", "gid": gid, "ids": [],
+                            "rows": [], "version": -1, "qmode": "off",
+                            "scales": None, "replica": rep.idx,
+                            "error": "ReplicaLost: owner died "
+                                     "mid-gather"})
         if closed or (not was_alive and not orphans):
             return
         # the failover marker the timeline renders on the router lane;
@@ -774,6 +926,7 @@ class Router:
 
         out["p50_ms"] = q(self._h_request, 0.50)
         out["p99_ms"] = q(self._h_request, 0.99)
+        out["gather_p50_ms"] = q(self._h_gather, 0.50)
         out["shed_rate"] = rate(self._c_shed.sum_over(w))
         out["error_rate"] = rate(self._c_failed.sum_over(w))
         out["availability"] = rate(self._c_ok.sum_over(w))

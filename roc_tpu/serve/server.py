@@ -103,22 +103,31 @@ class ServeResult(np.ndarray):
     the request's server-side latency.  ``qmode`` is the captured
     version's quantization spec (the wire's ``res.qmode`` field reads
     it) — during a mid-rollout quant swap it says which encoding
-    actually answered."""
+    actually answered.  On a sharded predictor (PR 20) ``shard`` is
+    the replica's owned ``(lo, hi)`` range and ``gather_ms`` the
+    microbatch's cross-shard gather wall (None when every id was
+    owned) — the wire's ``res.shard``/``res.gather_ms`` fields."""
     version: int = 0
     queue_ms: Optional[float] = None
     device_ms: Optional[float] = None
     qmode: str = "off"
+    shard: Optional[Tuple[int, int]] = None
+    gather_ms: Optional[float] = None
 
 
 def _result(rows: np.ndarray, version: int,
             queue_ms: Optional[float] = None,
             device_ms: Optional[float] = None,
-            qmode: str = "off") -> ServeResult:
+            qmode: str = "off",
+            shard: Optional[Tuple[int, int]] = None,
+            gather_ms: Optional[float] = None) -> ServeResult:
     out = rows.view(ServeResult)
     out.version = int(version)
     out.queue_ms = queue_ms
     out.device_ms = device_ms
     out.qmode = qmode
+    out.shard = shard
+    out.gather_ms = gather_ms
     return out
 
 
@@ -187,6 +196,7 @@ class Server:
         self._c_rows = self.reg.counter("rows")
         self._h_batch = self.reg.histogram("batch_ms")
         self._h_queue = self.reg.histogram("queue_ms")
+        self._h_gather = self.reg.histogram("gather_ms")
         self._batch_seq = 0
         self._versions = set()       # table versions actually served
         # the lane handshake: wall/mono stamped by the bus — the
@@ -291,6 +301,7 @@ class Server:
                 "batch_p50_ms": q(self._h_batch, 0.50),
                 "batch_p99_ms": q(self._h_batch, 0.99),
                 "queue_p50_ms": q(self._h_queue, 0.50),
+                "gather_p50_ms": q(self._h_gather, 0.50),
                 "n_shed": n_shed,
                 "n_timeout": n_timeout,
                 "n_rejected_closed": n_rejected,
@@ -460,6 +471,10 @@ class Server:
         t0 = time.monotonic()
         rows = self.pred.query(ids, pub=pub)
         ms = (time.monotonic() - t0) * 1e3
+        # cross-shard gather wall for this microbatch (None when every
+        # id was owned, and always on full-table predictors)
+        gms = getattr(self.pred, "last_gather_ms", None)
+        shard = getattr(self.pred, "shard", None)
         # the device dispatch above runs UNLOCKED; registry metrics
         # carry their own fine-grained locks, so only the version set
         # and span buffer hold the server lock, and the span flush
@@ -467,6 +482,8 @@ class Server:
         # I/O on submit()'s wait path (roc-lint blocking-under-lock)
         if self._obs:
             self._h_batch.record(ms)
+            if gms is not None:
+                self._h_gather.record(gms)
             self._c_batches.inc()
             self._c_rows.inc(int(ids.size))
             self._c_ok.inc(len(batch))
@@ -492,7 +509,9 @@ class Server:
                     _result(rows[lo:lo + r.ids.size], pub.version,
                             queue_ms=round(qms, 3),
                             device_ms=round(ms, 3),
-                            qmode=pub.qmode))
+                            qmode=pub.qmode, shard=shard,
+                            gather_ms=(None if gms is None
+                                       else round(gms, 3))))
             lo += r.ids.size
 
     def _flush_spans(self, final: bool = False) -> None:

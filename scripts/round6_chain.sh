@@ -87,6 +87,16 @@ python benchmarks/micro_serve.py --slo-smoke --cpu \
 #     must not reach the chip stages.
 python benchmarks/micro_serve.py --quant-smoke --cpu \
   --queries 100 --nodes 2000 > /dev/null || exit 1
+#     sharded-serving smoke (PR 20): export --shards 2, cold-load one
+#     slice (program keys must match the export-time shard warm set —
+#     zero new compiles), then serve a 100-query load gen through a
+#     2-replica sharded Router under a per-replica byte cap BELOW the
+#     full table, batches forced across the shard boundary.  Gate
+#     ENFORCED: answers must be bit-exact via the cross-shard gather
+#     leg at availability 1.0 — a fleet that cannot gather across its
+#     own shards must not reach the chip stages.
+python benchmarks/micro_serve.py --shard-smoke --cpu \
+  --queries 100 --nodes 2000 > /dev/null || exit 1
 # 1. staged headline refresh (regression guard before the new rows;
 #    now includes the serve stage — serve_p50_ms/p99/qps land in the
 #    headline line and the sentinel trajectory)

@@ -65,6 +65,15 @@ python benchmarks/micro_serve.py --slo-smoke --cpu \
 # (set -e makes the nonzero exit fatal)
 python benchmarks/micro_serve.py --quant-smoke --cpu \
     --queries 100 --nodes 2000 > /dev/null
+# sharded-serving smoke preflight (PR 20): export --shards 2, cold-
+# load one slice (zero new compiles — slice shapes ride the same
+# bucket quantization), then a 2-replica sharded Router under a byte
+# cap below the full table serves a 100-query load gen whose batches
+# straddle the shard boundary, bit-exact via the cross-shard gather
+# leg — a fleet that cannot gather across its own shards must not
+# reach chip time (set -e makes the nonzero exit fatal)
+python benchmarks/micro_serve.py --shard-smoke --cpu \
+    --queries 100 --nodes 2000 > /dev/null
 exec python -m roc_tpu.train.cli \
     -lr "$LR" -decay "$WD" -decay-rate "$DR" -dropout "$DROP" \
     -layers "$LAYERS" -e "$EPOCHS" -file dataset/reddit-dgl "$@"

@@ -284,6 +284,22 @@ def test_seeded_live_qmode_bites():
         {"single-version-batch"}, rep2.violations
 
 
+def test_seeded_shard_gather_bites():
+    """Merging gathered foreign rows from whatever version the owner
+    publishes at answer time instead of refusing the mismatched pin
+    (the PR-20 seeded bug — the owner republished between capture and
+    gather) violates gather-version-pinned and ONLY that: the locally
+    owned rows still come from the captured version, so
+    single-version-batch and quant-spec-pinned stay green."""
+    rep = run_model("table-swap", seed="shard-gather")
+    bad = {v["invariant"] for v in rep.violations}
+    assert bad == {"gather-version-pinned"}, rep.violations
+    # the sibling seeds are unchanged by the gather extension
+    rep2 = run_model("table-swap", seed="live-qmode")
+    assert {v["invariant"] for v in rep2.violations} == \
+        {"quant-spec-pinned"}, rep2.violations
+
+
 def test_modelcheck_findings_carry_schedule_and_budget(tmp_path):
     """A violation report becomes a modelcheck-invariant finding
     carrying the counterexample schedule; an exhausted budget is

@@ -252,6 +252,39 @@ def test_serve_quant_columns_bite(tmp_path):
         "no_history"
 
 
+def test_serve_shard_columns_bite(tmp_path):
+    """PR-20 satellite: the sharded-serving pair gates the
+    trajectory — a synthetic bad round (per-replica slice back at
+    full-table size → the slicing was lost; gather p50 blown up)
+    bites lower-better on BOTH columns, healthy jitter passes, and
+    load_bench_round reads the columns back like serve_p50_ms."""
+    from roc_tpu.obs.sentinel import load_bench_round
+    doc = {"parsed": {"value": 100.0, "unit": "ms",
+                      "serve_shard_table_bytes": 1388772.0,
+                      "serve_gather_p50_ms": 450.0}}
+    p = tmp_path / "BENCH_r24.json"
+    p.write_text(json.dumps(doc))
+    r = load_bench_round(str(p))
+    assert r["serve_shard_table_bytes"] == 1388772.0
+    assert r["serve_gather_p50_ms"] == 450.0
+    rounds = [dict(r, path=f"r{i}") for i in range(4)]
+    bad = check_run(rounds, {"serve_shard_table_bytes": 2640132.0,
+                             "serve_gather_p50_ms": 4500.0})
+    assert set(bad["regressed"]) == {"serve_shard_table_bytes",
+                                     "serve_gather_p50_ms"}
+    ok = check_run(rounds, {"serve_shard_table_bytes": 1388772.0,
+                            "serve_gather_p50_ms": 470.0})
+    assert ok["ok"], ok
+    # pre-PR-20 rounds lack the columns entirely: never an error
+    old = [{"path": f"r{i}", "serve_p50_ms": 0.5} for i in range(3)]
+    res = check_run(old, {"serve_p50_ms": 0.51,
+                          "serve_shard_table_bytes": 1388772.0,
+                          "serve_gather_p50_ms": 450.0})
+    assert res["ok"], res
+    assert res["checks"]["serve_shard_table_bytes"]["verdict"] == \
+        "no_history"
+
+
 def test_check_run_filters_step_history_by_dtype():
     rounds = [{"path": "a", "step_ms": 7920.0, "compile_s": None,
                "overlap_frac": None, "dtype": "float32"},

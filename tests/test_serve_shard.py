@@ -1,0 +1,288 @@
+"""Sharded serving tables (PR 20, ``serve/export.py --shards`` +
+``serve/predictor.py`` ShardSlice + the router gather leg):
+
+- the shard plan: edge-balanced contiguous ranges covering [0, V)
+  exactly, one fleet-uniform padded slice shape (max owned rounded to
+  NODE_MULTIPLE + halo + pad row), per-slice npz files on disk, and
+  per-replica bytes strictly below the full table once V clears the
+  halo;
+- cold slice load: ``load_predictor(shard=k)`` rebuilds from ONE
+  slice with program keys equal to the manifest's export-time shard
+  warm set (the zero-new-compiles parity), and answers owned ids
+  bit-exactly with no gather path;
+- cross-shard parity: two in-process shard predictors wired
+  gather_fn→read_rows serve GLOBAL ids bit-exactly vs the export
+  predictor, fp32 and int8 (quantized gathers ship stored codes +
+  per-row scales verbatim), including batches that straddle the
+  boundary;
+- the version pin: a gather answered from the wrong version is
+  retried once, then refused typed (GatherError); the owner side
+  refuses stale pins and foreign ids outright;
+- ``add_edges`` across the boundary: the full-cache originator ships
+  (rows, fp32 values) to every shard; owners apply exactly their
+  rows, non-owners bump an epoch-only version, and the fleet stays
+  bit-exact vs the mutated full table at lockstep version counters.
+"""
+
+import numpy as np
+import pytest
+
+
+def _dataset(V=2000, seed=0):
+    from roc_tpu.core.graph import synthetic_dataset
+    return synthetic_dataset(num_nodes=V, avg_degree=6, in_dim=24,
+                             num_classes=5, seed=seed)
+
+
+def _sgc_model():
+    from roc_tpu.models.sgc import build_sgc
+    return build_sgc([24, 5], k=2, dropout_rate=0.5)
+
+
+def _config(**kw):
+    from roc_tpu.train.trainer import TrainConfig
+    kw.setdefault("verbose", False)
+    kw.setdefault("symmetric", True)
+    return TrainConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def rig():
+    import jax
+    from roc_tpu.train.trainer import Trainer
+    ds = _dataset()
+    tr = Trainer(_sgc_model(), ds, _config())
+    tr.train(2)
+    return ds, tr, np.asarray(jax.device_get(tr.predict()))
+
+
+def _export_sharded(rig, out_dir, quant="off", n=2):
+    from roc_tpu.serve.export import build_predictor, export_predictor
+    ds, tr, _ = rig
+    pred = build_predictor(_sgc_model(), ds, _config(),
+                           params=tr.params, backend="precomputed",
+                           quant=quant)
+    manifest = export_predictor(
+        pred, out_dir, dataset_meta={"V": ds.graph.num_nodes},
+        shards=n)
+    return pred, manifest
+
+
+def _wire(a, b):
+    """gather_fn → the other shard's read_rows, with the owner's
+    typed refusal mapped to the wire client's sentinel answer."""
+    from roc_tpu.serve.errors import GatherError
+
+    def mk(owner, me):
+        def gather(ids, version):
+            try:
+                return owner.read_rows(ids, version)
+            except GatherError:
+                return None, None, -1, me.quant
+        return gather
+    a.gather_fn = mk(b, a)
+    b.gather_fn = mk(a, b)
+
+
+def _load_pair(art, wire=True):
+    from roc_tpu.serve.export import load_predictor
+    s0 = load_predictor(art, shard=0)
+    s1 = load_predictor(art, shard=1)
+    if wire:
+        _wire(s0, s1)
+    return s0, s1
+
+
+# ---------------------------------------------------------- the plan
+
+def test_shard_manifest_plan_and_bytes(rig, tmp_path):
+    import os
+
+    from roc_tpu.core.partition import NODE_MULTIPLE
+    from roc_tpu.serve.export import SHARD_FILE
+    from roc_tpu.serve.quant import table_bytes
+    ds = rig[0]
+    V = ds.graph.num_nodes
+    art = str(tmp_path / "art")
+    pred, manifest = _export_sharded(rig, art, quant="int8")
+    sb = manifest["shards"]
+    assert sb["n"] == 2
+    plan = [tuple(p) for p in sb["plan"]]
+    # contiguous, exactly covering [0, V)
+    assert plan[0][0] == 0 and plan[-1][1] == V
+    for (_, a_hi), (b_lo, _) in zip(plan, plan[1:]):
+        assert a_hi == b_lo
+    # one fleet-uniform slice shape: max owned, node-aligned, + halo
+    owned_max = max(hi - lo for lo, hi in plan)
+    assert sb["rows_padded"] >= owned_max
+    assert sb["rows_padded"] % NODE_MULTIPLE == 0
+    assert sb["halo"] == max(manifest["buckets"])
+    F = int(pred.cache.table.shape[1])
+    shape = (sb["rows_padded"] + sb["halo"] + 1, F)
+    assert sb["bytes_per_replica"] == table_bytes(shape, "int8")
+    # the capacity point: a slice is strictly smaller than the table
+    assert sb["bytes_per_replica"] < sb["bytes_full"]
+    for k in range(2):
+        assert os.path.exists(
+            os.path.join(art, SHARD_FILE.format(k=k)))
+    assert sb["program_keys"], "shard warm set must be recorded"
+
+
+def test_cold_slice_load_parity_and_programs(rig, tmp_path):
+    art = str(tmp_path / "art")
+    pred, manifest = _export_sharded(rig, art)
+    s0, s1 = _load_pair(art, wire=False)
+    for s in (s0, s1):
+        # zero-new-compiles: keys equal the export-time shard warm
+        # set (load_predictor raises on mismatch; pin it here too)
+        assert s.program_keys() == sorted(
+            manifest["shards"]["program_keys"])
+        lo, hi = s.shard
+        own = np.arange(lo, min(hi, lo + 16), dtype=np.int32)
+        assert np.array_equal(np.asarray(s.query(own)),
+                              np.asarray(pred.query(own)))
+        assert s.last_gather_ms is None, \
+            "owned-only queries must not touch the gather leg"
+
+
+# ------------------------------------------------- cross-shard parity
+
+@pytest.mark.parametrize("quant", ["off", "int8"])
+def test_cross_shard_gather_parity(rig, tmp_path, quant):
+    ds = rig[0]
+    V = ds.graph.num_nodes
+    art = str(tmp_path / "art")
+    pred, manifest = _export_sharded(rig, art, quant=quant)
+    s0, s1 = _load_pair(art)
+    b = manifest["shards"]["plan"][0][1]
+    rng = np.random.RandomState(0)
+    batches = [rng.randint(0, V, size=12).astype(np.int32)
+               for _ in range(6)]
+    batches.append(np.asarray([b - 1, b, b + 1, 0, V - 1],
+                              dtype=np.int32))   # straddle the seam
+    for ids in batches:
+        want = np.asarray(pred.query(ids))
+        for s in (s0, s1):
+            got = np.asarray(s.query(ids))
+            assert np.array_equal(got, want), (
+                f"quant={quant} shard {s.shard} drifted by "
+                f"{np.abs(got - want).max()}")
+    # the straddling batch crossed at least one foreign fetch
+    assert s0.last_gather_ms is not None
+
+
+def test_gather_version_pin_retry_then_refusal(rig, tmp_path):
+    from roc_tpu.serve.errors import GatherError
+    art = str(tmp_path / "art")
+    pred, manifest = _export_sharded(rig, art)
+    s0, s1 = _load_pair(art, wire=False)
+    foreign = np.asarray([s0.shard[1] + 1], dtype=np.int32)
+    # no gather leg at all → typed refusal
+    with pytest.raises(GatherError):
+        s0.query(foreign)
+    # stale once, fresh on the retry → served (the owner mid-publish)
+    calls = {"n": 0}
+
+    def flaky(ids, version):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return None, None, -1, s0.quant
+        return s1.read_rows(ids, version)
+    s0.gather_fn = flaky
+    want = np.asarray(pred.query(foreign))
+    assert np.array_equal(np.asarray(s0.query(foreign)), want)
+    assert calls["n"] == 2
+    # stale twice → GatherError, never a mixed-version batch
+    s0.gather_fn = lambda ids, version: (None, None, -1, s0.quant)
+    with pytest.raises(GatherError):
+        s0.query(foreign)
+
+
+def test_read_rows_owner_refusals(rig, tmp_path):
+    from roc_tpu.serve.errors import GatherError
+    art = str(tmp_path / "art")
+    _, manifest = _export_sharded(rig, art)
+    s0, s1 = _load_pair(art, wire=False)
+    lo1, hi1 = s1.shard
+    owned = np.asarray([lo1], dtype=np.int64)
+    live = s1.published().version
+    # stale pin refused — the REQUESTER decides what to do
+    with pytest.raises(GatherError):
+        s1.read_rows(owned, live + 1)
+    # foreign ids refused — a gather never silently crosses owners
+    with pytest.raises(GatherError):
+        s1.read_rows(np.asarray([lo1 - 1]), live)
+    vals, scales, ver, qmode = s1.read_rows(owned, live)
+    assert ver == live and qmode == "off" and scales is None
+    assert vals.shape[0] == 1
+
+
+# -------------------------------------------- add_edges invalidation
+
+def test_add_edges_invalidation_crosses_shard_boundary(rig, tmp_path):
+    """The sharded half of the invalidation fan-out: the originator
+    (full PropagationCache) recomputes the k-hop rows centrally and
+    ships (rows, values) to every shard.  An edge appended ACROSS the
+    boundary must refresh owned rows on both sides, keep the fleet
+    bit-exact vs the mutated full table, and advance every shard's
+    version in lockstep (epoch-only on shards that own none)."""
+    ds = rig[0]
+    art = str(tmp_path / "art")
+    pred, manifest = _export_sharded(rig, art)
+    s0, s1 = _load_pair(art)
+    b = manifest["shards"]["plan"][0][1]
+    v0 = (s0.published().version, s1.published().version)
+    # an edge across the seam: src owned by shard 0, dst by shard 1
+    src = np.asarray([b - 2], dtype=np.int32)
+    dst = np.asarray([b + 2], dtype=np.int32)
+    with pred._pub_lock:
+        rows = pred.cache.add_edges(src, dst)
+        version = pred._publish_rows_locked(rows)
+    pred._emit_publish(version, rows)
+    assert rows.size > 0
+    values = np.asarray(pred.cache.table[rows], dtype=np.float32)
+    applied = [s.apply_refresh(rows, values) for s in (s0, s1)]
+    # the k-hop set of a seam edge lands rows on BOTH owners here
+    assert applied[0] > 0 and applied[1] > 0
+    assert sum(applied) == rows.size, "each row on exactly one owner"
+    # lockstep version counters (the pinnable-mid-rollout property)
+    assert s0.published().version == v0[0] + 1
+    assert s1.published().version == v0[1] + 1
+    ids = np.unique(np.concatenate(
+        [rows[:8], np.asarray([b - 1, b, 0], dtype=np.int64)]
+    )).astype(np.int32)
+    want = np.asarray(pred.query(ids))
+    for s in (s0, s1):
+        assert np.array_equal(np.asarray(s.query(ids)), want)
+
+
+def test_add_edges_epoch_only_bump_off_owner(rig, tmp_path):
+    """Rows entirely inside shard 0: shard 1 applies nothing but its
+    version still advances — fleet-comparable counters are what keep
+    a cross-shard gather pinnable right after a refresh."""
+    art = str(tmp_path / "art")
+    pred, manifest = _export_sharded(rig, art)
+    s0, s1 = _load_pair(art)
+    rows = np.arange(4, dtype=np.int64)          # owned by shard 0
+    values = np.asarray(pred.cache.table[rows], dtype=np.float32)
+    v1 = s1.published().version
+    assert s1.apply_refresh(rows, values) == 0
+    assert s1.published().version == v1 + 1
+    assert s0.apply_refresh(rows, values) == rows.size
+    # and the gather leg still pins bit-exact across the new versions
+    want = np.asarray(pred.query(rows.astype(np.int32)))
+    assert np.array_equal(
+        np.asarray(s1.query(rows.astype(np.int32))), want)
+
+
+def test_refresh_guards_are_typed(rig, tmp_path):
+    """The two halves refuse each other's refresh API: sharded
+    predictors have no full host cache (refresh_rows), full-table
+    ones never see the fan-out (apply_refresh)."""
+    art = str(tmp_path / "art")
+    pred, _ = _export_sharded(rig, art)
+    s0, _ = _load_pair(art)
+    with pytest.raises(NotImplementedError):
+        s0.refresh_rows(np.arange(2))
+    with pytest.raises(NotImplementedError):
+        pred.apply_refresh(np.arange(2), np.zeros((2, 24), np.float32))
